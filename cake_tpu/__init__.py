@@ -1,0 +1,12 @@
+"""cake-tpu: a TPU-native distributed LLM inference framework.
+
+A ground-up rebuild of the capabilities of b0xtch/cake (distributed
+single-stream Llama-3 inference, layer-sharded across devices by a YAML
+topology) designed for TPU pods: JAX/XLA/pjit compute, shard_map + ICI
+collectives for multi-chip, Pallas kernels for the hot ops, and C++ for the
+native runtime components. See SURVEY.md for the reference blueprint.
+"""
+
+__version__ = "0.1.0"
+
+from cake_tpu.models.config import LlamaConfig, llama3_8b, llama3_70b  # noqa: F401

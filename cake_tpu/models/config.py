@@ -1,0 +1,126 @@
+"""Model architecture configuration.
+
+TPU-native equivalent of the reference's config plane
+(`cake-core/src/model/config.rs`): a dataclass deserialized from a HuggingFace
+`config.json` (hidden/intermediate sizes, layer/head counts, `rms_norm_eps`,
+`rope_theta`, bos/eos ids — config.rs:13-26), plus the generation-time maximum
+sequence length (the reference hard-caps MAX_SEQ_LEN=4096, config.rs:6; here it
+is a tunable because the TPU build supports long context).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Sequence
+
+import jax.numpy as jnp
+
+# Reference default (config.rs:6). Overridable per-config here.
+DEFAULT_MAX_SEQ_LEN = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    """Llama-family architecture hyper-parameters.
+
+    Field names mirror the HF ``config.json`` keys the reference reads
+    (`config.rs:13-26`) so `from_hf_dict` is a direct mapping.
+    """
+
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    bos_token_id: int | None = 128000
+    eos_token_id: int | Sequence[int] | None = 128001
+    tie_word_embeddings: bool = False
+    max_seq_len: int = DEFAULT_MAX_SEQ_LEN
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def num_kv_groups(self) -> int:
+        """Query heads per KV head (GQA group size, attention.rs:84-89)."""
+        return self.num_attention_heads // self.num_key_value_heads
+
+    @property
+    def jax_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def eos_ids(self) -> tuple[int, ...]:
+        """Normalized EOS id set (reference checks config ids or "</s>",
+        llama.rs:17,26-29,271)."""
+        if self.eos_token_id is None:
+            return ()
+        if isinstance(self.eos_token_id, int):
+            return (self.eos_token_id,)
+        return tuple(self.eos_token_id)
+
+    @classmethod
+    def from_hf_dict(cls, d: dict, **overrides) -> "LlamaConfig":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: v for k, v in d.items() if k in known}
+        # HF configs carry torch_dtype, not dtype.
+        td = d.get("torch_dtype")
+        if td and "dtype" not in overrides:
+            kwargs["dtype"] = {"float16": "bfloat16", "bfloat16": "bfloat16",
+                               "float32": "float32"}.get(td, "bfloat16")
+        kwargs.update(overrides)
+        return cls(**kwargs)
+
+    @classmethod
+    def from_hf_json(cls, path: str | Path, **overrides) -> "LlamaConfig":
+        with open(path) as f:
+            return cls.from_hf_dict(json.load(f), **overrides)
+
+    def to_hf_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop("max_seq_len")
+        d.pop("dtype")
+        d["model_type"] = "llama"
+        return d
+
+
+def llama3_8b(**overrides) -> LlamaConfig:
+    """Meta-Llama-3-8B — the reference's model of record (cake/mod.rs:88-96)."""
+    return LlamaConfig(**overrides)
+
+
+def llama3_70b(**overrides) -> LlamaConfig:
+    base = dict(
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_hidden_layers=80,
+        num_attention_heads=64,
+        num_key_value_heads=8,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def tiny(**overrides) -> LlamaConfig:
+    """Tiny random-weight config for tests (SURVEY.md §4 test strategy)."""
+    base = dict(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rope_theta=10000.0,
+        bos_token_id=1,
+        eos_token_id=2,
+        max_seq_len=128,
+        dtype="float32",
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)

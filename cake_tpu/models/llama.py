@@ -1,0 +1,164 @@
+"""Llama-3 decoder in pure functional JAX.
+
+Equivalent of the reference model stack (`cake-core/src/model/{llama,
+transformer,attention,mlp}.rs`): token embedding + N pre-norm decoder blocks +
+final RMSNorm + lm_head (llama.rs:61-76,79-143), with each block =
+``rms_1 -> attn -> +residual -> rms_2 -> SwiGLU -> +residual``
+(transformer.rs:48-64).
+
+TPU-first design decisions:
+
+- **Stacked layer weights + lax.scan.** Every per-layer weight is stored with
+  a leading ``[num_layers, ...]`` axis and the block loop is a single
+  ``lax.scan`` (llama.rs walks a ``Vec<Box<dyn Forwarder>>`` in Python-style
+  loop, llama.rs:88-119). Scan compiles the block body once for 32/80 layers,
+  and the layer axis is exactly the axis a pipeline stage shards over.
+- **Functional params pytree**, no framework modules: params flow through
+  `jit`/`shard_map` and shard with `NamedSharding` without indirection.
+- **Static shapes everywhere**: the KV cache is preallocated
+  (:mod:`cake_tpu.ops.kvcache`), decode and prefill are two jit signatures.
+- `forward_layers` runs an arbitrary contiguous slice of blocks — the same
+  entry point serves the single-chip model, a pipeline stage, and a remote
+  worker executing its topology-assigned range (worker.rs:85-98).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops.attention import self_attention_block
+from cake_tpu.ops.kvcache import KVCache
+from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.rope import rope_tables
+
+Params = dict[str, Any]
+
+# Stacked per-layer weight names -> shape builders (L = num layers).
+_LAYER_SHAPES = {
+    "attn_norm": lambda c: (c.hidden_size,),
+    "wq": lambda c: (c.hidden_size, c.num_attention_heads * c.head_dim),
+    "wk": lambda c: (c.hidden_size, c.num_key_value_heads * c.head_dim),
+    "wv": lambda c: (c.hidden_size, c.num_key_value_heads * c.head_dim),
+    "wo": lambda c: (c.num_attention_heads * c.head_dim, c.hidden_size),
+    "mlp_norm": lambda c: (c.hidden_size,),
+    "w_gate": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_up": lambda c: (c.hidden_size, c.intermediate_size),
+    "w_down": lambda c: (c.intermediate_size, c.hidden_size),
+}
+
+
+def init_params(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init params pytree (test fixtures / benchmarks; real weights
+    come from :mod:`cake_tpu.utils.weights`)."""
+    dt = dtype or config.jax_dtype
+    L = config.num_hidden_layers
+    keys = iter(jax.random.split(key, len(_LAYER_SHAPES) + 3))
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dt)
+
+    layers = {}
+    for name, shape_fn in _LAYER_SHAPES.items():
+        shape = shape_fn(config)
+        k = next(keys)
+        if name.endswith("norm"):
+            layers[name] = jnp.ones((L,) + shape, dt)
+        else:
+            layers[name] = dense(k, (L,) + shape, shape[0])
+    return {
+        "embed": dense(next(keys), (config.vocab_size, config.hidden_size),
+                       config.hidden_size),
+        "layers": layers,
+        "norm_f": jnp.ones((config.hidden_size,), dt),
+        "lm_head": dense(next(keys), (config.hidden_size, config.vocab_size),
+                         config.hidden_size),
+    }
+
+
+def block_forward(
+    layer: Params,  # one layer's weights (no leading L axis)
+    x: jax.Array,  # [B, T, hidden]
+    k_cache: jax.Array,  # [B, kv_heads, S, D]
+    v_cache: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    pos,
+    config: LlamaConfig,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One pre-norm decoder block (transformer.rs:48-64)."""
+    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    attn_out, k_cache, v_cache = self_attention_block(
+        h, layer["wq"], layer["wk"], layer["wv"], layer["wo"],
+        k_cache, v_cache, cos, sin, pos,
+        config.num_attention_heads, config.num_key_value_heads,
+    )
+    x = x + attn_out
+    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
+    return x, k_cache, v_cache
+
+
+def forward_layers(
+    layers: Params,  # stacked [L', ...] weights (any contiguous block range)
+    x: jax.Array,  # [B, T, hidden]
+    cache: KVCache,  # k/v: [L', B, kv_heads, S, D]
+    cos: jax.Array,
+    sin: jax.Array,
+    pos,
+    config: LlamaConfig,
+) -> tuple[jax.Array, KVCache]:
+    """Run a contiguous run of decoder blocks via ``lax.scan``.
+
+    This is the TPU-native `Forwarder::forward_batch` (cake/mod.rs:143-150,
+    worker.rs:208-219): one call executes any number of contiguous layers with
+    no per-layer dispatch.
+    """
+
+    def body(carry, per_layer):
+        h = carry
+        layer, kc, vc = per_layer
+        h, kc, vc = block_forward(layer, h, kc, vc, cos, sin, pos, config)
+        return h, (kc, vc)
+
+    x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))
+    return x, KVCache(k=k_new, v=v_new)
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, T] int32
+    cache: KVCache,
+    pos,
+    config: LlamaConfig,
+) -> tuple[jax.Array, KVCache]:
+    """Full forward: embed -> blocks -> ln_f -> last position -> lm_head.
+
+    Returns ``(logits [B, vocab] f32, new_cache)`` — logits taken at the last
+    position and upcast to f32 exactly as the reference (llama.rs:124-143).
+    """
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    x = params["embed"][tokens].astype(config.jax_dtype)
+    x, cache = forward_layers(params["layers"], x, cache, cos, sin, pos, config)
+    x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
+    x_last = x[:, -1, :]
+    logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+    return logits, cache
+
+
+def hidden_forward_layers(
+    layers: Params,
+    x: jax.Array,
+    cache: KVCache,
+    pos,
+    config: LlamaConfig,
+    max_seq: int | None = None,
+) -> tuple[jax.Array, KVCache]:
+    """Convenience wrapper that builds RoPE tables internally — the entry
+    point a worker jits for its assigned block range (worker.rs:203-224)."""
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    return forward_layers(layers, x, cache, cos, sin, pos, config)

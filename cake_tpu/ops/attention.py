@@ -1,0 +1,102 @@
+"""GQA causal self-attention (reference math path).
+
+Equivalent of `cake-core/src/model/attention.rs`: no-bias q/k/v/o projections
+sized by head counts (attention.rs:92-109), RoPE from precomputed tables
+(:17-27), KV append (:57), GQA key/value sharing (:59-60,84-89), **scores in
+f32 regardless of model dtype** (:62-77), causal masking, softmax, weighted
+sum, o_proj.
+
+TPU-first redesign decisions:
+
+- The cache is a fixed ``max_seq`` buffer; attention always reads the full
+  buffer and masks out positions beyond the causal frontier. This keeps every
+  decode step the same static shape (one compiled program) instead of the
+  reference's growing-concat shapes.
+- GQA is computed with a grouped einsum (``[B, kv_heads, group, T, D]``)
+  instead of materializing ``repeat_kv`` copies (attention.rs:84-89) — XLA
+  maps the group axis onto the MXU batch dimension for free, where a
+  materialized repeat would burn HBM bandwidth.
+- The memoized mask cache of the reference (cache.rs:81-103) is replaced by an
+  iota comparison fused into the softmax by XLA.
+
+This module is the reference-math path used for correctness tests and small
+shapes; a fused Pallas flash-attention kernel for long-context is planned as
+``cake_tpu.ops.flash`` and will plug in behind the same signature.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.ops import kvcache as kv
+from cake_tpu.ops.rope import apply_rope
+
+NEG_INF = -1e30
+
+
+def attend(
+    q: jax.Array,  # [B, n_heads, T, D] (already roped)
+    k_all: jax.Array,  # [B, kv_heads, S, D] (full cache buffer)
+    v_all: jax.Array,  # [B, kv_heads, S, D]
+    pos,  # scalar: absolute position of q[..., 0, :]
+) -> jax.Array:
+    """Masked GQA attention over a fixed-size KV buffer. Returns [B,H,T,D]."""
+    b, n_heads, t, d = q.shape
+    kv_heads, s = k_all.shape[1], k_all.shape[2]
+    group = n_heads // kv_heads
+
+    qg = q.reshape(b, kv_heads, group, t, d)
+    # f32 scores regardless of model dtype (attention.rs:62-77).
+    scores = jnp.einsum(
+        "bkgtd,bksd->bkgts", qg, k_all, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(d))
+
+    # Causal frontier: key position valid iff kpos <= pos + t_idx.
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0) + jnp.asarray(pos, jnp.int32)
+    mask = kpos <= qpos  # [T, S]
+    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum(
+        "bkgts,bksd->bkgtd", probs.astype(v_all.dtype), v_all,
+        preferred_element_type=jnp.float32,
+    )
+    return out.reshape(b, n_heads, t, d).astype(q.dtype)
+
+
+def self_attention_block(
+    x: jax.Array,  # [B, T, hidden]
+    wq: jax.Array,  # [hidden, n_heads * D]
+    wk: jax.Array,  # [hidden, kv_heads * D]
+    wv: jax.Array,  # [hidden, kv_heads * D]
+    wo: jax.Array,  # [n_heads * D, hidden]
+    k_cache: jax.Array,  # [B, kv_heads, S, D]
+    v_cache: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    pos,
+    num_heads: int,
+    num_kv_heads: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One attention sublayer incl. cache update.
+
+    Returns ``(attn_out [B,T,hidden], new_k_cache, new_v_cache)``.
+    Mirrors `attention.rs:30-90` + `cache.process_kv` (:57).
+    """
+    b, t, hidden = x.shape
+    d = wq.shape[1] // num_heads
+
+    q = (x @ wq).reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    k = (x @ wk).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+    v = (x @ wv).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+
+    q = apply_rope(q, cos, sin, pos)
+    k = apply_rope(k, cos, sin, pos)
+
+    k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, pos)
+
+    out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
+    return out @ wo, k_cache, v_cache

@@ -1,0 +1,99 @@
+"""Static-shape KV cache for autoregressive decode.
+
+TPU-native redesign of the reference cache (`cake-core/src/model/cache.rs`).
+The reference appends K/V per token with `Tensor::cat` along the sequence axis
+(cache.rs:106-135) — a realloc-per-step pattern that would force an XLA retrace
+on every decode step. Here the cache is a preallocated
+``[num_layers, batch, num_kv_heads, max_seq, head_dim]`` pytree updated in
+place with ``lax.dynamic_update_slice`` and donated across steps, so every
+decode step compiles once and reuses the same HBM buffers.
+
+The reference's other two cache jobs are relocated where XLA wants them:
+RoPE tables (cache.rs:31-50) live in :mod:`cake_tpu.ops.rope`; causal masks
+(cache.rs:81-103) are folded into attention via iota comparison (no
+memoization needed — the mask is fused by XLA, or folded into the Pallas
+flash kernel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.config import LlamaConfig
+
+
+@partial(jax.tree_util.register_dataclass, data_fields=["k", "v"], meta_fields=[])
+@dataclasses.dataclass
+class KVCache:
+    """Preallocated per-layer key/value buffers.
+
+    Shapes: ``k, v: [num_layers, batch, num_kv_heads, max_seq, head_dim]``.
+    The leading layer axis makes the cache scannable alongside stacked layer
+    weights, and shardable along a pipeline-stage mesh axis.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def max_seq(self) -> int:
+        return self.k.shape[3]
+
+    def as_new(self) -> "KVCache":
+        """Fresh zeroed cache with identical shapes.
+
+        Mirrors the reference's per-connection isolation clone
+        (`cache.rs:138-146`): same geometry, reset contents.
+        """
+        return KVCache(k=jnp.zeros_like(self.k), v=jnp.zeros_like(self.v))
+
+
+def init_cache(
+    config: LlamaConfig,
+    batch: int = 1,
+    max_seq: int | None = None,
+    dtype=None,
+    num_layers: int | None = None,
+) -> KVCache:
+    """Allocate a zeroed cache. ``num_layers`` overrides the config count so a
+    pipeline stage / worker can hold buffers for only its own layers
+    (the reference worker keeps a cache indexed by *global* block_idx,
+    cache.rs:17,58 — here each stage's cache is dense over its local layers)."""
+    L = config.num_hidden_layers if num_layers is None else num_layers
+    S = max_seq or config.max_seq_len
+    dt = dtype or config.jax_dtype
+    shape = (L, batch, config.num_key_value_heads, S, config.head_dim)
+    return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
+
+
+def update_layer(
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    pos: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write ``k_new/v_new [batch, kv_heads, T, head_dim]`` into one layer's
+    buffers ``[batch, kv_heads, max_seq, head_dim]`` at sequence offset ``pos``.
+
+    Replaces the reference's `process_kv` concat (cache.rs:106-135) — including
+    *not* reproducing its axis-confused trimming bug (length checks on the
+    heads axis, narrow on head_dim; see SURVEY.md §2).
+    """
+    zero = jnp.zeros((), jnp.int32)
+    start = (zero, zero, jnp.asarray(pos, jnp.int32), zero)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), start)
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), start)
+    return k_cache, v_cache

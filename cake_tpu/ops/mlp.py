@@ -1,0 +1,16 @@
+"""SwiGLU feed-forward.
+
+Equivalent of `cake-core/src/model/mlp.rs`: ``down(silu(gate(x)) * up(x))``
+(mlp.rs:15-18) with no-bias linears gate/up/down sized hidden↔intermediate
+(mlp.rs:21-32). Left as plain jnp — XLA fuses the silu and multiply into the
+matmul epilogues on TPU, so a hand-written kernel buys nothing here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down

@@ -1,0 +1,20 @@
+"""RMSNorm.
+
+Equivalent of the reference's ``candle_nn::RmsNorm`` usage in the pre-norm
+decoder block (`transformer.rs:30-38,48-64`). Computed in f32 regardless of
+activation dtype (the candle kernel upcasts the same way), cast back on exit
+so XLA keeps the surrounding matmuls in bf16 on the MXU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """``x * rsqrt(mean(x^2) + eps) * weight`` over the last axis."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)

@@ -1,0 +1,50 @@
+"""Rotary position embeddings.
+
+Equivalent of the reference's precomputed cos/sin tables + rope application
+(`cache.rs:31-50` builds ``theta_i = rope_theta^(-2i/d)`` tables for
+MAX_SEQ_LEN positions; `attention.rs:17-27` slices them by ``index_pos`` and
+applies ``candle_nn::rotary_emb::rope``). Here the tables are a small constant
+pytree computed once per model; slicing by position is a
+``dynamic_slice`` so the decode step stays a single compiled program.
+
+The rotation convention matches candle's ``rotary_emb::rope`` (non-interleaved
+half-rotation, the HF Llama convention): split head_dim into two halves,
+rotate ``(x1, x2) -> (x1*cos - x2*sin, x1*sin + x2*cos)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_tables(head_dim: int, max_seq: int, theta: float, dtype=jnp.float32):
+    """Precompute ``cos/sin [max_seq, head_dim // 2]`` (cache.rs:31-50)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [max_seq, head_dim/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(
+    x: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    pos: jax.Array,
+) -> jax.Array:
+    """Rotate ``x [batch, heads, T, head_dim]`` for absolute positions
+    ``pos .. pos+T`` (the reference's ``cosine/sine(index_pos, seq_len)``
+    slice, cache.rs:71-78)."""
+    b, h, t, d = x.shape
+    half = d // 2
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, jnp.asarray(pos, jnp.int32), t, axis=0)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, jnp.asarray(pos, jnp.int32), t, axis=0)
+    cos_t = cos_t[None, None, :, :]  # [1,1,T,half]
+    sin_t = sin_t[None, None, :, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos_t - x2 * sin_t, x1 * sin_t + x2 * cos_t], axis=-1
+    )
+    return rotated.astype(x.dtype)

@@ -1,0 +1,106 @@
+"""Seeded token sampling: temperature / top-k / top-p / repeat penalty.
+
+Equivalent of the reference's sampling plane: `create_logits_processor`
+(llama.rs:45-58) maps flags to candle's ``Sampling`` enum — temp<=0 → ArgMax,
+else All / TopK / TopP / TopKThenTopP — seeded with ``--seed`` (default
+299792458); repeat penalty over the last ``repeat_last_n`` tokens
+(llama.rs:250-259, candle's ``apply_repeat_penalty``: positive scores divided
+by the penalty, negative multiplied).
+
+TPU-first design: the whole sampler is a pure jittable function so it fuses
+into the decode-step program — no logits download to host per token (the
+reference ships full logits to the CPU sampler every step, llama.rs:241-265).
+The token history for the repeat penalty is a fixed-size device ring buffer
+(static shape; empty slots hold -1), not a growing host list.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+# Reference flag defaults (cake-core/src/lib.rs:15-64).
+DEFAULT_SEED = 299792458
+DEFAULT_TEMPERATURE = 1.0
+DEFAULT_REPEAT_PENALTY = 1.1
+DEFAULT_REPEAT_LAST_N = 128
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplerSettings:
+    temperature: float = DEFAULT_TEMPERATURE
+    top_k: int | None = None
+    top_p: float | None = None
+    repeat_penalty: float = DEFAULT_REPEAT_PENALTY
+    repeat_last_n: int = DEFAULT_REPEAT_LAST_N
+    seed: int = DEFAULT_SEED
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+
+def apply_repeat_penalty(
+    logits: jax.Array,  # [vocab] f32
+    history: jax.Array,  # [repeat_last_n] int32, -1 = empty slot
+    penalty: float,
+) -> jax.Array:
+    """Penalize every token present in ``history`` (llama.rs:250-259)."""
+    vocab = logits.shape[0]
+    ids = jnp.where(history >= 0, history, vocab)  # park empties out of range
+    present = jnp.zeros((vocab + 1,), jnp.bool_).at[ids].set(True)[:vocab]
+    penalized = jnp.where(logits >= 0.0, logits / penalty, logits * penalty)
+    return jnp.where(present, penalized, logits)
+
+
+def _mask_top_k(logits: jax.Array, k: int) -> jax.Array:
+    vals = jax.lax.top_k(logits, k)[0]
+    return jnp.where(logits < vals[-1], NEG_INF, logits)
+
+
+def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest prefix of the sorted distribution
+    whose cumulative probability reaches ``p`` (candle TopP semantics)."""
+    sorted_logits = jnp.sort(logits)[::-1]
+    probs = jax.nn.softmax(sorted_logits)
+    cum_exclusive = jnp.cumsum(probs) - probs
+    keep = cum_exclusive < p  # always keeps at least the top token
+    threshold = jnp.min(jnp.where(keep, sorted_logits, jnp.inf))
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def sample_token(
+    logits: jax.Array,  # [vocab] f32
+    key: jax.Array,
+    history: jax.Array,  # [repeat_last_n] int32 ring buffer, -1 empty
+    settings: SamplerSettings,
+) -> jax.Array:
+    """Pure sampling step -> scalar int32 token. Jittable; ``settings`` is
+    static (mode selection mirrors llama.rs:45-58)."""
+    if settings.repeat_penalty != 1.0:
+        logits = apply_repeat_penalty(logits, history, settings.repeat_penalty)
+
+    if settings.greedy:
+        return jnp.argmax(logits).astype(jnp.int32)
+
+    logits = logits / jnp.float32(settings.temperature)
+    if settings.top_k is not None:
+        logits = _mask_top_k(logits, settings.top_k)
+    if settings.top_p is not None:
+        logits = _mask_top_p(logits, settings.top_p)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def push_history(history: jax.Array, slot: jax.Array, token: jax.Array):
+    """Write ``token`` into the ring buffer at ``slot % len`` and bump slot."""
+    n = history.shape[0]
+    idx = jnp.mod(slot, n)
+    return history.at[idx].set(token), slot + 1
+
+
+def init_history(repeat_last_n: int) -> tuple[jax.Array, jax.Array]:
+    return jnp.full((repeat_last_n,), -1, jnp.int32), jnp.zeros((), jnp.int32)

@@ -1,0 +1,245 @@
+"""Autoregressive generation loop (single-host, all-local path).
+
+Equivalent of the reference's `Generator` trait + `LLama::next_token`
+(`cake-core/src/model/mod.rs:21-29,46-58`, `model/llama.rs:223-272`):
+``next_token(index) -> Token{id, text, is_end_of_stream}``, ``last()`` flushes
+the detokenizer tail, ``generated_tokens()`` counts. The KV-cache context
+windowing matches llama.rs:228-232 — the full prompt is fed once (prefill),
+every later step feeds exactly one token.
+
+TPU-first design:
+
+- **Two compiled programs**: ``prefill`` (prompt at bucketed lengths) and
+  ``decode_step``. The decode step fuses the *entire* per-token pipeline —
+  embed -> all layers -> ln_f -> lm_head -> repeat penalty -> sampling — into
+  one XLA program with the cache donated, so each token costs one dispatch
+  and zero host round-trips except the sampled id (the reference downloads
+  full logits to the CPU sampler every token, llama.rs:241-265).
+- **Prompt bucketing**: prompts are right-padded to a power-of-two bucket so
+  prefill compiles O(log max_seq) times, not per prompt length. Padded
+  positions write garbage K/V beyond the prompt, which is invisible under the
+  causal mask and overwritten by subsequent decode steps before it ever
+  enters the frontier.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.models import llama
+from cake_tpu.ops.kvcache import KVCache, init_cache
+from cake_tpu.ops.rope import rope_tables
+from cake_tpu.ops import sampling
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.utils.token_stream import TokenOutputStream
+
+
+@dataclasses.dataclass
+class Token:
+    """Mirror of the reference ``Token`` (model/mod.rs:46-52)."""
+
+    id: int
+    text: str | None
+    is_end_of_stream: bool
+
+
+def _bucket(n: int, max_seq: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return min(b, max_seq)
+
+
+def _lm_head(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
+    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
+    return (x_last @ params["lm_head"]).astype(jnp.float32)
+
+
+def prefill_fn(params, tokens, cache: KVCache, last_index, config: LlamaConfig):
+    """Prompt pass. ``tokens [B, T_pad]``; logits read at ``last_index``
+    (the last *real* prompt position). Returns (logits [B, vocab], cache)."""
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    x = params["embed"][tokens].astype(config.jax_dtype)
+    x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, 0, config)
+    x_last = jnp.take_along_axis(
+        x, last_index.reshape(-1, 1, 1).astype(jnp.int32), axis=1
+    )[:, 0, :]
+    return _lm_head(params, x_last, config), cache
+
+
+def decode_step_fn(
+    params,
+    token,  # [B] int32 — previous sampled token
+    cache: KVCache,
+    pos,  # scalar int32
+    key,
+    history,  # [repeat_last_n] int32
+    hist_slot,
+    config: LlamaConfig,
+    settings: SamplerSettings,
+):
+    """One fused decode step: forward one token + sample the next."""
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    x = params["embed"][token[:, None]].astype(config.jax_dtype)
+    x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, pos, config)
+    logits = _lm_head(params, x[:, -1, :], config)
+    next_tok = sampling.sample_token(logits[0], key, history, settings)
+    history, hist_slot = sampling.push_history(history, hist_slot, next_tok)
+    return next_tok, cache, history, hist_slot
+
+
+class LlamaGenerator:
+    """Single-stream generator over an all-local model. (The distributed,
+    topology-sharded equivalent is built on the same prefill/decode functions
+    with per-segment runners; see cake_tpu.parallel.)"""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        cache_dtype=None,
+    ):
+        self.config = config
+        self.params = params
+        self.settings = settings or SamplerSettings()
+        self.max_seq = max_seq or config.max_seq_len
+        self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
+                                dtype=cache_dtype)
+        self.stream = TokenOutputStream(tokenizer) if tokenizer is not None else None
+        self.tokenizer = tokenizer
+
+        self._prefill = jax.jit(
+            partial(prefill_fn, config=config),
+            static_argnames=(),
+            donate_argnames=("cache",),
+        )
+        self._decode = jax.jit(
+            partial(decode_step_fn, config=config, settings=self.settings),
+            donate_argnames=("cache",),
+        )
+
+        self._key = jax.random.PRNGKey(self.settings.seed)
+        self._history, self._hist_slot = sampling.init_history(
+            self.settings.repeat_last_n
+        )
+        self._prompt_tokens: list[int] = []
+        self._generated: list[int] = []
+        self._pos = 0
+        self._last_token: int | None = None
+        self._eos_ids = set(config.eos_ids())
+
+    # -- prompt handling ----------------------------------------------------
+    def set_prompt(self, prompt: str | list[int]) -> None:
+        if isinstance(prompt, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt requires a tokenizer")
+            ids = self.tokenizer.encode(prompt)
+            ids = getattr(ids, "ids", ids)  # HF tokenizers Encoding vs list
+            if self.config.bos_token_id is not None and (
+                not ids or ids[0] != self.config.bos_token_id
+            ):
+                ids = [self.config.bos_token_id] + list(ids)
+        else:
+            ids = list(prompt)
+        if not ids:
+            raise ValueError("empty prompt")
+        if len(ids) >= self.max_seq:
+            raise ValueError(f"prompt length {len(ids)} >= max_seq {self.max_seq}")
+        bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
+        if bad:
+            raise ValueError(
+                f"prompt token ids out of range [0, {self.config.vocab_size}): {bad[:5]}"
+            )
+        self._prompt_tokens = ids
+        # Reset all per-stream state so a generator can serve a new prompt
+        # (the stale KV beyond the new prompt is invisible under the causal
+        # mask and overwritten as decode advances, so the cache itself does
+        # not need zeroing).
+        self._generated.clear()
+        self._pos = 0
+        self._last_token = None
+        if self.stream is not None:
+            self.stream.clear()
+        # Seed the repeat-penalty window with the prompt tail (llama.rs:250-259
+        # penalizes over all generated context; we include the prompt tail) —
+        # one vectorized write, not a per-token device loop.
+        self._history, self._hist_slot = sampling.init_history(
+            self.settings.repeat_last_n
+        )
+        tail = ids[-self.settings.repeat_last_n :]
+        if tail:
+            idx = jnp.arange(len(tail), dtype=jnp.int32)
+            self._history = self._history.at[idx].set(
+                jnp.asarray(tail, jnp.int32)
+            )
+            self._hist_slot = jnp.int32(len(tail))
+
+    # -- Generator trait surface -------------------------------------------
+    def next_token(self, index: int) -> Token:
+        """index 0: prefill the whole prompt; index>0: one-token decode
+        (context windowing per llama.rs:228-232)."""
+        if index == 0:
+            if not self._prompt_tokens:
+                raise RuntimeError("set_prompt first")
+            n = len(self._prompt_tokens)
+            t_pad = _bucket(n, self.max_seq)
+            padded = self._prompt_tokens + [0] * (t_pad - n)
+            tokens = jnp.asarray([padded], jnp.int32)
+            logits, self.cache = self._prefill(
+                self.params, tokens, self.cache, jnp.asarray([n - 1], jnp.int32)
+            )
+            step_key = jax.random.fold_in(self._key, 0)
+            tok = sampling.sample_token(
+                logits[0], step_key, self._history, self.settings
+            )
+            self._history, self._hist_slot = sampling.push_history(
+                self._history, self._hist_slot, tok
+            )
+            self._pos = n
+            tok_id = int(tok)
+        else:
+            if self._pos >= self.max_seq:
+                raise RuntimeError(
+                    f"KV cache exhausted: position {self._pos} >= max_seq "
+                    f"{self.max_seq} (raise max_seq or shorten the stream)"
+                )
+            step_key = jax.random.fold_in(self._key, index)
+            tok, self.cache, self._history, self._hist_slot = self._decode(
+                self.params,
+                jnp.asarray([self._last_token], jnp.int32),
+                self.cache,
+                jnp.int32(self._pos),
+                step_key,
+                self._history,
+                self._hist_slot,
+            )
+            self._pos += 1
+            tok_id = int(tok)
+
+        self._last_token = tok_id
+        self._generated.append(tok_id)
+        is_eos = tok_id in self._eos_ids
+        text = self.stream.next_token(tok_id) if self.stream else None
+        return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
+
+    def last(self) -> str | None:
+        """Flush residual detokenizer text (model/mod.rs `last`,
+        llama.rs via token_output_stream.rs:55-69)."""
+        return self.stream.decode_rest() if self.stream else None
+
+    def generated_tokens(self) -> int:
+        return len(self._generated)
+
+    @property
+    def generated_ids(self) -> list[int]:
+        return list(self._generated)

@@ -1,0 +1,173 @@
+"""HF-checkpoint → params-pytree conversion and safetensors loading.
+
+Equivalent of the reference's weight plane: mmap'd safetensors via the
+`model.safetensors.index.json` weight_map (`utils/mod.rs:36-91`), with per-
+layer tensors resolved by HF names (``model.layers.{i}.self_attn.q_proj`` …,
+transformer.rs:30-38, attention.rs:92-109, mlp.rs:21-32).
+
+Differences by design:
+
+- HF stores linear weights ``[out, in]`` (torch Linear); the params pytree
+  stores ``[in, out]`` so forward is ``x @ w`` with no transposes inside jit.
+- Per-layer tensors are **stacked** into a single ``[num_layers, ...]`` array
+  per weight name (the scan/pipeline layout, see models/llama.py).
+- Loading accepts a layer *range* so a worker/pipeline stage loads only its
+  topology-assigned slice (the reference worker loads only its own blocks,
+  worker.rs:85-98; the splitter bundles are just a pre-filtered checkpoint).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Iterable
+
+import jax.numpy as jnp
+import numpy as np
+
+# our stacked name -> (HF suffix, transpose?)
+_LAYER_MAP = {
+    "attn_norm": ("input_layernorm.weight", False),
+    "wq": ("self_attn.q_proj.weight", True),
+    "wk": ("self_attn.k_proj.weight", True),
+    "wv": ("self_attn.v_proj.weight", True),
+    "wo": ("self_attn.o_proj.weight", True),
+    "mlp_norm": ("post_attention_layernorm.weight", False),
+    "w_gate": ("mlp.gate_proj.weight", True),
+    "w_up": ("mlp.up_proj.weight", True),
+    "w_down": ("mlp.down_proj.weight", True),
+}
+
+
+def params_from_hf_tensors(
+    get: Callable[[str], np.ndarray],
+    num_layers: int,
+    dtype="bfloat16",
+    layer_range: tuple[int, int] | None = None,
+    tie_word_embeddings: bool = False,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> dict:
+    """Build the params pytree from a tensor lookup ``get(hf_name)``.
+
+    ``layer_range=(lo, hi)`` loads only blocks ``lo..hi-1`` (still stacked,
+    dense from 0) — the worker/stage path."""
+    lo, hi = layer_range or (0, num_layers)
+    dt = jnp.dtype(dtype)
+
+    layers = {}
+    for ours, (suffix, transpose) in _LAYER_MAP.items():
+        per = []
+        for i in range(lo, hi):
+            w = np.asarray(get(f"model.layers.{i}.{suffix}"))
+            if transpose:
+                w = w.T
+            per.append(w)
+        layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
+
+    params: dict = {"layers": layers}
+    if include_embed:
+        params["embed"] = jnp.asarray(np.asarray(get("model.embed_tokens.weight"))).astype(dt)
+    if include_head:
+        params["norm_f"] = jnp.asarray(np.asarray(get("model.norm.weight"))).astype(dt)
+        head_name = (
+            "model.embed_tokens.weight" if tie_word_embeddings else "lm_head.weight"
+        )
+        params["lm_head"] = jnp.asarray(np.asarray(get(head_name)).T).astype(dt)
+    return params
+
+
+def load_safetensors_index(model_dir: str | Path) -> dict[str, Path]:
+    """Resolve tensor name -> shard file from ``model.safetensors.index.json``
+    (utils/mod.rs:36-91), falling back to a single ``model.safetensors`` (the
+    splitter also writes ``reduced.safetensors``)."""
+    model_dir = Path(model_dir)
+    index = model_dir / "model.safetensors.index.json"
+    if index.exists():
+        weight_map = json.loads(index.read_text())["weight_map"]
+        return {name: model_dir / fname for name, fname in weight_map.items()}
+    for candidate in ("model.safetensors", "reduced.safetensors"):
+        f = model_dir / candidate
+        if f.exists():
+            from safetensors import safe_open
+
+            with safe_open(f, framework="np") as sf:
+                return {name: f for name in sf.keys()}
+    raise FileNotFoundError(f"no safetensors index or file under {model_dir}")
+
+
+def load_llama_params(
+    model_dir: str | Path,
+    num_layers: int,
+    dtype="bfloat16",
+    layer_range: tuple[int, int] | None = None,
+    tie_word_embeddings: bool = False,
+    include_embed: bool = True,
+    include_head: bool = True,
+) -> dict:
+    """Load a Llama checkpoint directory into the params pytree.
+
+    Shards are opened lazily with ``safetensors.safe_open`` (zero-copy mmap,
+    the equivalent of VarBuilder::from_mmaped_safetensors, cake/mod.rs:100-101)
+    and only requested tensors are materialized — a worker loading 4 of 32
+    layers reads only those bytes.
+    """
+    from safetensors import safe_open
+
+    name_to_file = load_safetensors_index(model_dir)
+    handles: dict[Path, object] = {}
+
+    def get(name: str) -> np.ndarray:
+        f = name_to_file[name]
+        if f not in handles:
+            handles[f] = safe_open(f, framework="np")
+        return handles[f].get_tensor(name)
+
+    try:
+        return params_from_hf_tensors(
+            get,
+            num_layers,
+            dtype=dtype,
+            layer_range=layer_range,
+            tie_word_embeddings=tie_word_embeddings,
+            include_embed=include_embed,
+            include_head=include_head,
+        )
+    finally:
+        for h in handles.values():
+            if hasattr(h, "close"):
+                h.close()
+            elif hasattr(h, "__exit__"):
+                h.__exit__(None, None, None)
+
+
+def save_llama_params(params: dict, model_dir: str | Path, num_layers: int | None = None):
+    """Write a params pytree back to HF-format safetensors (test fixtures and
+    the splitter round-trip). Inverse of :func:`load_llama_params`."""
+    from safetensors.numpy import save_file
+
+    model_dir = Path(model_dir)
+    model_dir.mkdir(parents=True, exist_ok=True)
+    tensors: dict[str, np.ndarray] = {}
+    if "embed" in params:
+        tensors["model.embed_tokens.weight"] = np.asarray(params["embed"])
+    if "norm_f" in params:
+        tensors["model.norm.weight"] = np.asarray(params["norm_f"])
+        tensors["lm_head.weight"] = np.asarray(params["lm_head"]).T
+    L = params["layers"]["wq"].shape[0] if num_layers is None else num_layers
+    for ours, (suffix, transpose) in _LAYER_MAP.items():
+        stacked = np.asarray(params["layers"][ours])
+        for i in range(L):
+            w = stacked[i]
+            tensors[f"model.layers.{i}.{suffix}"] = w.T if transpose else np.ascontiguousarray(w)
+
+    out = model_dir / "model.safetensors"
+    # bf16 numpy isn't universally supported by safetensors.numpy; store f32
+    tensors = {k: np.ascontiguousarray(v, dtype=np.float32) for k, v in tensors.items()}
+    save_file(tensors, out)
+    index = {
+        "metadata": {"total_size": int(sum(v.nbytes for v in tensors.values()))},
+        "weight_map": {k: "model.safetensors" for k in tensors},
+    }
+    (model_dir / "model.safetensors.index.json").write_text(json.dumps(index))
+    return out

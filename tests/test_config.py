@@ -1,0 +1,57 @@
+import json
+
+from cake_tpu.models.config import LlamaConfig, llama3_8b, llama3_70b, tiny
+
+
+def test_defaults_are_llama3_8b():
+    c = llama3_8b()
+    assert c.num_hidden_layers == 32
+    assert c.num_attention_heads == 32
+    assert c.num_key_value_heads == 8
+    assert c.head_dim == 128
+    assert c.num_kv_groups == 4
+    assert c.vocab_size == 128256
+
+
+def test_llama3_70b():
+    c = llama3_70b()
+    assert c.num_hidden_layers == 80
+    assert c.hidden_size == 8192
+    assert c.head_dim == 128
+
+
+def test_from_hf_dict_roundtrip(tmp_path):
+    d = {
+        "vocab_size": 1000,
+        "hidden_size": 64,
+        "intermediate_size": 256,
+        "num_hidden_layers": 2,
+        "num_attention_heads": 4,
+        "num_key_value_heads": 2,
+        "rms_norm_eps": 1e-6,
+        "rope_theta": 10000.0,
+        "bos_token_id": 1,
+        "eos_token_id": 2,
+        "torch_dtype": "float16",
+        "model_type": "llama",
+        "unknown_hf_key": 123,
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(d))
+    c = LlamaConfig.from_hf_json(p)
+    assert c.vocab_size == 1000
+    assert c.num_key_value_heads == 2
+    assert c.rope_theta == 10000.0
+    assert c.dtype == "bfloat16"  # f16 maps to bf16 on TPU
+
+
+def test_eos_ids_normalization():
+    assert LlamaConfig(eos_token_id=None).eos_ids() == ()
+    assert LlamaConfig(eos_token_id=5).eos_ids() == (5,)
+    assert LlamaConfig(eos_token_id=[5, 6]).eos_ids() == (5, 6)
+
+
+def test_tiny_is_valid():
+    c = tiny()
+    assert c.hidden_size % c.num_attention_heads == 0
+    assert c.num_attention_heads % c.num_key_value_heads == 0

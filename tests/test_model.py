@@ -1,0 +1,118 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.kvcache import init_cache
+from cake_tpu.ops.rope import rope_tables
+
+
+def _full_logits(config, params, tokens):
+    """Forward the whole sequence at once (fresh cache), logits at last pos."""
+    cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+    logits, _ = llama.forward(params, tokens, cache, 0, config)
+    return logits
+
+
+def test_prefill_then_decode_matches_full_forward(tiny_config, tiny_params):
+    """KV-cache correctness: incremental decode must equal full-context
+    forward. This is the core invariant the reference never tests
+    (SURVEY.md §4)."""
+    cfg, params = tiny_config, tiny_params
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, cfg.vocab_size, size=10).tolist()
+
+    # Incremental: prefill 6 tokens, then decode 4 one at a time.
+    cache = init_cache(cfg, batch=1, max_seq=cfg.max_seq_len)
+    logits, cache = llama.forward(
+        params, jnp.asarray([ids[:6]], jnp.int32), cache, 0, cfg
+    )
+    for i in range(6, 10):
+        logits, cache = llama.forward(
+            params, jnp.asarray([[ids[i]]], jnp.int32), cache, i, cfg
+        )
+
+    full = _full_logits(cfg, params, jnp.asarray([ids + []], jnp.int32))
+    # logits after feeding ids[9] at pos 9 == full-forward last-position logits
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_scan_matches_python_loop(tiny_config, tiny_params):
+    """lax.scan over stacked layers == explicit per-layer loop."""
+    cfg, params = tiny_config, tiny_params
+    x = jax.random.normal(
+        jax.random.PRNGKey(5), (1, 7, cfg.hidden_size), jnp.float32
+    )
+    cache = init_cache(cfg, batch=1, max_seq=cfg.max_seq_len)
+    cos, sin = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    scanned, _ = llama.forward_layers(params["layers"], x, cache, cos, sin, 0, cfg)
+
+    h = x
+    for i in range(cfg.num_hidden_layers):
+        layer_i = jax.tree.map(lambda a: a[i], params["layers"])
+        h, _, _ = llama.block_forward(
+            layer_i, h, cache.k[i], cache.v[i], cos, sin, 0, cfg
+        )
+    np.testing.assert_allclose(np.asarray(scanned), np.asarray(h), rtol=1e-5, atol=1e-5)
+
+
+def test_causal_mask_future_independence(tiny_config, tiny_params):
+    """Changing a future token must not change logits at an earlier position
+    of the same full-sequence forward (true causality, not just finiteness)."""
+    from cake_tpu.runtime.generator import prefill_fn
+
+    cfg, params = tiny_config, tiny_params
+    ids_a = [3, 5, 7, 9, 11]
+    ids_b = [3, 5, 7, 9, 200]  # same prefix, different final token
+
+    def logits_at(ids, index):
+        cache = init_cache(cfg, batch=1, max_seq=cfg.max_seq_len)
+        logits, _ = prefill_fn(
+            params,
+            jnp.asarray([ids], jnp.int32),
+            cache,
+            jnp.asarray([index], jnp.int32),
+            cfg,
+        )
+        return np.asarray(logits)
+
+    # At position 3 (before the differing token) logits must be identical.
+    np.testing.assert_array_equal(logits_at(ids_a, 3), logits_at(ids_b, 3))
+    # At the final position they must differ.
+    assert not np.allclose(logits_at(ids_a, 4), logits_at(ids_b, 4))
+
+
+def test_forward_layers_subset_composes(tiny_config, tiny_params):
+    """Running layers [0,2) then [2,4) equals running [0,4) — the invariant
+    behind topology layer-sharding (worker executes its range only,
+    worker.rs:208-219)."""
+    cfg, params = tiny_config, tiny_params
+    x = jax.random.normal(jax.random.PRNGKey(2), (1, 5, cfg.hidden_size))
+    cos, sin = rope_tables(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+    cache = init_cache(cfg, batch=1, max_seq=cfg.max_seq_len)
+
+    full, _ = llama.forward_layers(params["layers"], x, cache, cos, sin, 0, cfg)
+
+    first = jax.tree.map(lambda a: a[:2], params["layers"])
+    second = jax.tree.map(lambda a: a[2:], params["layers"])
+    from cake_tpu.ops.kvcache import KVCache
+
+    c1 = KVCache(k=cache.k[:2], v=cache.v[:2])
+    c2 = KVCache(k=cache.k[2:], v=cache.v[2:])
+    h, _ = llama.forward_layers(first, x, c1, cos, sin, 0, cfg)
+    h, _ = llama.forward_layers(second, h, c2, cos, sin, 0, cfg)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(h), rtol=1e-5, atol=1e-5)
+
+
+def test_logits_are_f32(tiny_config, tiny_params):
+    cfg, params = tiny_config, tiny_params
+    cache = init_cache(cfg, batch=1, max_seq=cfg.max_seq_len)
+    logits, _ = llama.forward(
+        params, jnp.asarray([[1, 2, 3]], jnp.int32), cache, 0, cfg
+    )
+    assert logits.dtype == jnp.float32
+    assert logits.shape == (1, cfg.vocab_size)

@@ -1,0 +1,49 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.utils.weights import (
+    load_llama_params,
+    save_llama_params,
+    load_safetensors_index,
+)
+
+
+def test_safetensors_roundtrip(tmp_path):
+    cfg = tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    save_llama_params(params, tmp_path)
+    loaded = load_llama_params(tmp_path, cfg.num_hidden_layers, dtype="float32")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=0),
+        params,
+        loaded,
+    )
+
+
+def test_layer_range_loads_slice(tmp_path):
+    cfg = tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    save_llama_params(params, tmp_path)
+    part = load_llama_params(
+        tmp_path, cfg.num_hidden_layers, dtype="float32",
+        layer_range=(1, 3), include_embed=False, include_head=False,
+    )
+    assert "embed" not in part and "lm_head" not in part
+    assert part["layers"]["wq"].shape[0] == 2
+    np.testing.assert_allclose(
+        np.asarray(part["layers"]["wq"]),
+        np.asarray(params["layers"]["wq"][1:3]),
+        atol=0,
+    )
+
+
+def test_index_resolution(tmp_path):
+    cfg = tiny(num_hidden_layers=2)
+    params = llama.init_params(cfg, jax.random.PRNGKey(2), dtype=jnp.float32)
+    save_llama_params(params, tmp_path)
+    index = load_safetensors_index(tmp_path)
+    assert "model.embed_tokens.weight" in index
+    assert "model.layers.1.mlp.down_proj.weight" in index
