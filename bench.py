@@ -1,0 +1,158 @@
+"""Benchmark: fused single-chip Llama-3-8B decode throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference publishes no numbers (BASELINE.md: its deployment of record is
+Llama-3-8B layer-split across a Titan X Pascal + M1 Max over an ngrok tunnel,
+tokens/sec measured at runtime but never published — master.rs:57-65). With
+no published baseline to divide by, ``vs_baseline`` reports the fraction of
+the *HBM-bandwidth roofline* for this chip and model (ideal decode tok/s =
+HBM bytes/s / model bytes; the closer to 1.0 the better). That makes the
+number comparable across rounds and meaningful in absolute terms.
+
+Knobs (env):
+  CAKE_BENCH_PRESET  8b (default) | small | tiny  — model size
+  CAKE_BENCH_STEPS   timed decode steps (default 64)
+  CAKE_BENCH_SEQ     KV capacity (default 512)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# chip kind -> approx HBM GB/s (public specs)
+_HBM_GBPS = {
+    "v5 lite": 819.0,  # v5e: 16 GiB @ 819 GB/s
+    "v5e": 819.0,
+    "v4": 1228.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+    "cpu": 50.0,
+}
+
+
+def _hbm_gbps(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in _HBM_GBPS.items():
+        if k in kind:
+            return v
+    return 819.0
+
+
+def _config(preset: str):
+    from cake_tpu.models.config import LlamaConfig, llama3_8b, tiny
+
+    seq = int(os.environ.get("CAKE_BENCH_SEQ", "512"))
+    if preset == "8b":
+        return llama3_8b(max_seq_len=seq)
+    if preset == "small":
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=16, num_attention_heads=32,
+            num_key_value_heads=8, max_seq_len=seq,
+        )
+    return tiny(max_seq_len=min(seq, 128), dtype="bfloat16")
+
+
+def _param_bytes(params) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
+
+
+def main() -> int:
+    preset = os.environ.get("CAKE_BENCH_PRESET", "8b")
+    if preset not in ("8b", "small", "tiny"):
+        sys.stderr.write(f"unknown CAKE_BENCH_PRESET={preset!r}, using tiny\n")
+        preset = "tiny"
+    steps = int(os.environ.get("CAKE_BENCH_STEPS", "64"))
+
+    from cake_tpu.models.llama import init_params
+    from cake_tpu.ops.kvcache import init_cache
+    from cake_tpu.ops.sampling import SamplerSettings, init_history
+    from cake_tpu.runtime.generator import decode_step_fn, prefill_fn
+
+    dev = jax.devices()[0]
+    key = jax.random.PRNGKey(0)
+
+    # OOM fallback ladder: if the requested preset does not fit this chip's
+    # HBM, step down and say so (blocked inside the try so async allocation
+    # failures are actually caught here, not at first use).
+    ladder = ["8b", "small", "tiny"]
+    params = config = None
+    for p in ladder[ladder.index(preset):]:
+        cfg = _config(p)
+        try:
+            candidate = init_params(cfg, key)
+            candidate = jax.tree.map(lambda x: x.block_until_ready(), candidate)
+            params, config, preset = candidate, cfg, p
+            break
+        except Exception as e:
+            sys.stderr.write(f"init at preset={p} failed ({e}); stepping down\n")
+            candidate = None
+    if params is None:
+        sys.stderr.write("no preset fits this device\n")
+        return 1
+
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+    history, hist_slot = init_history(settings.repeat_last_n)
+
+    decode = jax.jit(
+        partial(decode_step_fn, config=config, settings=settings),
+        donate_argnames=("cache",),
+    )
+
+    # prefill a short prompt so decode runs from a warm cache
+    prompt = jnp.asarray([[1, 5, 9, 14, 3, 8, 2, 4]], jnp.int32)
+    prefill = jax.jit(partial(prefill_fn, config=config), donate_argnames=("cache",))
+    t_pf0 = time.perf_counter()
+    logits, cache = prefill(params, prompt, cache, jnp.asarray([7], jnp.int32))
+    logits.block_until_ready()
+    ttft_s = time.perf_counter() - t_pf0  # includes compile (cold TTFT)
+
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:1]
+    pos = 8
+
+    # warm-up (compile + 2 steps)
+    for _ in range(3):
+        tok, cache, history, hist_slot = decode(
+            params, tok, cache, jnp.int32(pos), key, history, hist_slot
+        )
+        tok = tok.reshape(1)
+        pos += 1
+    tok.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        tok, cache, history, hist_slot = decode(
+            params, tok.reshape(1), cache, jnp.int32(pos), key, history, hist_slot
+        )
+        pos += 1
+    tok.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    toks_per_s = steps / dt
+    model_gb = _param_bytes(params) / 1e9
+    roofline = _hbm_gbps(dev) / model_gb  # ideal decode tok/s (weights-bound)
+
+    print(json.dumps({
+        "metric": f"decode_tokens_per_sec_llama_{preset}_bf16_1chip",
+        "value": round(toks_per_s, 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(toks_per_s / roofline, 4),
+    }))
+    sys.stderr.write(
+        f"device={dev.device_kind} params={model_gb:.2f}GB "
+        f"roofline={roofline:.1f}tok/s ttft_cold={ttft_s:.2f}s steps={steps}\n"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,17 +89,28 @@ def block_forward(
     sin: jax.Array,
     pos,
     config: LlamaConfig,
+    num_heads: int | None = None,
+    num_kv_heads: int | None = None,
+    tp_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """One pre-norm decoder block (transformer.rs:48-64)."""
+    """One pre-norm decoder block (transformer.rs:48-64).
+
+    Under tensor parallelism (inside shard_map), ``num_heads``/``num_kv_heads``
+    are the per-device local counts and ``tp_axis`` names the mesh axis the
+    row-parallel projections reduce over; the norm weights are replicated.
+    """
     h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     attn_out, k_cache, v_cache = self_attention_block(
         h, layer["wq"], layer["wk"], layer["wv"], layer["wo"],
         k_cache, v_cache, cos, sin, pos,
-        config.num_attention_heads, config.num_key_value_heads,
+        num_heads or config.num_attention_heads,
+        num_kv_heads or config.num_key_value_heads,
+        tp_axis=tp_axis,
     )
     x = x + attn_out
     h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"])
+    x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"],
+                   tp_axis=tp_axis)
     return x, k_cache, v_cache
 
 
@@ -111,6 +122,9 @@ def forward_layers(
     sin: jax.Array,
     pos,
     config: LlamaConfig,
+    num_heads: int | None = None,
+    num_kv_heads: int | None = None,
+    tp_axis: str | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run a contiguous run of decoder blocks via ``lax.scan``.
 
@@ -122,7 +136,9 @@ def forward_layers(
     def body(carry, per_layer):
         h = carry
         layer, kc, vc = per_layer
-        h, kc, vc = block_forward(layer, h, kc, vc, cos, sin, pos, config)
+        h, kc, vc = block_forward(layer, h, kc, vc, cos, sin, pos, config,
+                                  num_heads=num_heads, num_kv_heads=num_kv_heads,
+                                  tp_axis=tp_axis)
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))

@@ -79,11 +79,17 @@ def self_attention_block(
     pos,
     num_heads: int,
     num_kv_heads: int,
+    tp_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One attention sublayer incl. cache update.
 
     Returns ``(attn_out [B,T,hidden], new_k_cache, new_v_cache)``.
     Mirrors `attention.rs:30-90` + `cache.process_kv` (:57).
+
+    ``tp_axis``: when run inside shard_map with heads sharded over a tensor-
+    parallel mesh axis (Megatron-style: column-parallel qkv, row-parallel
+    o_proj), pass the axis name — the o_proj partial sums are psum-reduced
+    over it. ``num_heads``/``num_kv_heads`` are then the *local* counts.
     """
     b, t, hidden = x.shape
     d = wq.shape[1] // num_heads
@@ -99,4 +105,7 @@ def self_attention_block(
 
     out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
-    return out @ wo, k_cache, v_cache
+    out = out @ wo
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out, k_cache, v_cache

@@ -12,5 +12,17 @@ import jax
 import jax.numpy as jnp
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
-    return (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+def swiglu(
+    x: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """``tp_axis``: inside shard_map with the intermediate dim sharded over a
+    tensor-parallel axis (column-parallel gate/up, row-parallel down), the
+    down-proj partial sums are psum-reduced over that axis."""
+    out = (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out

@@ -95,11 +95,33 @@ def sample_token(
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def sample_tokens(
+    logits: jax.Array,  # [B, vocab] f32
+    key: jax.Array,
+    history: jax.Array,  # [B, repeat_last_n] int32
+    settings: SamplerSettings,
+) -> jax.Array:
+    """Batched :func:`sample_token` -> [B] int32 (vmapped, per-row keys)."""
+    keys = jax.random.split(key, logits.shape[0])
+    return jax.vmap(lambda l, k, h: sample_token(l, k, h, settings))(
+        logits, keys, history
+    )
+
+
 def push_history(history: jax.Array, slot: jax.Array, token: jax.Array):
     """Write ``token`` into the ring buffer at ``slot % len`` and bump slot."""
     n = history.shape[0]
     idx = jnp.mod(slot, n)
     return history.at[idx].set(token), slot + 1
+
+
+def push_history_batched(history: jax.Array, slot: jax.Array, tokens: jax.Array):
+    """Batched ring-buffer write: ``history [B, N]``, ``tokens [B]``, shared
+    scalar ``slot``. Single source of the ring semantics for the sharded
+    decode path."""
+    n = history.shape[1]
+    idx = jnp.mod(slot, n)
+    return history.at[:, idx].set(tokens), slot + 1
 
 
 def init_history(repeat_last_n: int) -> tuple[jax.Array, jax.Array]:

@@ -1,0 +1,173 @@
+"""Device mesh construction and parameter sharding specs.
+
+The TPU-native replacement for the reference's distribution plane: instead of
+one TCP worker per host with activations serialized over sockets
+(`cake-core/src/cake/{client,worker,proto}`), the devices form a
+`jax.sharding.Mesh` with axes
+
+- ``stage`` — pipeline stages: the stacked layer axis shards here, the
+  equivalent of the reference topology's contiguous ``model.layers.N-M``
+  ranges per worker (topology.rs:46-69); activations move stage-to-stage by
+  ICI ``ppermute`` inside one compiled program.
+- ``tp`` — tensor parallelism (Megatron-style): attention heads and MLP
+  intermediate shard here; row-parallel projections psum over it. The
+  reference has no tensor parallelism (SURVEY.md §2 "not present") — on TPU
+  it is the main single-token latency lever, so it is first-class.
+- ``dp`` — data/batch parallelism for multi-stream serving (also absent in
+  the single-request reference).
+
+All collectives ride ICI when the mesh maps onto one slice; DCN only across
+slices (mesh construction keeps axis order ``(dp, stage, tp)`` so ``tp`` —
+the chattiest axis — lands on the innermost, fastest rings).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cake_tpu.models.config import LlamaConfig
+
+DP, STAGE, TP = "dp", "stage", "tp"
+
+
+def make_mesh(
+    num_stages: int = 1,
+    tp: int = 1,
+    dp: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a ``(dp, stage, tp)`` mesh from the flat device list."""
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_stages * tp * dp
+    if len(devices) < need:
+        raise ValueError(
+            f"need {need} devices for dp={dp} x stage={num_stages} x tp={tp}, "
+            f"have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(dp, num_stages, tp)
+    return Mesh(grid, (DP, STAGE, TP))
+
+
+def validate_shardable(config: LlamaConfig, num_stages: int, tp: int) -> None:
+    """Divisibility requirements for the (stage, tp) sharding."""
+    if config.num_hidden_layers % num_stages:
+        raise ValueError(
+            f"num_hidden_layers {config.num_hidden_layers} not divisible by "
+            f"stage count {num_stages}"
+        )
+    for name, dim in [
+        ("num_attention_heads", config.num_attention_heads),
+        ("num_key_value_heads", config.num_key_value_heads),
+        ("intermediate_size", config.intermediate_size),
+        ("vocab_size", config.vocab_size),
+    ]:
+        if dim % tp:
+            raise ValueError(f"{name} {dim} not divisible by tp {tp}")
+
+
+def param_specs() -> dict:
+    """PartitionSpec pytree matching the params layout (models/llama.py):
+    layer axis -> stage; head/intermediate out-features -> tp (column-
+    parallel); wo/w_down in-features -> tp (row-parallel); norms and embed
+    replicated; lm_head vocab -> tp."""
+    return {
+        "embed": P(None, None),
+        "layers": {
+            "attn_norm": P(STAGE, None),
+            "wq": P(STAGE, None, TP),
+            "wk": P(STAGE, None, TP),
+            "wv": P(STAGE, None, TP),
+            "wo": P(STAGE, TP, None),
+            "mlp_norm": P(STAGE, None),
+            "w_gate": P(STAGE, None, TP),
+            "w_up": P(STAGE, None, TP),
+            "w_down": P(STAGE, TP, None),
+        },
+        "norm_f": P(None),
+        "lm_head": P(None, TP),
+    }
+
+
+# KV cache [L, B, kv_heads, max_seq, head_dim]: layers over stage, batch over
+# dp, kv heads over tp — KV memory splits across both mesh axes.
+CACHE_SPEC = P(STAGE, DP, TP, None, None)
+
+
+def shard_params(params: dict, mesh: Mesh) -> dict:
+    """Place a (host or single-device) params pytree onto the mesh."""
+    specs = param_specs()
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def shard_cache(cache, mesh: Mesh):
+    from cake_tpu.ops.kvcache import KVCache
+
+    return KVCache(
+        k=jax.device_put(cache.k, NamedSharding(mesh, CACHE_SPEC)),
+        v=jax.device_put(cache.v, NamedSharding(mesh, CACHE_SPEC)),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Resolved parallel layout for a model on a mesh."""
+
+    mesh: Mesh
+    num_stages: int
+    tp: int
+    dp: int
+
+    @classmethod
+    def build(cls, config: LlamaConfig, num_stages: int = 1, tp: int = 1,
+              dp: int = 1, devices=None) -> "MeshPlan":
+        validate_shardable(config, num_stages, tp)
+        return cls(mesh=make_mesh(num_stages, tp, dp, devices),
+                   num_stages=num_stages, tp=tp, dp=dp)
+
+    @classmethod
+    def from_topology(cls, config: LlamaConfig, topology, tp: int = 1,
+                      dp: int = 1, devices=None) -> "MeshPlan":
+        """Derive the stage layout from a topology whose nodes carry mesh
+        ``device`` indices.
+
+        The single-program mesh pipeline shards the stacked layer axis
+        *uniformly*, so the topology's ranges must be exactly that uniform
+        split, in device order. Arbitrary/uneven layer ranges (which the
+        reference allows, topology.rs:46-69) are served by the master/worker
+        runtime instead; here they raise so a user's explicit placement is
+        never silently replaced.
+        """
+        staged = sorted(
+            (n for n in topology if n.device is not None),
+            key=lambda n: n.device,
+        )
+        num_stages = max(1, len(staged))
+        if staged:
+            if [n.device for n in staged] != list(range(num_stages)):
+                raise ValueError(
+                    "topology device indices must be 0..S-1 with no gaps; got "
+                    f"{[n.device for n in staged]}"
+                )
+            L = config.num_hidden_layers
+            if L % num_stages:
+                raise ValueError(
+                    f"{L} layers not divisible into {num_stages} stages"
+                )
+            per = L // num_stages
+            for s, node in enumerate(staged):
+                want = list(range(s * per, (s + 1) * per))
+                if node.layer_indices() != want:
+                    raise ValueError(
+                        f"mesh pipeline requires the uniform layer split: node "
+                        f"'{node.name}' (device {s}) must own layers "
+                        f"{want[0]}-{want[-1]}, got {node.layer_indices()}; "
+                        "use the master/worker runtime for uneven ranges"
+                    )
+        return cls.build(config, num_stages=num_stages, tp=tp, dp=dp,
+                         devices=devices)

@@ -1,0 +1,191 @@
+"""Single-program pipeline + tensor-parallel execution over a device mesh.
+
+This replaces the reference's entire distributed hot path. There, the master
+walks decoder blocks per token and ships activations to workers over TCP with
+length-prefixed bitcode frames (`llama.rs:88-119`, `client.rs:101-126`,
+`worker.rs:180-224`) — one socket round-trip per contiguous layer group per
+token. Here the *whole* per-token step (embed -> all pipeline stages -> norm
+-> lm_head -> sample) is ONE compiled XLA program over the mesh:
+
+- the stacked layer axis is sharded over the ``stage`` mesh axis (the
+  equivalent of topology layer ranges, topology.rs:46-69);
+- activations travel stage-to-stage by ``lax.ppermute`` — compiler-scheduled
+  ICI DMA, the TPU-native replacement for `RawTensor` TCP serialization
+  (proto/message.rs:11-34), which disappears entirely on-pod;
+- within each stage, attention heads and the MLP intermediate dim shard over
+  the ``tp`` axis (Megatron column/row parallelism, psum on the row-parallel
+  outputs) — parallelism the reference does not have (SURVEY.md §2);
+- the KV cache shards over (stage, dp, tp): each stage holds only its own
+  layers' cache, like the reference workers (worker.rs:52-61), and each tp
+  shard holds only its heads.
+
+Pipeline schedule: single-stream autoregressive decode is inherently
+sequential across layers, so the loop runs stages in turn (`lax.fori_loop`
+over S steps; stage s computes only at step s via `lax.cond`, everyone else
+passes through — matching the reference's "upstream workers idle while
+downstream compute" semantics, SURVEY.md §2) with a ppermute between steps.
+After S steps the fully-processed activation has returned to stage 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.models import llama
+from cake_tpu.ops import sampling
+from cake_tpu.ops.kvcache import KVCache
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.rope import rope_tables
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import CACHE_SPEC, DP, STAGE, TP, MeshPlan, param_specs
+
+
+def _local_counts(config: LlamaConfig, tp: int) -> tuple[int, int]:
+    return config.num_attention_heads // tp, config.num_key_value_heads // tp
+
+
+def _pipeline_layers(
+    x: jax.Array,  # [Bl, T, hidden] local activation
+    layers,  # local stacked layer weights [L/S, ...]
+    ck: jax.Array,  # local cache k [L/S, Bl, KVl, S, D]
+    cv: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    pos,
+    config: LlamaConfig,
+    num_stages: int,
+    heads_l: int,
+    kv_heads_l: int,
+):
+    """Run the staged pipeline loop. Returns (x_on_stage0, ck, cv)."""
+    my_stage = jax.lax.axis_index(STAGE)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+    def run(carry):
+        x, ck, cv = carry
+        h, new_cache = llama.forward_layers(
+            layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
+            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+        )
+        return h, new_cache.k, new_cache.v
+
+    def body(step, carry):
+        x, ck, cv = jax.lax.cond(
+            step == my_stage, run, lambda c: c, carry
+        )
+        x = jax.lax.ppermute(x, STAGE, perm)
+        return x, ck, cv
+
+    return jax.lax.fori_loop(0, num_stages, body, (x, ck, cv))
+
+
+def _select_stage0(x: jax.Array) -> jax.Array:
+    """Broadcast stage 0's value to all stages (the activation is only valid
+    where the pipeline completed)."""
+    my_stage = jax.lax.axis_index(STAGE)
+    return jax.lax.psum(jnp.where(my_stage == 0, x, jnp.zeros_like(x)), STAGE)
+
+
+def _head_logits(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
+    """ln_f + vocab-sharded lm_head; full logits gathered over tp."""
+    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
+    logits_local = (x_last @ params["lm_head"]).astype(jnp.float32)
+    return jax.lax.all_gather(logits_local, TP, axis=-1, tiled=True)
+
+
+def _dp_fold(key: jax.Array) -> jax.Array:
+    """Give each dp shard a distinct sampling key stream."""
+    return jax.random.fold_in(key, jax.lax.axis_index(DP))
+
+
+def build_sharded_decode(
+    config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan
+):
+    """Compile the fused multi-chip decode step.
+
+    Signature: ``(params, token [B], cache, pos, key, history [B, N],
+    hist_slot) -> (next_token [B], cache, history, hist_slot)``.
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+
+    def step(params, token, cache, pos, key, history, hist_slot):
+        cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+        x = params["embed"][token[:, None]].astype(config.jax_dtype)
+        x, ck, cv = _pipeline_layers(
+            x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
+            plan.num_stages, heads_l, kv_heads_l,
+        )
+        x_last = _select_stage0(x[:, -1, :])
+        logits = _head_logits(params, x_last, config)
+        tok = sampling.sample_tokens(logits, _dp_fold(key), history, settings)
+        history, hist_slot = sampling.push_history_batched(history, hist_slot, tok)
+        return tok, KVCache(k=ck, v=cv), history, hist_slot
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(),
+            P(DP),
+            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            P(),
+            P(None),
+            P(DP, None),
+            P(),
+        ),
+        out_specs=(
+            P(DP),
+            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            P(DP, None),
+            P(),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
+def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan):
+    """Compile the multi-chip prompt pass.
+
+    Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
+    (logits [B, vocab] f32, cache)``. ``T`` may be any bucketed length.
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+
+    def step(params, tokens, cache, last_index):
+        cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+        x = params["embed"][tokens].astype(config.jax_dtype)
+        x, ck, cv = _pipeline_layers(
+            x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
+            plan.num_stages, heads_l, kv_heads_l,
+        )
+        # slice the wanted position first so the cross-stage select moves
+        # [B, hidden], not the whole [B, T, hidden] activation
+        x_last = jnp.take_along_axis(
+            x, last_index.reshape(-1, 1, 1).astype(jnp.int32), axis=1
+        )[:, 0, :]
+        x_last = _select_stage0(x_last)
+        logits = _head_logits(params, x_last, config)
+        return logits, KVCache(k=ck, v=cv)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(),
+            P(DP, None),
+            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            P(DP),
+        ),
+        out_specs=(
+            P(DP, None),
+            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))

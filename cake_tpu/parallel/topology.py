@@ -1,0 +1,160 @@
+"""Topology: the distribution config plane.
+
+Equivalent of `cake-core/src/cake/topology.rs`: a YAML map of worker-name ->
+``{host, description, layers}`` (topology.rs:13-21) where each layers entry is
+either a single layer name or a range ``model.layers.0-5`` expanded to
+individual names (regex ``^(.+[^\\d])(\\d+)-(\\d+)$``, topology.rs:8-10,46-69)
+with ``stop > start`` validated (topology.rs:54). Lookups:
+``get_node_for_layer`` (topology.rs:75-84) and prefix-match
+``is_layer_owner`` used by the weight splitter (topology.rs:25-32).
+
+TPU-native extension: a node may carry ``device: <int>`` assigning it to a
+mesh stage index instead of (or in addition to) a TCP host — the same YAML
+file then drives either the cross-host worker deployment (reference
+semantics) or a single-program ICI pipeline over a device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+import yaml
+
+_RANGE_RE = re.compile(r"^(.+[^\d])(\d+)-(\d+)$")
+
+
+def expand_layer_ranges(entries: list[str]) -> list[str]:
+    """Expand range entries to individual layer names (topology.rs:46-69)."""
+    out: list[str] = []
+    for entry in entries:
+        m = _RANGE_RE.match(entry)
+        if m:
+            prefix, start, stop = m.group(1), int(m.group(2)), int(m.group(3))
+            if stop <= start:
+                raise ValueError(
+                    f"invalid layer range '{entry}': stop must be > start"
+                )
+            out.extend(f"{prefix}{i}" for i in range(start, stop + 1))
+        else:
+            out.append(entry)
+    return out
+
+
+@dataclasses.dataclass
+class Node:
+    """One worker's assignment (topology.rs:13-32)."""
+
+    name: str
+    host: str = ""
+    description: str = ""
+    layers: list[str] = dataclasses.field(default_factory=list)
+    device: int | None = None  # TPU extension: mesh stage index
+
+    def is_layer_owner(self, full_name: str) -> bool:
+        """Prefix match used by the splitter (topology.rs:25-32): does this
+        node own the layer a tensor like
+        ``model.layers.3.self_attn.q_proj.weight`` belongs to?"""
+        return any(
+            full_name == l or full_name.startswith(l + ".") for l in self.layers
+        )
+
+    def layer_indices(self, prefix: str = "model.layers.") -> list[int]:
+        """Sorted numeric indices of this node's decoder layers."""
+        idx = []
+        for l in self.layers:
+            if l.startswith(prefix):
+                tail = l[len(prefix):]
+                if tail.isdigit():
+                    idx.append(int(tail))
+        return sorted(idx)
+
+
+class Topology:
+    """Ordered worker-name -> Node mapping with layer lookups."""
+
+    def __init__(self, nodes: dict[str, Node]):
+        self.nodes = nodes
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Topology":
+        nodes = {}
+        for name, spec in (d or {}).items():
+            spec = spec or {}
+            nodes[name] = Node(
+                name=name,
+                host=spec.get("host", ""),
+                description=spec.get("description", ""),
+                layers=expand_layer_ranges(list(spec.get("layers", []))),
+                device=spec.get("device"),
+            )
+        return cls(nodes)
+
+    @classmethod
+    def from_path(cls, path: str | Path) -> "Topology":
+        with open(path) as f:
+            return cls.from_dict(yaml.safe_load(f))
+
+    def to_dict(self) -> dict:
+        out = {}
+        for name, n in self.nodes.items():
+            spec: dict = {"host": n.host, "description": n.description,
+                          "layers": list(n.layers)}
+            if n.device is not None:
+                spec["device"] = n.device
+            out[name] = spec
+        return out
+
+    def save(self, path: str | Path) -> None:
+        Path(path).write_text(yaml.safe_dump(self.to_dict(), sort_keys=False))
+
+    def get_node_for_layer(self, layer_name: str) -> Node | None:
+        """First node listing ``layer_name`` (topology.rs:75-84)."""
+        for node in self.nodes.values():
+            if layer_name in node.layers:
+                return node
+        return None
+
+    # -- dict-like surface (topology.rs:87-98 Deref) ------------------------
+    def __getitem__(self, name: str) -> Node:
+        return self.nodes[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.nodes
+
+    def __iter__(self):
+        return iter(self.nodes.values())
+
+    def __len__(self) -> int:
+        return len(self.nodes)
+
+    # -- planning helpers (TPU build) ---------------------------------------
+    def segments(self, num_layers: int, prefix: str = "model.layers.") -> list["Segment"]:
+        """Partition ``0..num_layers`` into maximal contiguous runs with a
+        single owner each — the coalescing the reference does per decode step
+        (llama.rs:88-119: contiguous blocks with equal ``ident()`` batch into
+        one RPC), computed once here because the assignment is static."""
+        segs: list[Segment] = []
+        for i in range(num_layers):
+            owner = self.get_node_for_layer(f"{prefix}{i}")
+            owner_name = owner.name if owner else None
+            if segs and segs[-1].owner == owner_name and segs[-1].stop == i:
+                segs[-1] = dataclasses.replace(segs[-1], stop=i + 1)
+            else:
+                segs.append(Segment(start=i, stop=i + 1, owner=owner_name))
+        return segs
+
+
+@dataclasses.dataclass(frozen=True)
+class Segment:
+    """A maximal contiguous layer run ``[start, stop)`` owned by one node
+    (``owner None`` = local to the master)."""
+
+    start: int
+    stop: int
+    owner: str | None
+
+    @property
+    def length(self) -> int:
+        return self.stop - self.start

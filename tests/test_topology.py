@@ -1,0 +1,115 @@
+import pytest
+
+from cake_tpu.parallel.topology import Topology, expand_layer_ranges
+
+
+EXAMPLE = {
+    "worker-a": {
+        "host": "10.0.0.1:10128",
+        "description": "gpu box",
+        "layers": ["model.layers.0-19"],
+    },
+    "worker-b": {
+        "host": "10.0.0.2:10128",
+        "description": "laptop",
+        "layers": ["model.layers.20-31"],
+    },
+}
+
+
+def test_range_expansion():
+    out = expand_layer_ranges(["model.layers.0-2", "model.layers.7"])
+    assert out == [
+        "model.layers.0",
+        "model.layers.1",
+        "model.layers.2",
+        "model.layers.7",
+    ]
+
+
+def test_range_expansion_rejects_bad_range():
+    with pytest.raises(ValueError):
+        expand_layer_ranges(["model.layers.5-5"])
+    with pytest.raises(ValueError):
+        expand_layer_ranges(["model.layers.9-3"])
+
+
+def test_from_dict_and_lookup():
+    t = Topology.from_dict(EXAMPLE)
+    assert len(t) == 2
+    assert t.get_node_for_layer("model.layers.0").name == "worker-a"
+    assert t.get_node_for_layer("model.layers.20").name == "worker-b"
+    assert t.get_node_for_layer("model.layers.31").name == "worker-b"
+    assert t.get_node_for_layer("model.layers.32") is None
+    assert "worker-a" in t
+    assert t["worker-b"].host == "10.0.0.2:10128"
+
+
+def test_is_layer_owner_prefix_match():
+    t = Topology.from_dict(EXAMPLE)
+    a = t["worker-a"]
+    assert a.is_layer_owner("model.layers.3.self_attn.q_proj.weight")
+    assert not a.is_layer_owner("model.layers.20.mlp.up_proj.weight")
+    assert a.is_layer_owner("model.layers.19.mlp.up_proj.weight")
+    assert not t["worker-b"].is_layer_owner("model.layers.2.input_layernorm.weight")
+    assert not a.is_layer_owner("model.norm.weight")
+
+
+def test_is_layer_owner_no_false_string_prefix():
+    """A node owning exactly layer 1 must NOT own layer 19's tensors (string
+    prefix 'model.layers.1' of 'model.layers.19...' must not match)."""
+    t = Topology.from_dict({"w": {"layers": ["model.layers.1"]}})
+    n = t["w"]
+    assert n.is_layer_owner("model.layers.1.self_attn.q_proj.weight")
+    assert not n.is_layer_owner("model.layers.19.self_attn.q_proj.weight")
+    assert not n.is_layer_owner("model.layers.10.mlp.up_proj.weight")
+
+
+def test_layer_indices():
+    t = Topology.from_dict(EXAMPLE)
+    assert t["worker-b"].layer_indices() == list(range(20, 32))
+
+
+def test_yaml_roundtrip(tmp_path):
+    t = Topology.from_dict(EXAMPLE)
+    p = tmp_path / "topology.yml"
+    t.save(p)
+    t2 = Topology.from_path(p)
+    assert t2["worker-a"].layers == t["worker-a"].layers
+    assert t2["worker-b"].host == t["worker-b"].host
+
+
+def test_segments_coalesce_contiguous_runs():
+    t = Topology.from_dict(
+        {
+            "w1": {"layers": ["model.layers.0-3"]},
+            "w2": {"layers": ["model.layers.4-5"]},
+        }
+    )
+    segs = t.segments(num_layers=8)
+    assert [(s.start, s.stop, s.owner) for s in segs] == [
+        (0, 4, "w1"),
+        (4, 6, "w2"),
+        (6, 8, None),  # unassigned -> local to master
+    ]
+
+
+def test_segments_interleaved_owner():
+    t = Topology.from_dict(
+        {
+            "w1": {"layers": ["model.layers.0", "model.layers.2"]},
+        }
+    )
+    segs = t.segments(num_layers=3)
+    assert [(s.start, s.stop, s.owner) for s in segs] == [
+        (0, 1, "w1"),
+        (1, 2, None),
+        (2, 3, "w1"),
+    ]
+
+
+def test_device_extension():
+    t = Topology.from_dict(
+        {"stage0": {"device": 0, "layers": ["model.layers.0-1"]}}
+    )
+    assert t["stage0"].device == 0
