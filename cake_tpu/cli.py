@@ -1,0 +1,230 @@
+"""cake-tpu command line.
+
+Equivalent of the reference CLI (`cake-cli/src/main.rs` + the clap Args in
+`cake-core/src/lib.rs:15-64`): same flag surface and defaults — --model,
+--topology, --prompt, --seed (299792458), -n/--sample-len (100),
+--temperature (1.0), --top-p, --top-k, --repeat-penalty (1.1),
+--repeat-last-n (128), --dtype, --mode master|worker, --name, --address
+(127.0.0.1:10128). TPU additions: --max-seq (the reference hard-caps 4096),
+--stages/--tp for the on-pod mesh pipeline instead of TCP workers.
+
+Usage:
+  python -m cake_tpu.cli --model /path/to/llama --prompt "..."          # local
+  python -m cake_tpu.cli --mode worker --name w1 --model ... --topology t.yml
+  python -m cake_tpu.cli --model ... --topology t.yml --prompt "..."    # master
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+import time
+from pathlib import Path
+
+log = logging.getLogger("cake_tpu.cli")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cake-tpu",
+        description="TPU-native distributed single-stream LLM inference",
+    )
+    p.add_argument("--model", required=True,
+                   help="checkpoint directory (config.json + safetensors)")
+    p.add_argument("--mode", choices=["master", "worker"], default="master")
+    p.add_argument("--name", default=None, help="worker name in the topology")
+    p.add_argument("--address", default="127.0.0.1:10128",
+                   help="worker bind address")
+    p.add_argument("--topology", default=None, help="topology YAML path")
+    p.add_argument("--prompt", default="Why is the sky blue?")
+    p.add_argument("--prompt-ids", default=None, dest="prompt_ids",
+                   help="comma-separated token ids (bypasses the tokenizer)")
+    p.add_argument("--seed", type=int, default=299792458)
+    p.add_argument("-n", "--sample-len", type=int, default=100, dest="sample_len")
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-p", type=float, default=None, dest="top_p")
+    p.add_argument("--top-k", type=int, default=None, dest="top_k")
+    p.add_argument("--repeat-penalty", type=float, default=1.1,
+                   dest="repeat_penalty")
+    p.add_argument("--repeat-last-n", type=int, default=128,
+                   dest="repeat_last_n")
+    p.add_argument("--dtype", choices=["bf16", "f16", "f32"], default="bf16",
+                   help="f16 maps to bf16 on TPU")
+    p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
+    p.add_argument("--stages", type=int, default=1,
+                   help="on-pod pipeline stages (mesh, not TCP)")
+    p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
+    p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p
+
+
+_DTYPES = {"bf16": "bfloat16", "f16": "bfloat16", "f32": "float32"}
+
+
+def _load_config(args):
+    from cake_tpu.models.config import LlamaConfig
+
+    cfg_path = Path(args.model) / "config.json"
+    if not cfg_path.exists():
+        sys.exit(f"error: {cfg_path} not found")
+    overrides = {"dtype": _DTYPES[args.dtype]}
+    if args.max_seq:
+        overrides["max_seq_len"] = args.max_seq
+    return LlamaConfig.from_hf_json(cfg_path, **overrides)
+
+
+def _load_tokenizer(model_dir: str):
+    tok_path = Path(model_dir) / "tokenizer.json"
+    if tok_path.exists():
+        try:
+            from tokenizers import Tokenizer
+
+            return Tokenizer.from_file(str(tok_path))
+        except Exception as e:
+            log.warning("tokenizer load failed: %s", e)
+    return None
+
+
+def _settings(args):
+    from cake_tpu.ops.sampling import SamplerSettings
+
+    return SamplerSettings(
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        repeat_penalty=args.repeat_penalty,
+        repeat_last_n=args.repeat_last_n,
+        seed=args.seed,
+    )
+
+
+def run_worker(args) -> int:
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime.worker import Worker
+    from cake_tpu.utils.memory import memory_report
+    from cake_tpu.utils.weights import load_llama_params
+
+    if not args.name:
+        sys.exit("error: --mode worker requires --name")
+    if not args.topology:
+        sys.exit("error: --mode worker requires --topology")
+    config = _load_config(args)
+    topology = Topology.from_path(args.topology)
+
+    def loader(lo, hi):
+        return load_llama_params(
+            args.model, config.num_hidden_layers, dtype=config.dtype,
+            layer_range=(lo, hi), include_embed=False, include_head=False,
+        )["layers"]
+
+    worker = Worker(args.name, config, topology, loader,
+                    address=args.address, max_seq=args.max_seq)
+    log.info("worker ready (%s)", memory_report())
+    try:
+        worker.serve_forever()
+    except KeyboardInterrupt:
+        worker.shutdown()
+    return 0
+
+
+def run_master(args) -> int:
+    from cake_tpu.utils.memory import memory_report
+    from cake_tpu.utils.weights import load_llama_params
+
+    config = _load_config(args)
+    tokenizer = _load_tokenizer(args.model)
+    settings = _settings(args)
+
+    t0 = time.perf_counter()
+    if args.topology:
+        from cake_tpu.parallel.topology import Topology
+        from cake_tpu.runtime.master import DistributedGenerator, build_runners
+
+        topology = Topology.from_path(args.topology)
+        head = load_llama_params(
+            args.model, config.num_hidden_layers, dtype=config.dtype,
+            layer_range=(0, 0),
+        )
+
+        def loader(lo, hi):
+            return load_llama_params(
+                args.model, config.num_hidden_layers, dtype=config.dtype,
+                layer_range=(lo, hi), include_embed=False, include_head=False,
+            )["layers"]
+
+        runners = build_runners(config, topology, loader, max_seq=args.max_seq)
+        gen = DistributedGenerator(config, head, runners, tokenizer=tokenizer,
+                                   settings=settings, max_seq=args.max_seq)
+    else:
+        from cake_tpu.runtime.generator import LlamaGenerator
+
+        params = load_llama_params(args.model, config.num_hidden_layers,
+                                   dtype=config.dtype)
+        gen = LlamaGenerator(config, params, tokenizer=tokenizer,
+                             settings=settings, max_seq=args.max_seq)
+    log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
+             memory_report())
+
+    if args.prompt_ids:
+        gen.set_prompt([int(t) for t in args.prompt_ids.split(",")])
+    else:
+        if tokenizer is None:
+            sys.exit(
+                "error: no tokenizer.json in the model dir; pass --prompt-ids"
+            )
+        gen.set_prompt(args.prompt)
+        print(args.prompt, end="", flush=True)
+    t_gen0 = time.perf_counter()
+    n_tokens = 0
+    gen_error = None
+    for i in range(args.sample_len):
+        try:
+            tok = gen.next_token(i)
+        except Exception as e:
+            # end the run with a clean newline instead of a traceback
+            # (reference: cake-cli/main.rs:51-55)
+            gen_error = e
+            break
+        n_tokens += 1
+        if tok.text:
+            print(tok.text, end="", flush=True)
+        if i == 0:
+            t_warm = time.perf_counter()  # exclude warm-up (master.rs:37-40)
+        if tok.is_end_of_stream:
+            break
+    rest = gen.last()
+    if rest:
+        print(rest, end="")
+    print()
+    if n_tokens > 1:
+        dt = time.perf_counter() - t_warm
+        log.info("%d tokens, %.2f tok/s (excl. warm-up; TTFT %.2fs) — %s",
+                 n_tokens, (n_tokens - 1) / dt,
+                 t_warm - t_gen0, memory_report())
+    if hasattr(gen, "close"):
+        gen.close()
+    if gen_error is not None:
+        log.error("generation ended early: %s", gen_error)
+        return 1
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    if args.mode == "worker":
+        return run_worker(args)
+    return run_master(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""Location-transparent block execution: the `Forwarder` seam.
+
+Equivalent of the reference's central abstraction (`cake/mod.rs:116-159`):
+anything that can run decoder layer(s) forward — a local device or a remote
+worker — behind one interface, so the generation loop is placement-blind
+(llama.rs:88-119). Differences by design:
+
+- A runner owns a contiguous *segment* of layers, not a single layer: the
+  reference coalesces contiguous same-worker layers per step at runtime
+  (llama.rs:100-119) and still opens one TCP connection per layer
+  (llama.rs:179-184); here the static topology is planned into segments once
+  (topology.segments) and each remote segment holds exactly one connection.
+- The local path is a jitted `lax.scan` over the segment's stacked weights —
+  one XLA dispatch per segment per token, zero per-layer overhead.
+- Activations cross runners as numpy arrays (device transfers only at remote
+  boundaries, matching worker.rs:203,224 semantics).
+"""
+
+from __future__ import annotations
+
+import time
+from abc import ABC, abstractmethod
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops.kvcache import KVCache, init_cache
+
+
+class BlockRunner(ABC):
+    """One contiguous run of decoder blocks, local or remote."""
+
+    start: int
+    stop: int
+
+    @abstractmethod
+    def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
+        """Run blocks [start, stop) on ``x [B, T, hidden]`` at ``pos``."""
+
+    @abstractmethod
+    def ident(self) -> str:
+        """Placement identity ('local' or worker address), cake/mod.rs:156-158."""
+
+    def layer_names(self) -> list[str]:
+        return [f"model.layers.{i}" for i in range(self.start, self.stop)]
+
+    def reset(self) -> None:
+        """Fresh KV state for a new stream (cache.as_new, cache.rs:138-146)."""
+
+    def close(self) -> None:
+        pass
+
+
+class LocalRunner(BlockRunner):
+    """Jitted on-device execution of a stacked layer slice."""
+
+    def __init__(self, config: LlamaConfig, layers, start: int, stop: int,
+                 batch: int = 1, max_seq: int | None = None):
+        assert next(iter(layers.values())).shape[0] == stop - start
+        self.config = config
+        self.start, self.stop = start, stop
+        self.layers = layers
+        self.max_seq = max_seq or config.max_seq_len
+        self.batch = batch
+        self.cache = init_cache(config, batch=batch, max_seq=self.max_seq,
+                                num_layers=stop - start)
+        self._fn = jax.jit(
+            partial(llama.hidden_forward_layers, config=config),
+            donate_argnames=("cache",),
+        )
+
+    def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
+        h, self.cache = self._fn(
+            self.layers, jnp.asarray(x, self.config.jax_dtype), self.cache,
+            jnp.int32(pos),
+        )
+        return np.asarray(h)
+
+    def forward_jax(self, x: jax.Array, pos) -> jax.Array:
+        """Device-resident variant for all-local pipelines (no host copy)."""
+        h, self.cache = self._fn(self.layers, x, self.cache, jnp.int32(pos))
+        return h
+
+    def ident(self) -> str:
+        return "local"
+
+    def reset(self) -> None:
+        self.cache = self.cache.as_new()
+
+
+class RemoteRunner(BlockRunner):
+    """Proxy to a worker over the wire (the reference `Client`,
+    client.rs:14-135): handshake measures latency, forward ships one Batch
+    per call for the whole segment."""
+
+    def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000):
+        from cake_tpu.runtime import protocol, wire
+        from cake_tpu.runtime.protocol import MsgType
+
+        self._protocol, self._wire, self._MsgType = protocol, wire, MsgType
+        self.start, self.stop = start, stop
+        self._timeout_ms = timeout_ms
+        if ":" in host:
+            addr, port = host.rsplit(":", 1)
+        else:
+            addr, port = host, "10128"
+        self.addr = f"{addr}:{port}"
+        self._handshake()
+
+    def _handshake(self) -> None:
+        """Connect + Hello/WorkerInfo exchange, recording RTT latency and
+        verifying layer coverage (client.rs:41-47)."""
+        addr, port = self.addr.rsplit(":", 1)
+        t0 = time.perf_counter()
+        self.conn = self._wire.connect(addr, int(port),
+                                       timeout_ms=self._timeout_ms)
+        self.conn.send(self._MsgType.HELLO)
+        t, payload = self.conn.recv()
+        if t != self._MsgType.WORKER_INFO:
+            raise RuntimeError(f"handshake failed: got message type {t}")
+        self.info = self._protocol.WorkerInfo.from_bytes(payload)
+        self.info.latency_ms = (time.perf_counter() - t0) * 1000
+        missing = [n for n in self.layer_names() if n not in self.info.layers]
+        if missing:
+            raise RuntimeError(
+                f"worker {self.info.name}@{self.addr} does not serve {missing}"
+            )
+
+    def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
+        ops = [(name, pos) for name in self.layer_names()]
+        self.conn.send(self._MsgType.BATCH, self._protocol.encode_ops(x, ops))
+        t, payload = self.conn.recv()
+        if t == self._MsgType.ERROR:
+            raise RuntimeError(
+                f"worker {self.addr}: {self._protocol.decode_error(payload)}"
+            )
+        if t != self._MsgType.TENSOR:
+            raise RuntimeError(f"unexpected reply type {t}")
+        return self._protocol.decode_tensor(payload)
+
+    def ident(self) -> str:
+        return self.addr
+
+    def reset(self) -> None:
+        # Reference semantics: a fresh connection gets a fresh cache clone
+        # (worker.rs:52-61). Reconnecting is the reset.
+        self.close()
+        self._handshake()
+
+    def close(self) -> None:
+        try:
+            self.conn.send(self._MsgType.GOODBYE)
+        except Exception:
+            pass
+        self.conn.close()

@@ -95,39 +95,24 @@ def decode_step_fn(
     return next_tok, cache, history, hist_slot
 
 
-class LlamaGenerator:
-    """Single-stream generator over an all-local model. (The distributed,
-    topology-sharded equivalent is built on the same prefill/decode functions
-    with per-segment runners; see cake_tpu.parallel.)"""
+class GeneratorBase:
+    """Shared Generator-trait state machine (model/mod.rs:21-29,46-58):
+    prompt validation + per-stream reset, repeat-penalty history seeding,
+    token bookkeeping, EOS detection, streaming detok, counters. Subclasses
+    implement the model execution (`next_token`)."""
 
     def __init__(
         self,
         config: LlamaConfig,
-        params,
         tokenizer=None,
         settings: SamplerSettings | None = None,
         max_seq: int | None = None,
-        cache_dtype=None,
     ):
         self.config = config
-        self.params = params
         self.settings = settings or SamplerSettings()
         self.max_seq = max_seq or config.max_seq_len
-        self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
-                                dtype=cache_dtype)
-        self.stream = TokenOutputStream(tokenizer) if tokenizer is not None else None
         self.tokenizer = tokenizer
-
-        self._prefill = jax.jit(
-            partial(prefill_fn, config=config),
-            static_argnames=(),
-            donate_argnames=("cache",),
-        )
-        self._decode = jax.jit(
-            partial(decode_step_fn, config=config, settings=self.settings),
-            donate_argnames=("cache",),
-        )
-
+        self.stream = TokenOutputStream(tokenizer) if tokenizer is not None else None
         self._key = jax.random.PRNGKey(self.settings.seed)
         self._history, self._hist_slot = sampling.init_history(
             self.settings.repeat_last_n
@@ -183,14 +168,82 @@ class LlamaGenerator:
                 jnp.asarray(tail, jnp.int32)
             )
             self._hist_slot = jnp.int32(len(tail))
+        self._on_new_prompt()
 
-    # -- Generator trait surface -------------------------------------------
+    def _on_new_prompt(self) -> None:
+        """Hook for subclasses (e.g. reset remote runner caches)."""
+
+    # -- shared bookkeeping --------------------------------------------------
+    def _require_prompt(self) -> None:
+        if not self._prompt_tokens:
+            raise RuntimeError("set_prompt first")
+
+    def _check_capacity(self) -> None:
+        if self._pos >= self.max_seq:
+            raise RuntimeError(
+                f"KV cache exhausted: position {self._pos} >= max_seq "
+                f"{self.max_seq} (raise max_seq or shorten the stream)"
+            )
+
+    def _finish_token(self, tok_id: int) -> Token:
+        self._last_token = tok_id
+        self._generated.append(tok_id)
+        is_eos = tok_id in self._eos_ids
+        text = self.stream.next_token(tok_id) if self.stream else None
+        return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
+
+    # -- Generator trait surface --------------------------------------------
+    def next_token(self, index: int) -> Token:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def last(self) -> str | None:
+        """Flush residual detokenizer text (model/mod.rs `last`,
+        llama.rs via token_output_stream.rs:55-69)."""
+        return self.stream.decode_rest() if self.stream else None
+
+    def generated_tokens(self) -> int:
+        return len(self._generated)
+
+    @property
+    def generated_ids(self) -> list[int]:
+        return list(self._generated)
+
+    def close(self) -> None:
+        pass
+
+
+class LlamaGenerator(GeneratorBase):
+    """Single-stream generator over an all-local model. (The distributed,
+    topology-sharded equivalent — runtime.master.DistributedGenerator —
+    shares this base and swaps the execution path for a runner walk.)"""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        cache_dtype=None,
+    ):
+        super().__init__(config, tokenizer, settings, max_seq)
+        self.params = params
+        self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
+                                dtype=cache_dtype)
+        self._prefill = jax.jit(
+            partial(prefill_fn, config=config),
+            donate_argnames=("cache",),
+        )
+        self._decode = jax.jit(
+            partial(decode_step_fn, config=config, settings=self.settings),
+            donate_argnames=("cache",),
+        )
+
     def next_token(self, index: int) -> Token:
         """index 0: prefill the whole prompt; index>0: one-token decode
         (context windowing per llama.rs:228-232)."""
         if index == 0:
-            if not self._prompt_tokens:
-                raise RuntimeError("set_prompt first")
+            self._require_prompt()
             n = len(self._prompt_tokens)
             t_pad = _bucket(n, self.max_seq)
             padded = self._prompt_tokens + [0] * (t_pad - n)
@@ -206,13 +259,8 @@ class LlamaGenerator:
                 self._history, self._hist_slot, tok
             )
             self._pos = n
-            tok_id = int(tok)
         else:
-            if self._pos >= self.max_seq:
-                raise RuntimeError(
-                    f"KV cache exhausted: position {self._pos} >= max_seq "
-                    f"{self.max_seq} (raise max_seq or shorten the stream)"
-                )
+            self._check_capacity()
             step_key = jax.random.fold_in(self._key, index)
             tok, self.cache, self._history, self._hist_slot = self._decode(
                 self.params,
@@ -224,22 +272,4 @@ class LlamaGenerator:
                 self._hist_slot,
             )
             self._pos += 1
-            tok_id = int(tok)
-
-        self._last_token = tok_id
-        self._generated.append(tok_id)
-        is_eos = tok_id in self._eos_ids
-        text = self.stream.next_token(tok_id) if self.stream else None
-        return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
-
-    def last(self) -> str | None:
-        """Flush residual detokenizer text (model/mod.rs `last`,
-        llama.rs via token_output_stream.rs:55-69)."""
-        return self.stream.decode_rest() if self.stream else None
-
-    def generated_tokens(self) -> int:
-        return len(self._generated)
-
-    @property
-    def generated_ids(self) -> list[int]:
-        return list(self._generated)
+        return self._finish_token(int(tok))

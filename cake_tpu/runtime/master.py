@@ -1,0 +1,143 @@
+"""Master: distributed generation across topology-assigned runners.
+
+Equivalent of the reference master + distributed LLama model
+(`cake-core/src/cake/master.rs` + `model/llama.rs:61-219`): the master holds
+the embedding, final norm, lm_head, tokenizer and sampler (llama.rs:61-76),
+walks the decoder blocks in order with contiguous same-owner runs coalesced
+into one call (llama.rs:88-119), and streams tokens with a tokens/sec report
+that excludes the warm-up token (master.rs:36-65).
+
+The walk is planned *statically* from the topology into segments
+(topology.segments) — local segments run as one jitted scan on this host's
+device, remote segments as one wire round-trip to their worker
+(parallel/runner.py). This is the cross-host runtime; the on-pod equivalent
+(whole pipeline in one compiled program over a mesh) is parallel/pipeline.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops import sampling
+from cake_tpu.ops.norms import rms_norm
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.runner import BlockRunner, LocalRunner, RemoteRunner
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime.generator import GeneratorBase, Token, _bucket
+
+log = logging.getLogger("cake_tpu.master")
+
+
+def build_runners(
+    config: LlamaConfig,
+    topology: Topology,
+    local_params_loader,  # callable (start, stop) -> stacked layers pytree
+    max_seq: int | None = None,
+) -> list[BlockRunner]:
+    """Plan the block walk: one runner per contiguous same-owner segment.
+    Unassigned layers run locally on the master (llama.rs:177-193: topology
+    decides Client vs local Transformer per layer)."""
+    runners: list[BlockRunner] = []
+    for seg in topology.segments(config.num_hidden_layers):
+        if seg.owner is None:
+            runners.append(
+                LocalRunner(
+                    config, local_params_loader(seg.start, seg.stop),
+                    seg.start, seg.stop, max_seq=max_seq or config.max_seq_len,
+                )
+            )
+        else:
+            node = topology[seg.owner]
+            runner = RemoteRunner(node.host, seg.start, seg.stop)
+            log.info("connected: %s", runner.info)
+            runners.append(runner)
+    return runners
+
+
+class DistributedGenerator(GeneratorBase):
+    """Generator-trait surface over a runner plan (shares GeneratorBase with
+    the all-local runtime.generator.LlamaGenerator; only the execution path
+    differs: embed + runner walk + head here, one fused program there)."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        head_params: dict,  # embed, norm_f, lm_head
+        runners: list[BlockRunner],
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+    ):
+        super().__init__(config, tokenizer, settings, max_seq)
+        self.runners = runners
+        self.embed = head_params["embed"]
+        self.norm_f = head_params["norm_f"]
+        self.lm_head = head_params["lm_head"]
+        self._head_fn = jax.jit(self._head)
+        self._sample_fn = jax.jit(
+            partial(sampling.sample_token, settings=self.settings)
+        )
+        self._t_start: float | None = None
+
+    def _head(self, x_last: jax.Array) -> jax.Array:
+        h = rms_norm(x_last, self.norm_f, self.config.rms_norm_eps)
+        return (h @ self.lm_head).astype(jnp.float32)
+
+    def _on_new_prompt(self) -> None:
+        self._t_start = None
+        for r in self.runners:
+            r.reset()
+
+    # -- forward across runners --------------------------------------------
+    def _forward(self, tokens: list[int], pos: int, last_index: int) -> jax.Array:
+        x = np.asarray(
+            self.embed[jnp.asarray([tokens], jnp.int32)].astype(
+                self.config.jax_dtype
+            )
+        )
+        for runner in self.runners:
+            x = runner.forward(x, pos)
+        x_last = jnp.asarray(x[:, last_index, :])
+        return self._head_fn(x_last)[0]
+
+    # -- Generator trait ----------------------------------------------------
+    def next_token(self, index: int) -> Token:
+        if index == 0:
+            self._require_prompt()
+            n = len(self._prompt_tokens)
+            t_pad = _bucket(n, self.max_seq)
+            logits = self._forward(
+                self._prompt_tokens + [0] * (t_pad - n), 0, n - 1
+            )
+            self._pos = n
+        else:
+            self._check_capacity()
+            logits = self._forward([self._last_token], self._pos, 0)
+            self._pos += 1
+
+        step_key = jax.random.fold_in(self._key, index)
+        tok = self._sample_fn(logits, step_key, self._history)
+        self._history, self._hist_slot = sampling.push_history(
+            self._history, self._hist_slot, tok
+        )
+        if index == 0:
+            # tokens/sec excludes the warm-up token (master.rs:37-40)
+            self._t_start = time.perf_counter()
+        return self._finish_token(int(tok))
+
+    def tokens_per_sec(self) -> float | None:
+        """Decode throughput excluding the warm-up token (master.rs:57-65)."""
+        if self._t_start is None or len(self._generated) < 2:
+            return None
+        return (len(self._generated) - 1) / (time.perf_counter() - self._t_start)
+
+    def close(self) -> None:
+        for r in self.runners:
+            r.close()

@@ -1,0 +1,147 @@
+"""Message schema over the wire transport.
+
+Equivalent of the reference's `Message` enum + `RawTensor`
+(proto/message.rs:11-76): Hello / WorkerInfo / SingleOp / Batch / Tensor —
+plus an explicit Error message (the reference just drops the connection,
+worker.rs:180,256-258). The reference serializes with the Rust-specific
+``bitcode`` (chosen over gRPC for speed, message.rs:104-105); here the
+payloads are a fixed little-endian binary layout for tensors (schema below)
+and JSON for the small control structures — language-neutral, zero-copy on
+the tensor bytes, no codegen.
+
+Tensor payload layout (little-endian):
+  u8 dtype_code | u8 ndim | u32 dims[ndim] | raw bytes (C-order)
+
+On-pod activations never use this path (they ride ICI inside the compiled
+pipeline program); this is the cross-host control/data plane between the
+master CLI and TPU-VM workers, where the reference's TCP semantics survive.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import platform
+import struct
+from enum import IntEnum
+
+import numpy as np
+
+from cake_tpu import __version__
+
+
+class MsgType(IntEnum):
+    HELLO = 1
+    WORKER_INFO = 2
+    SINGLE_OP = 3
+    BATCH = 4
+    TENSOR = 5
+    ERROR = 6
+    GOODBYE = 7
+
+
+# dtype codes (u8). bf16 rides as raw uint16 payloads with its own code.
+_DTYPES: list[tuple[int, str]] = [
+    (0, "float32"),
+    (1, "bfloat16"),
+    (2, "float16"),
+    (3, "int32"),
+    (4, "int8"),
+    (5, "uint8"),
+    (6, "int64"),
+]
+_CODE_TO_NAME = {c: n for c, n in _DTYPES}
+_NAME_TO_CODE = {n: c for c, n in _DTYPES}
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def encode_tensor(x) -> bytes:
+    """numpy (or jax-convertible) array -> wire bytes."""
+    arr = np.asarray(x)
+    name = arr.dtype.name if arr.dtype.name in _NAME_TO_CODE else str(arr.dtype)
+    if name not in _NAME_TO_CODE:
+        raise ValueError(f"unsupported wire dtype {arr.dtype}")
+    header = struct.pack("<BB", _NAME_TO_CODE[name], arr.ndim)
+    dims = struct.pack(f"<{arr.ndim}I", *arr.shape)
+    return header + dims + np.ascontiguousarray(arr).tobytes()
+
+
+def decode_tensor(buf: bytes) -> np.ndarray:
+    code, ndim = struct.unpack_from("<BB", buf, 0)
+    if code not in _CODE_TO_NAME:
+        raise ValueError(f"unknown dtype code {code}")
+    dims = struct.unpack_from(f"<{ndim}I", buf, 2)
+    off = 2 + 4 * ndim
+    dt = _np_dtype(_CODE_TO_NAME[code])
+    expect = int(np.prod(dims)) * dt.itemsize if ndim else dt.itemsize
+    data = buf[off:]
+    if len(data) != expect:
+        raise ValueError(
+            f"tensor payload size {len(data)} != expected {expect} for "
+            f"shape {dims} {dt}"
+        )
+    return np.frombuffer(data, dtype=dt).reshape(dims)
+
+
+@dataclasses.dataclass
+class WorkerInfo:
+    """Capability/identity exchange (proto/message.rs:37-53): version, os,
+    arch, device kind, latency (filled by the client from the handshake RTT,
+    client.rs:41-47), dtype, plus the layers this worker serves."""
+
+    name: str
+    version: str = __version__
+    os: str = dataclasses.field(default_factory=platform.system)
+    arch: str = dataclasses.field(default_factory=platform.machine)
+    device: str = ""
+    dtype: str = ""
+    latency_ms: float = 0.0
+    layers: list[str] = dataclasses.field(default_factory=list)
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, buf: bytes) -> "WorkerInfo":
+        d = json.loads(buf.decode())
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    def __str__(self) -> str:
+        return (
+            f"{self.name}@{self.device or '?'} v{self.version} "
+            f"({self.os}/{self.arch}, {self.dtype}, "
+            f"latency {self.latency_ms:.1f}ms, {len(self.layers)} layers)"
+        )
+
+
+def encode_ops(x: np.ndarray, ops: list[tuple[str, int]]) -> bytes:
+    """Batch payload: JSON op list (layer_name, index_pos) + tensor.
+
+    The reference `Batch` carries ``Vec<(layer_name, index_pos, block_idx)>``
+    (message.rs:57-76); block_idx is recoverable from layer_name so the wire
+    format carries just (name, pos)."""
+    meta = json.dumps(ops).encode()
+    return struct.pack("<I", len(meta)) + meta + encode_tensor(x)
+
+
+def decode_ops(buf: bytes) -> tuple[np.ndarray, list[tuple[str, int]]]:
+    (mlen,) = struct.unpack_from("<I", buf, 0)
+    ops = [tuple(o) for o in json.loads(buf[4 : 4 + mlen].decode())]
+    x = decode_tensor(buf[4 + mlen :])
+    return x, ops
+
+
+def encode_error(msg: str) -> bytes:
+    return msg.encode()
+
+
+def decode_error(buf: bytes) -> str:
+    return buf.decode(errors="replace")

@@ -1,0 +1,255 @@
+"""Wire transport binding: C++ framed-socket library with Python fallback.
+
+The native library (`native/cake_wire.cc`) is the C++ equivalent of the
+reference's Rust proto plane (framing magic + length + payload + size cap,
+proto/mod.rs:4-7, message.rs:118-155) plus a CRC32 trailer. This module loads
+it via ctypes (auto-building with g++ on first use) and exposes blocking
+send/recv of ``(msg_type, payload bytes)`` frames. A pure-Python fallback
+implements the identical frame format so the two interoperate; the native
+path is the default, the fallback exists for environments without a
+toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+import zlib
+from pathlib import Path
+
+MAGIC = 0x7CA4E701
+MAX_PAYLOAD = 512 * 1024 * 1024
+_HEADER = struct.Struct("<IBI")  # magic, msg_type, payload_len
+
+_REPO_ROOT = Path(__file__).resolve().parents[2]
+_SRC = _REPO_ROOT / "native" / "cake_wire.cc"
+_SO = _REPO_ROOT / "native" / "libcakewire.so"
+_BUILD_LOCK = threading.Lock()
+
+_lib = None
+_lib_tried = False
+
+
+def _build_native() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", str(_SO), str(_SRC)],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def native_lib():
+    """Load (building if needed) the native wire library, or None."""
+    global _lib, _lib_tried
+    with _BUILD_LOCK:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        stale = _SO.exists() and _SRC.exists() and (
+            _SO.stat().st_mtime < _SRC.stat().st_mtime
+        )
+        if not _SO.exists() or stale:
+            # (re)build only when the source is present; a prebuilt .so
+            # shipped without sources is used as-is
+            if not _SRC.exists() or not _build_native():
+                return None
+        try:
+            lib = ctypes.CDLL(str(_SO))
+        except OSError:
+            return None
+        lib.cw_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+        lib.cw_connect.restype = ctypes.c_int
+        lib.cw_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
+        lib.cw_listen.restype = ctypes.c_int
+        lib.cw_accept.argtypes = [ctypes.c_int]
+        lib.cw_accept.restype = ctypes.c_int
+        lib.cw_local_port.argtypes = [ctypes.c_int]
+        lib.cw_local_port.restype = ctypes.c_int
+        lib.cw_close.argtypes = [ctypes.c_int]
+        lib.cw_send_msg.argtypes = [
+            ctypes.c_int, ctypes.c_uint8,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+        ]
+        lib.cw_send_msg.restype = ctypes.c_int
+        lib.cw_recv_msg.argtypes = [
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.cw_recv_msg.restype = ctypes.c_int
+        lib.cw_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return _lib
+
+
+class WireError(Exception):
+    pass
+
+
+class PeerClosed(WireError):
+    pass
+
+
+_ERRORS = {
+    -1: "io error",
+    -2: "peer closed",
+    -3: "resolve failed",
+    -4: "connect failed",
+    -5: "bind failed",
+    -6: "listen failed",
+    -7: "payload exceeds 512 MiB cap",
+    -8: "bad magic",
+    -9: "crc mismatch",
+    -10: "out of memory",
+}
+
+
+def _raise(code: int):
+    if code == -2:
+        raise PeerClosed(_ERRORS[-2])
+    raise WireError(_ERRORS.get(code, f"wire error {code}"))
+
+
+class Connection:
+    """One framed duplex connection (native fd or Python socket)."""
+
+    def __init__(self, fd: int | None = None, sock: socket.socket | None = None):
+        self._fd = fd
+        self._sock = sock
+        self._lib = native_lib() if fd is not None else None
+
+    @property
+    def is_native(self) -> bool:
+        return self._fd is not None
+
+    # -- send/recv ----------------------------------------------------------
+    def send(self, msg_type: int, payload: bytes = b"") -> None:
+        if len(payload) > MAX_PAYLOAD:
+            raise WireError(_ERRORS[-7])
+        if self._fd is not None:
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
+                if payload else None
+            rc = self._lib.cw_send_msg(self._fd, msg_type, buf, len(payload))
+            if rc < 0:
+                _raise(rc)
+        else:
+            crc = zlib.crc32(bytes([msg_type]))
+            crc = zlib.crc32(payload, crc)
+            frame = _HEADER.pack(MAGIC, msg_type, len(payload)) + payload + \
+                struct.pack("<I", crc)
+            self._sock.sendall(frame)
+
+    def recv(self) -> tuple[int, bytes]:
+        if self._fd is not None:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            ln = ctypes.c_uint32()
+            rc = self._lib.cw_recv_msg(self._fd, ctypes.byref(out), ctypes.byref(ln))
+            if rc < 0:
+                _raise(rc)
+            try:
+                data = ctypes.string_at(out, ln.value) if ln.value else b""
+            finally:
+                if ln.value:
+                    self._lib.cw_free(out)
+            return rc, data
+        else:
+            header = self._read_exact(_HEADER.size)
+            magic, msg_type, plen = _HEADER.unpack(header)
+            if magic != MAGIC:
+                _raise(-8)
+            if plen > MAX_PAYLOAD:
+                _raise(-7)
+            payload = self._read_exact(plen) if plen else b""
+            (want_crc,) = struct.unpack("<I", self._read_exact(4))
+            crc = zlib.crc32(bytes([msg_type]))
+            crc = zlib.crc32(payload, crc)
+            if crc != want_crc:
+                _raise(-9)
+            return msg_type, payload
+
+    def _read_exact(self, n: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < n:
+            chunk = self._sock.recv(n - got)
+            if not chunk:
+                raise PeerClosed(_ERRORS[-2])
+            chunks.append(chunk)
+            got += len(chunk)
+        return b"".join(chunks)
+
+    def close(self) -> None:
+        if self._fd is not None:
+            self._lib.cw_close(self._fd)
+            self._fd = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def connect(host: str, port: int, timeout_ms: int = 10000,
+            force_python: bool = False) -> Connection:
+    lib = None if force_python else native_lib()
+    if lib is not None:
+        fd = lib.cw_connect(host.encode(), port, timeout_ms)
+        if fd >= 0:
+            return Connection(fd=fd)
+        _raise(fd)
+    sock = socket.create_connection((host, port), timeout=timeout_ms / 1000)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    sock.settimeout(None)
+    return Connection(sock=sock)
+
+
+class Listener:
+    """Framed-connection acceptor (native or Python)."""
+
+    def __init__(self, addr: str = "0.0.0.0", port: int = 0,
+                 force_python: bool = False):
+        lib = None if force_python else native_lib()
+        if lib is not None:
+            fd = lib.cw_listen(addr.encode(), port, 16)
+            if fd < 0:
+                _raise(fd)
+            self._fd, self._sock, self._lib = fd, None, lib
+            self.port = lib.cw_local_port(fd)
+        else:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((addr, port))
+            s.listen(16)
+            self._fd, self._sock, self._lib = None, s, None
+            self.port = s.getsockname()[1]
+
+    def accept(self) -> Connection:
+        if self._fd is not None:
+            fd = self._lib.cw_accept(self._fd)
+            if fd < 0:
+                _raise(fd)
+            return Connection(fd=fd)
+        conn, _ = self._sock.accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return Connection(sock=conn)
+
+    def close(self) -> None:
+        if self._fd is not None:
+            self._lib.cw_close(self._fd)
+            self._fd = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None

@@ -1,0 +1,134 @@
+"""split-model: pre-split a checkpoint into per-worker bundles.
+
+Equivalent of the reference's `cake-split-model` crate
+(cake-split-model/src/main.rs): for each worker in the topology (or one via
+--worker), filter the safetensors weight_map by layer ownership
+(main.rs:80-106, topology.rs:25-32), copy the matching tensors into
+``<name>-node/model/{reduced.safetensors, model.safetensors.index.json}``
+(main.rs:108-142,176-200), **verify by re-loading the written file**
+(main.rs:202-208), and write a single-worker ``topology.yml``
+(main.rs:210-223). Config/tokenizer files are copied alongside so a bundle
+is a self-sufficient worker checkpoint.
+
+Usage:
+  python -m cake_tpu.tools.split_model \\
+      --model-path /path/to/llama --topology topology.yml --output ./bundles
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.utils.weights import load_safetensors_index
+
+
+def reduce_for_worker(weight_map: dict[str, str], node) -> dict[str, str]:
+    """Filter tensor name -> shard file to the worker's layers
+    (main.rs:80-106)."""
+    return {
+        name: fname
+        for name, fname in weight_map.items()
+        if node.is_layer_owner(name)
+    }
+
+
+def split_for_worker(model_dir: Path, out_root: Path, topology: Topology,
+                     node) -> Path:
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    name_to_file = load_safetensors_index(model_dir)
+    weight_map = {n: str(f.name) for n, f in name_to_file.items()}
+    reduced = reduce_for_worker(weight_map, node)
+    if not reduced:
+        raise ValueError(f"worker '{node.name}' owns no tensors")
+
+    out_dir = out_root / f"{node.name}-node" / "model"
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    # copy matching tensors out of the mmap'd shards (main.rs:108-142)
+    tensors: dict[str, np.ndarray] = {}
+    handles: dict[Path, object] = {}
+    try:
+        for tname in sorted(reduced):
+            f = name_to_file[tname]
+            if f not in handles:
+                handles[f] = safe_open(f, framework="np")
+            tensors[tname] = handles[f].get_tensor(tname)
+    finally:
+        for h in handles.values():
+            if hasattr(h, "close"):
+                h.close()
+
+    out_file = out_dir / "reduced.safetensors"
+    save_file(tensors, out_file)
+    index = {
+        "metadata": {
+            "total_size": int(sum(t.nbytes for t in tensors.values()))
+        },
+        "weight_map": {n: "reduced.safetensors" for n in tensors},
+    }
+    (out_dir / "model.safetensors.index.json").write_text(json.dumps(index))
+
+    # self-check: re-open the written file and verify every tensor resolves
+    # to exactly one shard (main.rs:202-208)
+    with safe_open(out_file, framework="np") as sf:
+        written = set(sf.keys())
+    if written != set(tensors):
+        raise RuntimeError(
+            f"verification failed for '{node.name}': wrote {len(tensors)} "
+            f"tensors, file has {len(written)}"
+        )
+
+    # config/tokenizer travel with the bundle
+    for aux in ("config.json", "tokenizer.json", "tokenizer_config.json"):
+        src = model_dir / aux
+        if src.exists():
+            shutil.copy(src, out_dir / aux)
+
+    # single-worker topology (main.rs:210-223)
+    single = Topology.from_dict({node.name: {
+        "host": node.host, "description": node.description,
+        "layers": list(node.layers),
+    }})
+    single.save(out_root / f"{node.name}-node" / "topology.yml")
+    return out_dir
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cake-split-model")
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--topology", required=True)
+    p.add_argument("--output", required=True)
+    p.add_argument("--worker", default=None,
+                   help="split only this worker (default: all)")
+    args = p.parse_args(argv)
+
+    model_dir = Path(args.model_path)
+    topology = Topology.from_path(args.topology)
+    out_root = Path(args.output)
+
+    nodes = list(topology)
+    if args.worker:
+        if args.worker not in topology:
+            sys.exit(f"error: worker '{args.worker}' not in topology")
+        nodes = [topology[args.worker]]
+
+    for node in nodes:
+        out = split_for_worker(model_dir, out_root, topology, node)
+        total = sum(
+            f.stat().st_size for f in out.glob("reduced.safetensors")
+        )
+        print(f"{node.name}: {out} ({total / 1e6:.1f} MB)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

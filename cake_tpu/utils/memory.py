@@ -1,0 +1,39 @@
+"""Process/device memory reporting.
+
+Equivalent of the reference's RSS logging at every phase via memory_stats +
+human_bytes (cake/mod.rs:67-73, master.rs:25-28, worker.rs:102-106,
+llama.rs:203-206), plus TPU-side HBM stats the reference has no analog for.
+"""
+
+from __future__ import annotations
+
+import resource
+
+
+def rss_bytes() -> int:
+    """Peak resident set size of this process (linux: ru_maxrss is KiB)."""
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+
+
+def human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0:
+            return f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} PiB"
+
+
+def memory_report() -> str:
+    parts = [f"rss {human_bytes(rss_bytes())}"]
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        stats = dev.memory_stats()
+        if stats and "bytes_in_use" in stats:
+            parts.append(f"hbm {human_bytes(stats['bytes_in_use'])}")
+            if "bytes_limit" in stats:
+                parts.append(f"of {human_bytes(stats['bytes_limit'])}")
+    except Exception:
+        pass
+    return ", ".join(parts)

@@ -55,17 +55,18 @@ def params_from_hf_tensors(
     lo, hi = layer_range or (0, num_layers)
     dt = jnp.dtype(dtype)
 
-    layers = {}
-    for ours, (suffix, transpose) in _LAYER_MAP.items():
-        per = []
-        for i in range(lo, hi):
-            w = np.asarray(get(f"model.layers.{i}.{suffix}"))
-            if transpose:
-                w = w.T
-            per.append(w)
-        layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
-
-    params: dict = {"layers": layers}
+    params: dict = {}
+    if hi > lo:
+        layers = {}
+        for ours, (suffix, transpose) in _LAYER_MAP.items():
+            per = []
+            for i in range(lo, hi):
+                w = np.asarray(get(f"model.layers.{i}.{suffix}"))
+                if transpose:
+                    w = w.T
+                per.append(w)
+            layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
+        params["layers"] = layers
     if include_embed:
         params["embed"] = jnp.asarray(np.asarray(get("model.embed_tokens.weight"))).astype(dt)
     if include_head:

@@ -1,0 +1,239 @@
+// cake-tpu native wire transport.
+//
+// C++ equivalent of the reference's native Rust communication plane
+// (cake-core/src/cake/proto/{mod,message}.rs + the tokio socket handling in
+// client.rs/worker.rs): length-prefixed framed messages over TCP with a
+// magic word and a hard size cap (proto/mod.rs:4-7, message.rs:118-155).
+//
+// Differences by design (TPU build):
+//  - CRC32 trailer on every frame (the reference has no integrity check;
+//    activations crossing DCN between TPU-VM hosts deserve one).
+//  - The payload is an opaque byte blob; tensor/header encoding lives one
+//    layer up (Python protocol.py or any other binding) so the native lib
+//    stays schema-free. On-pod transfers never touch this path at all —
+//    they ride ICI inside the compiled program (parallel/pipeline.py).
+//
+// Exposed as a plain C ABI for ctypes. All functions return >=0 on success,
+// negative error codes on failure. Blocking IO with optional timeouts.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x7CA4E701u;  // cake-tpu wire v1
+constexpr uint32_t kMaxPayload = 512u * 1024u * 1024u;  // 512 MiB cap
+
+// CRC32 (IEEE, table-driven), computed over type byte + payload.
+uint32_t crc32_table[256];
+bool crc32_init_done = false;
+
+void crc32_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+  crc32_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed) {
+  if (!crc32_init_done) crc32_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < len; i++)
+    c = crc32_table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+int read_full(int fd, uint8_t* buf, size_t len) {
+  size_t got = 0;
+  while (got < len) {
+    ssize_t n = ::recv(fd, buf + got, len - got, 0);
+    if (n == 0) return -2;  // peer closed
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    got += static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+int write_full(int fd, const uint8_t* buf, size_t len) {
+  size_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, buf + sent, len - sent, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    sent += static_cast<size_t>(n);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- connection management ------------------------------------------------
+
+// Connect to host:port. Returns fd >= 0 or negative errno-style code.
+int cw_connect(const char* host, uint16_t port, int timeout_ms) {
+  struct addrinfo hints = {};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%u", port);
+  struct addrinfo* res = nullptr;
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return -3;
+  int fd = -1;
+  for (struct addrinfo* ai = res; ai; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (timeout_ms > 0) {
+      struct timeval tv = {timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
+      // The timeout only bounds connect(); established-connection reads may
+      // legitimately block for a long time (e.g. the peer is inside an XLA
+      // compile), so clear it — matching the Python fallback's
+      // settimeout(None) after connect.
+      struct timeval zero = {0, 0};
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof zero);
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &zero, sizeof zero);
+      break;
+    }
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  return fd >= 0 ? fd : -4;
+}
+
+// Bind+listen on addr:port. Returns listening fd or negative.
+int cw_listen(const char* addr, uint16_t port, int backlog) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in sa = {};
+  sa.sin_family = AF_INET;
+  sa.sin_port = htons(port);
+  sa.sin_addr.s_addr = (addr && *addr) ? inet_addr(addr) : INADDR_ANY;
+  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&sa), sizeof sa) < 0) {
+    ::close(fd);
+    return -5;
+  }
+  if (::listen(fd, backlog > 0 ? backlog : 16) < 0) {
+    ::close(fd);
+    return -6;
+  }
+  return fd;
+}
+
+// Accept one connection; returns connected fd or negative.
+int cw_accept(int listen_fd) {
+  int fd = ::accept(listen_fd, nullptr, nullptr);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return fd;
+}
+
+// Local port of a bound socket (for port-0 auto-assign in tests).
+int cw_local_port(int fd) {
+  struct sockaddr_in sa = {};
+  socklen_t len = sizeof sa;
+  if (getsockname(fd, reinterpret_cast<struct sockaddr*>(&sa), &len) < 0)
+    return -1;
+  return ntohs(sa.sin_port);
+}
+
+void cw_close(int fd) { ::close(fd); }
+
+// ---- framing --------------------------------------------------------------
+// Frame layout (little-endian):
+//   u32 magic | u8 msg_type | u32 payload_len | payload | u32 crc32
+// crc32 covers msg_type + payload.
+
+int cw_send_msg(int fd, uint8_t msg_type, const uint8_t* payload,
+                uint32_t len) {
+  if (len > kMaxPayload) return -7;
+  uint8_t header[9];
+  memcpy(header, &kMagic, 4);
+  header[4] = msg_type;
+  memcpy(header + 5, &len, 4);
+  uint32_t crc = crc32(&msg_type, 1, 0);
+  if (len) crc = crc32(payload, len, crc ^ 0);  // chain: seed with prior crc
+  if (write_full(fd, header, sizeof header) < 0) return -1;
+  if (len && write_full(fd, payload, len) < 0) return -1;
+  uint8_t trailer[4];
+  memcpy(trailer, &crc, 4);
+  if (write_full(fd, trailer, 4) < 0) return -1;
+  return 0;
+}
+
+// Receive a frame. On success (*payload) is malloc'd (caller frees with
+// cw_free), *len set, returns msg_type (>=0). Negative on error:
+//  -1 io, -2 closed, -8 bad magic, -7 oversized, -9 crc mismatch.
+int cw_recv_msg(int fd, uint8_t** payload, uint32_t* len) {
+  uint8_t header[9];
+  int rc = read_full(fd, header, sizeof header);
+  if (rc < 0) return rc;
+  uint32_t magic;
+  memcpy(&magic, header, 4);
+  if (magic != kMagic) return -8;
+  uint8_t msg_type = header[4];
+  uint32_t plen;
+  memcpy(&plen, header + 5, 4);
+  if (plen > kMaxPayload) return -7;
+  uint8_t* buf = nullptr;
+  if (plen) {
+    buf = static_cast<uint8_t*>(malloc(plen));
+    if (!buf) return -10;
+    rc = read_full(fd, buf, plen);
+    if (rc < 0) {
+      free(buf);
+      return rc;
+    }
+  }
+  uint8_t trailer[4];
+  rc = read_full(fd, trailer, 4);
+  if (rc < 0) {
+    free(buf);
+    return rc;
+  }
+  uint32_t want_crc;
+  memcpy(&want_crc, trailer, 4);
+  uint32_t crc = crc32(&msg_type, 1, 0);
+  if (plen) crc = crc32(buf, plen, crc ^ 0);
+  if (crc != want_crc) {
+    free(buf);
+    return -9;
+  }
+  *payload = buf;
+  *len = plen;
+  return msg_type;
+}
+
+void cw_free(uint8_t* buf) { free(buf); }
+
+uint32_t cw_magic() { return kMagic; }
+uint32_t cw_max_payload() { return kMaxPayload; }
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+"""CLI surface: flag parity with the reference + end-to-end subprocess runs."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import pytest
+
+from cake_tpu.cli import build_parser
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.utils.weights import save_llama_params
+
+CFG = tiny()
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_defaults_match_reference():
+    """Flag defaults mirror cake-core/src/lib.rs:15-64."""
+    args = build_parser().parse_args(["--model", "x"])
+    assert args.seed == 299792458
+    assert args.sample_len == 100
+    assert args.temperature == 1.0
+    assert args.repeat_penalty == 1.1
+    assert args.repeat_last_n == 128
+    assert args.address == "127.0.0.1:10128"
+    assert args.mode == "master"
+    assert args.top_k is None and args.top_p is None
+
+
+def test_short_n_flag():
+    args = build_parser().parse_args(["--model", "x", "-n", "7"])
+    assert args.sample_len == 7
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("climodel")
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype="float32")
+    save_llama_params(params, d)
+    (d / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    return d
+
+
+def _run_cli(argv, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli"] + argv,
+        capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
+    )
+
+
+def test_local_generation_subprocess(model_dir):
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5,7",
+        "-n", "4", "--temperature", "0", "--max-seq", "32", "--cpu",
+    ])
+    assert r.returncode == 0, r.stderr
+    assert "tok/s" in r.stderr
+
+
+def test_missing_config_errors(tmp_path):
+    r = _run_cli(["--model", str(tmp_path), "--prompt-ids", "1", "-n", "1"])
+    assert r.returncode != 0
+    assert "config.json not found" in r.stderr
+
+
+def test_string_prompt_without_tokenizer_errors(model_dir):
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt", "hello", "-n", "1", "--cpu",
+    ])
+    assert r.returncode != 0
+    assert "--prompt-ids" in r.stderr
+
+
+def test_worker_requires_name(model_dir):
+    r = _run_cli(["--model", str(model_dir), "--mode", "worker"])
+    assert r.returncode != 0
+    assert "--name" in r.stderr

@@ -1,0 +1,177 @@
+"""Master/worker loopback: distributed generation must match local exactly.
+
+The reference was only ever validated by manual multi-node deployment
+(SURVEY.md §4); here the whole master<->worker path — wire framing, tensor
+codec, worker op loop, per-connection caches, segment coalescing — runs over
+localhost and is held to golden-token parity with the all-local generator.
+"""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime.master import DistributedGenerator, build_runners
+from cake_tpu.runtime.worker import Worker
+from cake_tpu.runtime.generator import LlamaGenerator
+
+CFG = tiny(max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(3))
+
+
+def _loader(params):
+    return lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+
+def _head_params(params):
+    return {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+
+
+def _start_worker(name, topo, params, port=0):
+    w = Worker(
+        name, CFG, topo, _loader(params), address=f"127.0.0.1:{port}",
+        max_seq=CFG.max_seq_len,
+    )
+    w.serve_in_background()
+    return w
+
+
+def _local_stream(params, prompt, n, settings):
+    g = LlamaGenerator(CFG, params, settings=settings)
+    g.set_prompt(prompt)
+    return [g.next_token(i).id for i in range(n)]
+
+
+def test_all_remote_two_workers(params):
+    """Master holds no layers; two workers serve [0,2) and [2,4)."""
+    w1 = _start_worker("w1", Topology.from_dict(
+        {"w1": {"layers": ["model.layers.0-1"]}}), params)
+    w2 = _start_worker("w2", Topology.from_dict(
+        {"w2": {"layers": ["model.layers.2-3"]}}), params)
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{w1.port}", "layers": ["model.layers.0-1"]},
+        "w2": {"host": f"127.0.0.1:{w2.port}", "layers": ["model.layers.2-3"]},
+    })
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    runners = build_runners(CFG, topo, _loader(params))
+    assert [r.ident() for r in runners] == [
+        f"127.0.0.1:{w1.port}", f"127.0.0.1:{w2.port}"
+    ]
+    g = DistributedGenerator(CFG, _head_params(params), runners,
+                             settings=settings)
+    g.set_prompt([5, 9, 2])
+    got = [g.next_token(i).id for i in range(6)]
+    assert got == _local_stream(params, [5, 9, 2], 6, settings)
+    assert g.tokens_per_sec() is not None
+    g.close()
+    w1.shutdown()
+    w2.shutdown()
+
+
+def test_mixed_local_remote(params):
+    """Worker serves the middle segment; master runs layers 0 and 3 locally
+    (llama.rs:177-193 semantics: per-layer placement by topology)."""
+    w = _start_worker("mid", Topology.from_dict(
+        {"mid": {"layers": ["model.layers.1-2"]}}), params)
+    topo = Topology.from_dict({
+        "mid": {"host": f"127.0.0.1:{w.port}", "layers": ["model.layers.1-2"]},
+    })
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    runners = build_runners(CFG, topo, _loader(params))
+    idents = [r.ident() for r in runners]
+    assert idents == ["local", f"127.0.0.1:{w.port}", "local"]
+    g = DistributedGenerator(CFG, _head_params(params), runners,
+                             settings=settings)
+    g.set_prompt([1, 2, 3, 4])
+    got = [g.next_token(i).id for i in range(5)]
+    assert got == _local_stream(params, [1, 2, 3, 4], 5, settings)
+    g.close()
+    w.shutdown()
+
+
+def test_sampled_stream_parity(params):
+    """Seeded non-greedy sampling also matches local exactly (same sampler,
+    same key schedule)."""
+    w = _start_worker("all", Topology.from_dict(
+        {"all": {"layers": ["model.layers.0-3"]}}), params)
+    topo = Topology.from_dict({
+        "all": {"host": f"127.0.0.1:{w.port}", "layers": ["model.layers.0-3"]},
+    })
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=77)
+    runners = build_runners(CFG, topo, _loader(params))
+    g = DistributedGenerator(CFG, _head_params(params), runners,
+                             settings=settings)
+    g.set_prompt([3, 1, 4])
+    got = [g.next_token(i).id for i in range(8)]
+    assert got == _local_stream(params, [3, 1, 4], 8, settings)
+    g.close()
+    w.shutdown()
+
+
+def test_generator_reuse_reconnects(params):
+    """set_prompt on a distributed generator resets worker-side caches via
+    reconnect (reference: fresh connection = fresh cache, worker.rs:52-61)."""
+    w = _start_worker("all", Topology.from_dict(
+        {"all": {"layers": ["model.layers.0-3"]}}), params)
+    topo = Topology.from_dict({
+        "all": {"host": f"127.0.0.1:{w.port}", "layers": ["model.layers.0-3"]},
+    })
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    runners = build_runners(CFG, topo, _loader(params))
+    g = DistributedGenerator(CFG, _head_params(params), runners,
+                             settings=settings)
+    g.set_prompt([9, 8, 7])
+    first = [g.next_token(i).id for i in range(4)]
+    g.set_prompt([9, 8, 7])
+    second = [g.next_token(i).id for i in range(4)]
+    assert first == second
+    g.close()
+    w.shutdown()
+
+
+def test_worker_rejects_unserved_layer(params):
+    from cake_tpu.parallel.runner import RemoteRunner
+
+    w = _start_worker("w", Topology.from_dict(
+        {"w": {"layers": ["model.layers.0-1"]}}), params)
+    with pytest.raises(RuntimeError, match="does not serve"):
+        RemoteRunner(f"127.0.0.1:{w.port}", start=2, stop=4)
+    w.shutdown()
+
+
+def test_worker_reports_op_errors(params):
+    """A malformed op gets an Error reply, and the connection keeps serving."""
+    from cake_tpu.runtime import protocol, wire
+    from cake_tpu.runtime.protocol import MsgType
+
+    w = _start_worker("w", Topology.from_dict(
+        {"w": {"layers": ["model.layers.0-1"]}}), params)
+    conn = wire.connect("127.0.0.1", w.port)
+    conn.send(MsgType.HELLO)
+    t, payload = conn.recv()
+    assert t == MsgType.WORKER_INFO
+    x = np.zeros((1, 1, CFG.hidden_size), np.float32)
+    conn.send(MsgType.BATCH, protocol.encode_ops(x, [("model.layers.3", 0)]))
+    t, payload = conn.recv()
+    assert t == MsgType.ERROR
+    assert "not served" in protocol.decode_error(payload)
+    # connection still alive: valid op succeeds
+    conn.send(MsgType.BATCH, protocol.encode_ops(x, [("model.layers.0", 0)]))
+    t, payload = conn.recv()
+    assert t == MsgType.TENSOR
+    conn.close()
+    w.shutdown()
+
+
+def test_worker_requires_assigned_layers(params):
+    with pytest.raises(ValueError, match="not present"):
+        Worker("ghost", CFG, Topology.from_dict({}), _loader(params))

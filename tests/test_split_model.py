@@ -1,0 +1,102 @@
+"""Splitter round-trip: bundles load back and serve generation correctly."""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.tools.split_model import main as split_main, split_for_worker
+from cake_tpu.utils.weights import load_llama_params, save_llama_params
+
+CFG = tiny()
+
+
+@pytest.fixture(scope="module")
+def model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("model")
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype="float32")
+    save_llama_params(params, d)
+    (d / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    return d
+
+
+def _topo(tmp_path):
+    t = Topology.from_dict({
+        "w1": {"host": "10.0.0.1:10128", "layers": ["model.layers.0-1"]},
+        "w2": {"host": "10.0.0.2:10128", "layers": ["model.layers.2-3"]},
+    })
+    p = tmp_path / "topology.yml"
+    t.save(p)
+    return t, p
+
+
+def test_split_cli_all_workers(model_dir, tmp_path, capsys):
+    _, topo_path = _topo(tmp_path)
+    rc = split_main([
+        "--model-path", str(model_dir),
+        "--topology", str(topo_path),
+        "--output", str(tmp_path / "bundles"),
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "w1:" in out and "w2:" in out
+    for w in ("w1", "w2"):
+        bundle = tmp_path / "bundles" / f"{w}-node"
+        assert (bundle / "model" / "reduced.safetensors").exists()
+        assert (bundle / "model" / "model.safetensors.index.json").exists()
+        assert (bundle / "model" / "config.json").exists()
+        assert (bundle / "topology.yml").exists()
+
+
+def test_bundle_contains_only_own_layers(model_dir, tmp_path):
+    topo, _ = _topo(tmp_path)
+    out = split_for_worker(model_dir, tmp_path / "b", topo, topo["w1"])
+    index = json.loads((out / "model.safetensors.index.json").read_text())
+    names = set(index["weight_map"])
+    assert all(n.startswith("model.layers.0.") or n.startswith("model.layers.1.")
+               for n in names)
+    assert not any("model.layers.2" in n for n in names)
+    assert not any(n.startswith("model.embed") for n in names)  # head stays local
+
+
+def test_bundle_loads_with_layer_range(model_dir, tmp_path):
+    """A worker bundle must load through the normal weights loader and match
+    the original tensors exactly."""
+    topo, _ = _topo(tmp_path)
+    out = split_for_worker(model_dir, tmp_path / "b", topo, topo["w2"])
+    part = load_llama_params(
+        out, CFG.num_hidden_layers, dtype="float32",
+        layer_range=(2, 4), include_embed=False, include_head=False,
+    )
+    full = load_llama_params(model_dir, CFG.num_hidden_layers, dtype="float32")
+    np.testing.assert_array_equal(
+        np.asarray(part["layers"]["wq"]),
+        np.asarray(full["layers"]["wq"][2:4]),
+    )
+
+
+def test_single_worker_flag(model_dir, tmp_path):
+    _, topo_path = _topo(tmp_path)
+    rc = split_main([
+        "--model-path", str(model_dir),
+        "--topology", str(topo_path),
+        "--output", str(tmp_path / "one"),
+        "--worker", "w2",
+    ])
+    assert rc == 0
+    assert (tmp_path / "one" / "w2-node").exists()
+    assert not (tmp_path / "one" / "w1-node").exists()
+
+
+def test_single_node_topology_written(model_dir, tmp_path):
+    topo, _ = _topo(tmp_path)
+    split_for_worker(model_dir, tmp_path / "b", topo, topo["w1"])
+    t = Topology.from_path(tmp_path / "b" / "w1-node" / "topology.yml")
+    assert len(t) == 1
+    assert t["w1"].host == "10.0.0.1:10128"
+    assert t["w1"].layer_indices() == [0, 1]
