@@ -19,9 +19,11 @@ TPU-first redesign decisions:
 - The memoized mask cache of the reference (cache.rs:81-103) is replaced by an
   iota comparison fused into the softmax by XLA.
 
-This module is the reference-math path used for correctness tests and small
-shapes; a fused Pallas flash-attention kernel for long-context is planned as
-``cake_tpu.ops.flash`` and will plug in behind the same signature.
+On TPU, :func:`attend` dispatches to the fused Pallas flash kernels
+(:mod:`cake_tpu.ops.pallas.flash`) — blockwise online softmax, causal mask in
+registers, no HBM score materialization, KV blocks past the frontier never
+fetched. This XLA path remains the fallback and the parity oracle
+(``CAKE_PALLAS=0`` forces it everywhere).
 """
 
 from __future__ import annotations
@@ -30,9 +32,16 @@ import jax
 import jax.numpy as jnp
 
 from cake_tpu.ops import kvcache as kv
+from cake_tpu.ops import pallas as pk
 from cake_tpu.ops.rope import apply_rope
 
 NEG_INF = -1e30
+
+
+def _flash_ok(t: int, s: int, d: int) -> bool:
+    """Shapes the compiled (non-interpret) kernels handle efficiently:
+    lane-aligned head_dim and a KV buffer divisible into aligned blocks."""
+    return d % 128 == 0 and s % 128 == 0
 
 
 def attend(
@@ -40,8 +49,31 @@ def attend(
     k_all: jax.Array,  # [B, kv_heads, S, D] (full cache buffer)
     v_all: jax.Array,  # [B, kv_heads, S, D]
     pos,  # scalar: absolute position of q[..., 0, :]
+    impl: str = "auto",  # auto | xla | flash
 ) -> jax.Array:
     """Masked GQA attention over a fixed-size KV buffer. Returns [B,H,T,D]."""
+    t, d = q.shape[2], q.shape[3]
+    s = k_all.shape[2]
+    if impl == "auto":
+        impl = (
+            "flash"
+            if pk.kernels_enabled() and (pk.interpret_default() or _flash_ok(t, s, d))
+            else "xla"
+        )
+    if impl == "flash":
+        if t == 1:
+            return pk.flash_decode(q, k_all, v_all, pos)
+        return pk.flash_attention(q, k_all, v_all, pos)
+    return _attend_xla(q, k_all, v_all, pos)
+
+
+def _attend_xla(
+    q: jax.Array,
+    k_all: jax.Array,
+    v_all: jax.Array,
+    pos,
+) -> jax.Array:
+    """Reference-math XLA path (full [T, S] scores, mask by iota compare)."""
     b, n_heads, t, d = q.shape
     kv_heads, s = k_all.shape[1], k_all.shape[2]
     group = n_heads // kv_heads

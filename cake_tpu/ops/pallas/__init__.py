@@ -1,0 +1,57 @@
+"""Pallas TPU kernels (SURVEY.md §7 step 4).
+
+The reference delegates all device kernels to candle's CUDA/Metal backends
+(`cake-core/Cargo.toml:28-48`); the TPU-native equivalent is hand-written
+Pallas (Mosaic) kernels for the hot ops, with the pure-JAX reference-math
+implementations in :mod:`cake_tpu.ops` retained as the fallback / parity
+oracle.
+
+Dispatch policy (``CAKE_PALLAS`` env): ``auto`` (default — kernels on TPU,
+XLA elsewhere), ``1`` (force kernels; interpreted off-TPU, used by tests),
+``0`` (force XLA fallback everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def _mode() -> str:
+    return os.environ.get("CAKE_PALLAS", "auto").lower()
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def kernels_enabled() -> bool:
+    """Should hot ops route to Pallas kernels?"""
+    mode = _mode()
+    if mode in ("1", "true", "force"):
+        return True
+    if mode in ("0", "false", "off"):
+        return False
+    return on_tpu()
+
+
+def interpret_default() -> bool:
+    """Pallas kernels run interpreted off-TPU (CPU tests), compiled on TPU."""
+    return not on_tpu()
+
+
+from cake_tpu.ops.pallas.flash import (  # noqa: E402
+    flash_attention,
+    flash_decode,
+)
+from cake_tpu.ops.pallas.fused import rms_norm_pallas  # noqa: E402
+
+__all__ = [
+    "kernels_enabled",
+    "interpret_default",
+    "on_tpu",
+    "flash_attention",
+    "flash_decode",
+    "rms_norm_pallas",
+]

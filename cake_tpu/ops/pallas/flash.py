@@ -1,0 +1,317 @@
+"""Blockwise (flash) causal GQA attention as Pallas TPU kernels.
+
+Replaces the reference's materialized-scores attention for long sequences
+(`cake-core/src/model/attention.rs:59-80`: repeat_kv + full [T, S] score
+matrix + memoized masks, cache.rs:81-103). Here the causal mask is folded
+into an online-softmax blockwise sweep over the KV buffer — scores never hit
+HBM, the mask is an iota comparison computed in registers, and KV blocks
+entirely beyond the causal frontier are never even DMA'd from HBM (their
+block index is clamped so the pipeline re-uses the previous fetch, and the
+compute is predicated off).
+
+Two kernels share the math:
+
+- :func:`flash_attention` — prefill: ``q [B, H, T, D]`` against the full
+  ``[B, KVH, S, D]`` cache buffers, grid over (batch, head, q-block,
+  kv-block) with f32 running max / sum / accumulator scratch.
+- :func:`flash_decode` — decode (T == 1): the GQA head group is folded into
+  the q-row axis (``[B, KVH, group, D]``) so the MXU sees a [group, D] x
+  [D, BK] matmul per step; grid over (batch, kv-head, kv-block). Only KV
+  blocks at or before the frontier ``pos`` are read.
+
+Numerics match :func:`cake_tpu.ops.attention.attend`: f32 scores and
+accumulation regardless of model dtype (attention.rs:62-77), probabilities
+cast to the value dtype for the PV matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+_LANES = 128
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    """Largest power-of-two block <= preferred that divides n."""
+    b = 1
+    while b * 2 <= min(n, preferred) and n % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Prefill kernel
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(
+    pos_ref,  # scalar prefetch: [1] int32
+    q_ref,  # [1, 1, BQ, D]
+    k_ref,  # [1, 1, BK, D]
+    v_ref,  # [1, 1, BK, D]
+    o_ref,  # [1, 1, BQ, D]
+    acc_ref,  # VMEM [BQ, D] f32
+    m_ref,  # VMEM [BQ, LANES] f32  (running max, lanes replicated)
+    l_ref,  # VMEM [BQ, LANES] f32  (running denom)
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    num_kv_blocks: int,
+):
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    pos = pos_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    # Last kv block index visible to any row of this q block.
+    max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
+
+    @pl.when(kb <= max_kb)
+    def _compute():
+        q = q_ref[0, 0]  # [BQ, D]
+        k = k_ref[0, 0]  # [BK, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale  # [BQ, BK] f32
+
+        qpos = (
+            pos
+            + qb * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        )
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_ref[:]  # [BQ, LANES]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)  # [BQ, LANES]
+        p = jnp.exp(s - m_new[:, :1])  # [BQ, BK] f32
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+
+    @pl.when(kb == num_kv_blocks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, H, T, D] (already roped)
+    k_all: jax.Array,  # [B, KVH, S, D] full cache buffer
+    v_all: jax.Array,
+    pos,  # scalar int: absolute position of q[..., 0, :]
+    *,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal flash attention over a fixed KV buffer. Returns [B, H, T, D]."""
+    b, h, t, d = q.shape
+    kvh, s = k_all.shape[1], k_all.shape[2]
+    group = h // kvh
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(s, block_k)
+    nq, nk = t // bq, s // bk
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    scale = 1.0 / math.sqrt(d)
+
+    def q_map(bi, hi, qb, kb, pos_ref):
+        return (bi, hi, qb, 0)
+
+    def kv_map(bi, hi, qb, kb, pos_ref):
+        # Clamp to the causal frontier: fully-masked blocks re-use the
+        # previous block index, so the pipeline skips their HBM fetch.
+        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
+        return (bi, hi // group, jnp.minimum(kb, max_kb), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_kernel, block_q=bq, block_k=bk, scale=scale, num_kv_blocks=nk
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * t * s * d,
+            bytes_accessed=(q.size + 2 * k_all.size + q.size) * q.dtype.itemsize,
+            transcendentals=b * h * t * s,
+        ),
+        interpret=interpret,
+    )(pos_arr, q, k_all, v_all)
+
+
+# ---------------------------------------------------------------------------
+# Decode kernel (T == 1)
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    pos_ref,  # [1] int32
+    q_ref,  # [1, 1, G, D]
+    k_ref,  # [1, 1, BK, D]
+    v_ref,  # [1, 1, BK, D]
+    o_ref,  # [1, 1, G, D]
+    acc_ref,  # VMEM [G, D] f32
+    m_ref,  # VMEM [G, LANES] f32
+    l_ref,  # VMEM [G, LANES] f32
+    *,
+    group: int,
+    block_k: int,
+    scale: float,
+    num_kv_blocks: int,
+):
+    kb = pl.program_id(2)
+    pos = pos_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    max_kb = jax.lax.div(pos, block_k)
+
+    @pl.when(kb <= max_kb)
+    def _compute():
+        q = q_ref[0, 0]  # [G, D]
+        k = k_ref[0, 0]  # [BK, D]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale  # [G, BK]
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (group, block_k), 1
+        )
+        s = jnp.where(kpos <= pos, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+
+    @pl.when(kb == num_kv_blocks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def flash_decode(
+    q: jax.Array,  # [B, H, 1, D] (already roped)
+    k_all: jax.Array,  # [B, KVH, S, D]
+    v_all: jax.Array,
+    pos,  # scalar int
+    *,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Single-position flash attention. Returns [B, H, 1, D].
+
+    The GQA group is folded into q rows so each (batch, kv-head) grid cell is
+    one [group, D] x [D, BK] matmul; KV blocks past ``pos`` are neither read
+    nor computed.
+    """
+    b, h, t, d = q.shape
+    assert t == 1, "flash_decode requires T == 1"
+    kvh, s = k_all.shape[1], k_all.shape[2]
+    group = h // kvh
+    bk = _pick_block(s, block_k)
+    nk = s // bk
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, kvh, group, d)
+
+    def q_map(bi, khi, kb, pos_ref):
+        return (bi, khi, 0, 0)
+
+    def kv_map(bi, khi, kb, pos_ref):
+        return (bi, khi, jnp.minimum(kb, jax.lax.div(pos_ref[0], bk)), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, kvh, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, d), q_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((group, d), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+            pltpu.VMEM((group, _LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _decode_kernel, group=group, block_k=bk, scale=scale, num_kv_blocks=nk
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * s * d,
+            bytes_accessed=2 * k_all.size * k_all.dtype.itemsize,
+            transcendentals=b * h * s,
+        ),
+        interpret=interpret,
+    )(pos_arr, qg, k_all, v_all)
+    return out.reshape(b, h, 1, d)

@@ -1,0 +1,65 @@
+"""Fused elementwise Pallas kernels: RMSNorm.
+
+The reference's RMSNorm comes from candle's fused CUDA/Metal kernel
+(`transformer.rs:30-38`); this is the Pallas equivalent — one pass over each
+row block in VMEM, f32 statistics, output cast back to the activation dtype.
+XLA fuses the pure-JAX version well already; the kernel exists so the whole
+decoder block can run kernel-resident on TPU and as the template for further
+fusions.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)  # [BR, hidden]
+    var = jnp.mean(x * x, axis=1, keepdims=True)
+    normed = x * jax.lax.rsqrt(var + eps)
+    o_ref[:] = (normed * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def rms_norm_pallas(
+    x: jax.Array,  # [..., hidden]
+    weight: jax.Array,  # [hidden]
+    eps: float,
+    *,
+    block_rows: int = 256,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused ``x * rsqrt(mean(x^2) + eps) * weight`` over the last axis."""
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+    orig_shape = x.shape
+    hidden = orig_shape[-1]
+    rows = x.size // hidden
+    x2 = x.reshape(rows, hidden)
+    w2 = weight.reshape(1, hidden)
+
+    br = 1
+    while br * 2 <= min(rows, block_rows) and rows % (br * 2) == 0:
+        br *= 2
+
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+        grid=(rows // br,),
+        in_specs=[
+            pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+            pl.BlockSpec((1, hidden), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, hidden), lambda i: (i, 0)),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(x2, w2)
+    return out.reshape(orig_shape)

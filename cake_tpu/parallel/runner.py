@@ -97,13 +97,15 @@ class RemoteRunner(BlockRunner):
     client.rs:14-135): handshake measures latency, forward ships one Batch
     per call for the whole segment."""
 
-    def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000):
+    def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000,
+                 max_seq: int | None = None):
         from cake_tpu.runtime import protocol, wire
         from cake_tpu.runtime.protocol import MsgType
 
         self._protocol, self._wire, self._MsgType = protocol, wire, MsgType
         self.start, self.stop = start, stop
         self._timeout_ms = timeout_ms
+        self._expected_max_seq = max_seq
         if ":" in host:
             addr, port = host.rsplit(":", 1)
         else:
@@ -128,6 +130,18 @@ class RemoteRunner(BlockRunner):
         if missing:
             raise RuntimeError(
                 f"worker {self.info.name}@{self.addr} does not serve {missing}"
+            )
+        # KV capacity must agree: a smaller worker cache would silently clamp
+        # KV writes past its max_seq (dynamic_update_slice semantics) and
+        # corrupt the stream long after the handshake.
+        if (
+            self._expected_max_seq
+            and self.info.max_seq
+            and self.info.max_seq != self._expected_max_seq
+        ):
+            raise RuntimeError(
+                f"worker {self.info.name}@{self.addr} max_seq "
+                f"{self.info.max_seq} != master max_seq {self._expected_max_seq}"
             )
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:

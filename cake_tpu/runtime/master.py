@@ -26,11 +26,10 @@ import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops import sampling
-from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.runner import BlockRunner, LocalRunner, RemoteRunner
 from cake_tpu.parallel.topology import Topology
-from cake_tpu.runtime.generator import GeneratorBase, Token, _bucket
+from cake_tpu.runtime.generator import GeneratorBase, Token, _bucket, _lm_head
 
 log = logging.getLogger("cake_tpu.master")
 
@@ -55,7 +54,10 @@ def build_runners(
             )
         else:
             node = topology[seg.owner]
-            runner = RemoteRunner(node.host, seg.start, seg.stop)
+            runner = RemoteRunner(
+                node.host, seg.start, seg.stop,
+                max_seq=max_seq or config.max_seq_len,
+            )
             log.info("connected: %s", runner.info)
             runners.append(runner)
     return runners
@@ -80,15 +82,19 @@ class DistributedGenerator(GeneratorBase):
         self.embed = head_params["embed"]
         self.norm_f = head_params["norm_f"]
         self.lm_head = head_params["lm_head"]
-        self._head_fn = jax.jit(self._head)
+        # Same head math as the all-local path (generator._lm_head) — one
+        # implementation, no drift between the fused and distributed runtimes.
+        self._head_fn = jax.jit(
+            partial(
+                _lm_head,
+                {"norm_f": self.norm_f, "lm_head": self.lm_head},
+                config=config,
+            )
+        )
         self._sample_fn = jax.jit(
             partial(sampling.sample_token, settings=self.settings)
         )
         self._t_start: float | None = None
-
-    def _head(self, x_last: jax.Array) -> jax.Array:
-        h = rms_norm(x_last, self.norm_f, self.config.rms_norm_eps)
-        return (h @ self.lm_head).astype(jnp.float32)
 
     def _on_new_prompt(self) -> None:
         self._t_start = None

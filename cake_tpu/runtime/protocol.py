@@ -104,6 +104,10 @@ class WorkerInfo:
     dtype: str = ""
     latency_ms: float = 0.0
     layers: list[str] = dataclasses.field(default_factory=list)
+    # KV capacity of this worker's caches; the master rejects a mismatch at
+    # handshake (a silently smaller worker cache would clamp KV writes once
+    # pos exceeds it and corrupt generation).
+    max_seq: int = 0
 
     def to_bytes(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()

@@ -65,7 +65,14 @@ def native_lib():
         try:
             lib = ctypes.CDLL(str(_SO))
         except OSError:
-            return None
+            # existing binary unloadable (e.g. built for another arch):
+            # rebuild from source and retry once
+            if not _SRC.exists() or not _build_native():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_SO))
+            except OSError:
+                return None
         lib.cw_connect.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]
         lib.cw_connect.restype = ctypes.c_int
         lib.cw_listen.argtypes = [ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int]

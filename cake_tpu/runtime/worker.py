@@ -31,7 +31,6 @@ import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops.kvcache import KVCache, init_cache
-from cake_tpu.parallel.runner import LocalRunner
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import protocol, wire
 from cake_tpu.runtime.protocol import MsgType, WorkerInfo
@@ -77,11 +76,10 @@ class Worker:
             raise ValueError(f"worker '{name}' has no layers assigned")
         self.runs = _contiguous_runs(indices)
         log.info("worker %s loading layers %s", name, self.runs)
-        self._runners = {
-            (lo, hi): LocalRunner(
-                config, params_loader(lo, hi), lo, hi, max_seq=self.max_seq
-            )
-            for lo, hi in self.runs
+        # Only the stacked weights are held long-term; KV caches are allocated
+        # fresh per connection (worker.rs:52-61) — nothing idle pins HBM.
+        self._layers = {
+            (lo, hi): params_loader(lo, hi) for lo, hi in self.runs
         }
         from functools import partial
 
@@ -104,6 +102,9 @@ class Worker:
                 if self._stop.is_set():
                     return
                 raise
+            if self._stop.is_set():  # woken by shutdown's dummy connect
+                conn.close()
+                return
             th = threading.Thread(
                 target=self._handle_connection, args=(conn,), daemon=True
             )
@@ -118,6 +119,12 @@ class Worker:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # A blocked accept() does not return when the fd is closed from
+        # another thread on Linux; wake it with a throwaway connection.
+        try:
+            wire.connect("127.0.0.1", self.port, timeout_ms=1000).close()
+        except Exception:
+            pass
         self.listener.close()
 
     # -- per-connection loop ------------------------------------------------
@@ -127,6 +134,7 @@ class Worker:
             name=self.name,
             device=getattr(dev, "device_kind", str(dev)),
             dtype=self.config.dtype,
+            max_seq=self.max_seq,
             layers=[
                 f"model.layers.{i}"
                 for lo, hi in self.runs
@@ -139,7 +147,10 @@ class Worker:
         per-connection fresh cache (worker.rs:149-258)."""
         # fresh per-connection caches: isolation over synchronization
         caches = {
-            run: self._runners[run].cache.as_new() for run in self._runners
+            (lo, hi): init_cache(
+                self.config, batch=1, max_seq=self.max_seq, num_layers=hi - lo
+            )
+            for lo, hi in self.runs
         }
         ops_done = 0
         t_window = time.perf_counter()
@@ -223,17 +234,17 @@ class Worker:
             ):
                 j += 1
             lo, hi = indices[i][0], indices[j][0] + 1
-            runner = self._runners[run]
+            run_layers = self._layers[run]
             cache = caches[run]
             if (lo, hi) == run:
                 # fast path: the whole stored run in one jitted scan
                 h, caches[run] = self._fn(
-                    runner.layers, h, cache, jnp.int32(pos)
+                    run_layers, h, cache, jnp.int32(pos)
                 )
             else:
                 # partial-run request: slice weights + cache, write back
                 layers = jax.tree.map(
-                    lambda a: a[lo - run[0] : hi - run[0]], runner.layers
+                    lambda a: a[lo - run[0] : hi - run[0]], run_layers
                 )
                 sub = KVCache(
                     k=cache.k[lo - run[0] : hi - run[0]],

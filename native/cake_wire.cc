@@ -127,15 +127,28 @@ int cw_connect(const char* host, uint16_t port, int timeout_ms) {
 
 // Bind+listen on addr:port. Returns listening fd or negative.
 int cw_listen(const char* addr, uint16_t port, int backlog) {
-  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return -1;
+  // Resolve with getaddrinfo (symmetric with cw_connect): hostnames work and
+  // bogus strings fail with -3 instead of inet_addr() silently yielding the
+  // broadcast address.
+  struct addrinfo hints = {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  hints.ai_flags = AI_PASSIVE;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%u", port);
+  struct addrinfo* res = nullptr;
+  const char* node = (addr && *addr) ? addr : nullptr;
+  if (getaddrinfo(node, portstr, &hints, &res) != 0 || !res) return -3;
+  int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    return -1;
+  }
   int one = 1;
   setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
-  struct sockaddr_in sa = {};
-  sa.sin_family = AF_INET;
-  sa.sin_port = htons(port);
-  sa.sin_addr.s_addr = (addr && *addr) ? inet_addr(addr) : INADDR_ANY;
-  if (::bind(fd, reinterpret_cast<struct sockaddr*>(&sa), sizeof sa) < 0) {
+  int rc = ::bind(fd, res->ai_addr, res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc < 0) {
     ::close(fd);
     return -5;
   }
