@@ -14,6 +14,7 @@ Knobs (env):
   CAKE_BENCH_PRESET  8b (default) | small | tiny  — model size
   CAKE_BENCH_STEPS   timed decode steps (default 64)
   CAKE_BENCH_SEQ     KV capacity (default 512)
+  CAKE_BENCH_QUANT   int8 — quantize linear weights (per-channel int8)
 """
 
 from __future__ import annotations
@@ -83,12 +84,18 @@ def main() -> int:
     # OOM fallback ladder: if the requested preset does not fit this chip's
     # HBM, step down and say so (blocked inside the try so async allocation
     # failures are actually caught here, not at first use).
+    quant = os.environ.get("CAKE_BENCH_QUANT", "")
     ladder = ["8b", "small", "tiny"]
     params = config = None
     for p in ladder[ladder.index(preset):]:
         cfg = _config(p)
         try:
             candidate = init_params(cfg, key)
+            if quant == "int8":
+                # quantize inside the ladder so an OOM here steps down too
+                from cake_tpu.ops.quant import quantize_params
+
+                candidate = quantize_params(candidate)
             candidate = jax.tree.map(lambda x: x.block_until_ready(), candidate)
             params, config, preset = candidate, cfg, p
             break
@@ -141,8 +148,9 @@ def main() -> int:
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb  # ideal decode tok/s (weights-bound)
 
+    wtag = "int8" if quant == "int8" else "bf16"
     print(json.dumps({
-        "metric": f"decode_tokens_per_sec_llama_{preset}_bf16_1chip",
+        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip",
         "value": round(toks_per_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_s / roofline, 4),

@@ -51,6 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="repeat_last_n")
     p.add_argument("--dtype", choices=["bf16", "f16", "f32"], default="bf16",
                    help="f16 maps to bf16 on TPU")
+    p.add_argument("--quantize", choices=["int8"], default=None,
+                   help="quantize linear weights on load (per-channel int8)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -117,6 +119,7 @@ def run_worker(args) -> int:
         return load_llama_params(
             args.model, config.num_hidden_layers, dtype=config.dtype,
             layer_range=(lo, hi), include_embed=False, include_head=False,
+            quantize=args.quantize,
         )["layers"]
 
     worker = Worker(args.name, config, topology, loader,
@@ -145,13 +148,14 @@ def run_master(args) -> int:
         topology = Topology.from_path(args.topology)
         head = load_llama_params(
             args.model, config.num_hidden_layers, dtype=config.dtype,
-            layer_range=(0, 0),
+            layer_range=(0, 0), quantize=args.quantize,
         )
 
         def loader(lo, hi):
             return load_llama_params(
                 args.model, config.num_hidden_layers, dtype=config.dtype,
                 layer_range=(lo, hi), include_embed=False, include_head=False,
+                quantize=args.quantize,
             )["layers"]
 
         runners = build_runners(config, topology, loader, max_seq=args.max_seq)
@@ -161,7 +165,7 @@ def run_master(args) -> int:
         from cake_tpu.runtime.generator import LlamaGenerator
 
         params = load_llama_params(args.model, config.num_hidden_layers,
-                                   dtype=config.dtype)
+                                   dtype=config.dtype, quantize=args.quantize)
         gen = LlamaGenerator(config, params, tokenizer=tokenizer,
                              settings=settings, max_seq=args.max_seq)
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,

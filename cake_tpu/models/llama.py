@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops import quant
 from cake_tpu.ops.attention import self_attention_block
 from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.mlp import swiglu
@@ -162,7 +163,7 @@ def forward(
     x, cache = forward_layers(params["layers"], x, cache, cos, sin, pos, config)
     x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
     x_last = x[:, -1, :]
-    logits = (x_last @ params["lm_head"]).astype(jnp.float32)
+    logits = quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
     return logits, cache
 
 

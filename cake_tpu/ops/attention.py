@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from cake_tpu.ops import kvcache as kv
 from cake_tpu.ops import pallas as pk
+from cake_tpu.ops import quant
 from cake_tpu.ops.rope import apply_rope
 
 NEG_INF = -1e30
@@ -124,11 +125,11 @@ def self_attention_block(
     over it. ``num_heads``/``num_kv_heads`` are then the *local* counts.
     """
     b, t, hidden = x.shape
-    d = wq.shape[1] // num_heads
+    d = quant.out_features(wq) // num_heads
 
-    q = (x @ wq).reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
-    k = (x @ wk).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
-    v = (x @ wv).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+    q = quant.dense(x, wq).reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    k = quant.dense(x, wk).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+    v = quant.dense(x, wv).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
 
     q = apply_rope(q, cos, sin, pos)
     k = apply_rope(k, cos, sin, pos)
@@ -137,7 +138,7 @@ def self_attention_block(
 
     out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
-    out = out @ wo
+    out = quant.dense(out, wo)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out, k_cache, v_cache

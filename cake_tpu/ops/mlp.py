@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cake_tpu.ops.quant import dense
+
 
 def swiglu(
     x: jax.Array,
@@ -22,7 +24,7 @@ def swiglu(
     """``tp_axis``: inside shard_map with the intermediate dim sharded over a
     tensor-parallel axis (column-parallel gate/up, row-parallel down), the
     down-proj partial sums are psum-reduced over that axis."""
-    out = (jax.nn.silu(x @ w_gate) * (x @ w_up)) @ w_down
+    out = dense(jax.nn.silu(dense(x, w_gate)) * dense(x, w_up), w_down)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out

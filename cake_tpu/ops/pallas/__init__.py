@@ -46,6 +46,7 @@ from cake_tpu.ops.pallas.flash import (  # noqa: E402
     flash_decode,
 )
 from cake_tpu.ops.pallas.fused import rms_norm_pallas  # noqa: E402
+from cake_tpu.ops.pallas.quant import quant_matmul_pallas  # noqa: E402
 
 __all__ = [
     "kernels_enabled",
@@ -54,4 +55,5 @@ __all__ = [
     "flash_attention",
     "flash_decode",
     "rms_norm_pallas",
+    "quant_matmul_pallas",
 ]

@@ -1,0 +1,88 @@
+"""Pallas int8-weight matmul: ``y = (x @ q_int8) * scale`` fused.
+
+The int8 weights stream HBM→VMEM at half the bf16 bytes (the decode
+bottleneck), are converted to the activation dtype in VMEM, hit the MXU with
+f32 accumulation, and the per-output-channel dequant scale is applied in the
+epilogue — the dequantized weights never exist in HBM (the XLA fallback in
+:func:`cake_tpu.ops.quant.quant_matmul_xla` relies on convert-into-dot
+fusion instead).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _pick_block(n: int, preferred: int) -> int:
+    b = 1
+    while b * 2 <= min(n, preferred) and n % (b * 2) == 0:
+        b *= 2
+    return b
+
+
+def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, num_k_blocks: int):
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    x = x_ref[:]  # [BM, BK] activation dtype
+    w = q_ref[:].astype(x.dtype)  # [BK, BN] int8 -> activation dtype in VMEM
+    acc_ref[:] += jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _finish():
+        o_ref[:] = (acc_ref[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def quant_matmul_pallas(
+    x: jax.Array,  # [M, K]
+    q: jax.Array,  # [K, N] int8
+    scale: jax.Array,  # [N] f32
+    *,
+    block_m: int = 256,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused int8-weight matmul with per-channel dequant epilogue."""
+    m, k = x.shape
+    n = q.shape[1]
+    bm = _pick_block(m, block_m)
+    bn = _pick_block(n, block_n)
+    bk = _pick_block(k, block_k)
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, num_k_blocks=k // bk),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        grid=(m // bm, n // bn, k // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((bk, bn), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec((1, bn), lambda i, j, kb: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=m * k * x.dtype.itemsize + k * n + m * n * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x, q, scale.reshape(1, n).astype(jnp.float32))
+    return out

@@ -1,0 +1,142 @@
+"""Int8 weight quantization (per-output-channel, symmetric).
+
+The reference runs f16/bf16 weights only (dtype plane, `cake/mod.rs:56-62`);
+int8 is a capability the TPU build adds because it is load-bearing for the
+70B-on-v5e-16 target (SURVEY.md §7: ~8.75 GB f16 weights + KV per 16 GB chip
+leaves no headroom — int8 halves the weight bytes and decode is
+HBM-bandwidth-bound, so it is also a throughput lever).
+
+Scheme: symmetric per-output-channel absmax. For a weight ``w [in, out]``
+(or stacked ``[L, in, out]``): ``scale = absmax(w, axis=in) / 127``,
+``q = round(w / scale)`` in int8. Matmul dequantizes in the epilogue:
+``y = (x @ q) * scale`` — the int8 weights stream from HBM at half the bf16
+bytes and the MXU accumulates in f32 (on TPU via the Pallas kernel in
+:mod:`cake_tpu.ops.pallas.quant`; elsewhere XLA fuses the int8→bf16 convert
+into the dot).
+
+Every linear site in the model goes through :func:`dense`, which accepts
+either a plain array or a :class:`QuantizedLinear` — quantization is a pure
+params-pytree transform (:func:`quantize_params`), no model code changes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["q", "scale"],
+    meta_fields=[],
+)
+@dataclasses.dataclass
+class QuantizedLinear:
+    """int8 weight + f32 per-output-channel scale.
+
+    ``q: [..., in, out] int8``, ``scale: [..., out] f32`` (leading axes — the
+    stacked layer axis — are shared)."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def quantize_linear(w: jax.Array) -> QuantizedLinear:
+    """Symmetric per-output-channel int8 quantization of ``w [..., in, out]``."""
+    wf = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=-2)  # [..., out]
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127).astype(jnp.int8)
+    return QuantizedLinear(q=q, scale=scale)
+
+
+def quantize_linear_np(w) -> tuple:
+    """Host-side (numpy) variant of :func:`quantize_linear` for quantize-
+    during-load: the bf16 weight never reaches the device, so peak HBM is the
+    int8 bytes, not bf16 + temporaries. Returns ``(q int8, scale f32)``."""
+    import numpy as np
+
+    wf = np.asarray(w, np.float32)
+    absmax = np.max(np.abs(wf), axis=-2)
+    scale = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+    q = np.clip(np.round(wf / scale[..., None, :]), -127, 127).astype(np.int8)
+    return q, scale
+
+
+# Linear weight names eligible for quantization (norms/embed stay bf16; the
+# embedding is a gather, not a matmul, and norm scales are tiny).
+LAYER_LINEARS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize every linear in a params pytree (model or stage slice).
+
+    Works on full params (embed/norm_f/lm_head + layers) and on bare stacked
+    layer pytrees (a worker's slice)."""
+    out = dict(params)
+    if "layers" in params:
+        out["layers"] = {
+            k: (quantize_linear(v) if k in LAYER_LINEARS else v)
+            for k, v in params["layers"].items()
+        }
+    elif all(k in params for k in ("wq", "wo")):  # bare layer-stack pytree
+        return {
+            k: (quantize_linear(v) if k in LAYER_LINEARS else v)
+            for k, v in params.items()
+        }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_linear(params["lm_head"])
+    return out
+
+
+def dequantize_linear(w: QuantizedLinear, dtype=jnp.bfloat16) -> jax.Array:
+    return (w.q.astype(jnp.float32) * w.scale[..., None, :]).astype(dtype)
+
+
+def quant_matmul_xla(x: jax.Array, q: jax.Array, scale: jax.Array) -> jax.Array:
+    """Fallback path: XLA fuses the int8→x.dtype convert into the dot."""
+    y = jnp.dot(x, q.astype(x.dtype), preferred_element_type=jnp.float32)
+    return (y * scale).astype(x.dtype)
+
+
+def quant_matmul(
+    x: jax.Array,  # [..., in]
+    q: jax.Array,  # [in, out] int8
+    scale: jax.Array,  # [out] f32
+    impl: str = "auto",
+) -> jax.Array:
+    from cake_tpu.ops import pallas as pk
+
+    if impl == "auto":
+        impl = (
+            "pallas"
+            if pk.kernels_enabled()
+            and (
+                pk.interpret_default()
+                or (q.shape[0] % 256 == 0 and q.shape[1] % 256 == 0)
+            )
+            else "xla"
+        )
+    if impl == "pallas":
+        from cake_tpu.ops.pallas.quant import quant_matmul_pallas
+
+        lead_shape = x.shape[:-1]
+        y = quant_matmul_pallas(x.reshape(-1, x.shape[-1]), q, scale)
+        return y.reshape(*lead_shape, q.shape[1])
+    return quant_matmul_xla(x, q, scale)
+
+
+def out_features(w) -> int:
+    """Output width of a linear weight (plain or quantized)."""
+    return (w.q if isinstance(w, QuantizedLinear) else w).shape[-1]
+
+
+def dense(x: jax.Array, w) -> jax.Array:
+    """``x @ w`` for either a plain array or a :class:`QuantizedLinear` —
+    the single dispatch point every linear in the model routes through."""
+    if isinstance(w, QuantizedLinear):
+        return quant_matmul(x, w.q, w.scale)
+    return x @ w

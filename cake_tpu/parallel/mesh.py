@@ -69,12 +69,17 @@ def validate_shardable(config: LlamaConfig, num_stages: int, tp: int) -> None:
             raise ValueError(f"{name} {dim} not divisible by tp {tp}")
 
 
-def param_specs() -> dict:
+def param_specs(params: dict | None = None) -> dict:
     """PartitionSpec pytree matching the params layout (models/llama.py):
     layer axis -> stage; head/intermediate out-features -> tp (column-
     parallel); wo/w_down in-features -> tp (row-parallel); norms and embed
-    replicated; lm_head vocab -> tp."""
-    return {
+    replicated; lm_head vocab -> tp.
+
+    Pass ``params`` to get specs matching its structure where linears may be
+    int8-quantized (ops.quant.QuantizedLinear): the q tensor takes the
+    weight's spec, the per-output-channel scale takes the spec minus the
+    in-features axis."""
+    base = {
         "embed": P(None, None),
         "layers": {
             "attn_norm": P(STAGE, None),
@@ -90,6 +95,19 @@ def param_specs() -> dict:
         "norm_f": P(None),
         "lm_head": P(None, TP),
     }
+    if params is None:
+        return base
+    from cake_tpu.ops.quant import QuantizedLinear
+
+    def refine(p, s):
+        if isinstance(p, dict):
+            return {k: refine(p[k], s[k]) for k in p}
+        if isinstance(p, QuantizedLinear):
+            scale_spec = P(*(tuple(s)[:-2] + (s[-1],)))
+            return QuantizedLinear(q=s, scale=scale_spec)
+        return s
+
+    return refine(params, base)
 
 
 # KV cache [L, B, kv_heads, max_seq, head_dim]: layers over stage, batch over
@@ -99,7 +117,7 @@ CACHE_SPEC = P(STAGE, DP, TP, None, None)
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a (host or single-device) params pytree onto the mesh."""
-    specs = param_specs()
+    specs = param_specs(params)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
     )

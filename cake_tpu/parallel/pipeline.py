@@ -37,7 +37,7 @@ from jax.sharding import PartitionSpec as P
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.models import llama
-from cake_tpu.ops import sampling
+from cake_tpu.ops import quant, sampling
 from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import rope_tables
@@ -94,7 +94,7 @@ def _select_stage0(x: jax.Array) -> jax.Array:
 def _head_logits(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
     """ln_f + vocab-sharded lm_head; full logits gathered over tp."""
     x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
-    logits_local = (x_last @ params["lm_head"]).astype(jnp.float32)
+    logits_local = quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
     return jax.lax.all_gather(logits_local, TP, axis=-1, tiled=True)
 
 
@@ -104,12 +104,15 @@ def _dp_fold(key: jax.Array) -> jax.Array:
 
 
 def build_sharded_decode(
-    config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan
+    config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
+    params_like: dict | None = None,
 ):
     """Compile the fused multi-chip decode step.
 
     Signature: ``(params, token [B], cache, pos, key, history [B, N],
     hist_slot) -> (next_token [B], cache, history, hist_slot)``.
+    ``params_like``: pass the params pytree (or a structural twin) when some
+    linears are int8-quantized so the shard_map specs match.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
 
@@ -130,7 +133,7 @@ def build_sharded_decode(
         step,
         mesh=plan.mesh,
         in_specs=(
-            param_specs(),
+            param_specs(params_like),
             P(DP),
             KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
             P(),
@@ -149,7 +152,8 @@ def build_sharded_decode(
     return jax.jit(sharded, donate_argnums=(2,))
 
 
-def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan):
+def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
+                          params_like: dict | None = None):
     """Compile the multi-chip prompt pass.
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
@@ -177,7 +181,7 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan):
         step,
         mesh=plan.mesh,
         in_specs=(
-            param_specs(),
+            param_specs(params_like),
             P(DP, None),
             KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
             P(DP),

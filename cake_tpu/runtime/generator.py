@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.models import llama
+from cake_tpu.ops import quant
 from cake_tpu.ops.kvcache import KVCache, init_cache
 from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops import sampling
@@ -59,7 +60,7 @@ def _bucket(n: int, max_seq: int, floor: int = 16) -> int:
 
 def _lm_head(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
     x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
-    return (x_last @ params["lm_head"]).astype(jnp.float32)
+    return quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
 
 
 def prefill_fn(params, tokens, cache: KVCache, last_index, config: LlamaConfig):

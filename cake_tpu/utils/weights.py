@@ -47,11 +47,21 @@ def params_from_hf_tensors(
     tie_word_embeddings: bool = False,
     include_embed: bool = True,
     include_head: bool = True,
+    quantize: str | None = None,
 ) -> dict:
     """Build the params pytree from a tensor lookup ``get(hf_name)``.
 
     ``layer_range=(lo, hi)`` loads only blocks ``lo..hi-1`` (still stacked,
-    dense from 0) — the worker/stage path."""
+    dense from 0) — the worker/stage path.
+
+    ``quantize="int8"`` quantizes every linear *on the host as it streams in*
+    (per-output-channel symmetric int8, ops.quant) — the bf16 weights never
+    reach the device, so peak HBM is the int8 bytes. Norms and the embedding
+    stay in ``dtype``."""
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantize={quantize!r}")
+    from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear_np
+
     lo, hi = layer_range or (0, num_layers)
     dt = jnp.dtype(dtype)
 
@@ -59,13 +69,25 @@ def params_from_hf_tensors(
     if hi > lo:
         layers = {}
         for ours, (suffix, transpose) in _LAYER_MAP.items():
-            per = []
+            do_quant = quantize == "int8" and ours in LAYER_LINEARS
+            per, scales = [], []
             for i in range(lo, hi):
                 w = np.asarray(get(f"model.layers.{i}.{suffix}"))
                 if transpose:
                     w = w.T
-                per.append(w)
-            layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
+                if do_quant:
+                    q, s = quantize_linear_np(w)
+                    per.append(q)
+                    scales.append(s)
+                else:
+                    per.append(w)
+            if do_quant:
+                layers[ours] = QuantizedLinear(
+                    q=jnp.asarray(np.stack(per)),
+                    scale=jnp.asarray(np.stack(scales)),
+                )
+            else:
+                layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
         params["layers"] = layers
     if include_embed:
         params["embed"] = jnp.asarray(np.asarray(get("model.embed_tokens.weight"))).astype(dt)
@@ -74,7 +96,12 @@ def params_from_hf_tensors(
         head_name = (
             "model.embed_tokens.weight" if tie_word_embeddings else "lm_head.weight"
         )
-        params["lm_head"] = jnp.asarray(np.asarray(get(head_name)).T).astype(dt)
+        head = np.asarray(get(head_name)).T
+        if quantize == "int8":
+            q, s = quantize_linear_np(head)
+            params["lm_head"] = QuantizedLinear(q=jnp.asarray(q), scale=jnp.asarray(s))
+        else:
+            params["lm_head"] = jnp.asarray(head).astype(dt)
     return params
 
 
@@ -105,6 +132,7 @@ def load_llama_params(
     tie_word_embeddings: bool = False,
     include_embed: bool = True,
     include_head: bool = True,
+    quantize: str | None = None,
 ) -> dict:
     """Load a Llama checkpoint directory into the params pytree.
 
@@ -133,6 +161,7 @@ def load_llama_params(
             tie_word_embeddings=tie_word_embeddings,
             include_embed=include_embed,
             include_head=include_head,
+            quantize=quantize,
         )
     finally:
         for h in handles.values():

@@ -1,0 +1,154 @@
+"""Int8 weight quantization: round-trip accuracy, kernel parity, model
+quality, and sharded execution of quantized params."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops import quant
+from cake_tpu.ops.kvcache import init_cache
+from cake_tpu.ops.pallas.quant import quant_matmul_pallas
+from cake_tpu.ops.quant import (
+    QuantizedLinear,
+    dense,
+    dequantize_linear,
+    quantize_linear,
+    quantize_params,
+)
+
+
+def test_quantize_round_trip():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    ql = quantize_linear(w)
+    assert ql.q.dtype == jnp.int8 and ql.scale.shape == (32,)
+    back = dequantize_linear(ql, jnp.float32)
+    # max error bounded by half a quantization step per channel
+    step = np.asarray(ql.scale)
+    err = np.abs(np.asarray(back) - np.asarray(w))
+    assert (err <= 0.5 * step[None, :] + 1e-7).all()
+
+
+def test_quantize_stacked_scale_axes():
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 16, 8), jnp.float32)
+    ql = quantize_linear(w)
+    assert ql.q.shape == (3, 16, 8)
+    assert ql.scale.shape == (3, 8)
+
+
+def test_quant_matmul_pallas_matches_xla():
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (8, 64), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (64, 32), jnp.float32)
+    ql = quantize_linear(w)
+    ref = quant.quant_matmul_xla(x, ql.q, ql.scale)
+    out = quant_matmul_pallas(x, ql.q, ql.scale, block_m=4, block_n=8,
+                              block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_dense_dispatch():
+    x = jnp.ones((2, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+    np.testing.assert_allclose(np.asarray(dense(x, w)), 8.0)
+    out = dense(x, quantize_linear(w))
+    np.testing.assert_allclose(np.asarray(out), 8.0, rtol=1e-2)
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return tiny(max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def params(cfg):
+    return llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_quantized_model_logits_close(cfg, params):
+    qparams = quantize_params(params)
+    assert isinstance(qparams["layers"]["wq"], QuantizedLinear)
+    assert isinstance(qparams["lm_head"], QuantizedLinear)
+    assert not isinstance(qparams["layers"]["attn_norm"], QuantizedLinear)
+    ids = [3, 1, 4, 1, 5, 9, 2, 6]
+    tokens = jnp.asarray([ids], jnp.int32)
+    logits_f, _ = llama.forward(
+        params, tokens, init_cache(cfg, 1, cfg.max_seq_len), 0, cfg
+    )
+    logits_q, _ = llama.forward(
+        qparams, tokens, init_cache(cfg, 1, cfg.max_seq_len), 0, cfg
+    )
+    a = np.asarray(logits_f[0], np.float64)
+    b = np.asarray(logits_q[0], np.float64)
+    cos = a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+    assert cos > 0.99, f"cosine similarity {cos}"
+
+
+def test_quantized_generation_runs(cfg, params):
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    g = LlamaGenerator(cfg, quantize_params(params),
+                       settings=SamplerSettings(temperature=0.0))
+    g.set_prompt([3, 1, 4])
+    ids = [g.next_token(i).id for i in range(6)]
+    assert len(ids) == 6
+    assert all(0 <= t < cfg.vocab_size for t in ids)
+
+
+def test_quantize_during_load_matches_posthoc(cfg, params, tmp_path):
+    """load_llama_params(quantize='int8') (host-side, streaming) produces the
+    same pytree as loading bf16 then quantize_params."""
+    from cake_tpu.utils.weights import load_llama_params, save_llama_params
+
+    save_llama_params(params, tmp_path)
+    loaded_q = load_llama_params(
+        tmp_path, cfg.num_hidden_layers, dtype="float32", quantize="int8"
+    )
+    posthoc = quantize_params(
+        load_llama_params(tmp_path, cfg.num_hidden_layers, dtype="float32")
+    )
+    for name in ("wq", "w_down"):
+        np.testing.assert_array_equal(
+            np.asarray(loaded_q["layers"][name].q),
+            np.asarray(posthoc["layers"][name].q),
+        )
+        np.testing.assert_allclose(
+            np.asarray(loaded_q["layers"][name].scale),
+            np.asarray(posthoc["layers"][name].scale), rtol=1e-6,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(loaded_q["lm_head"].q), np.asarray(posthoc["lm_head"].q)
+    )
+    # norms/embed stay plain
+    assert not isinstance(loaded_q["layers"]["attn_norm"], QuantizedLinear)
+    assert not isinstance(loaded_q["embed"], QuantizedLinear)
+
+
+def test_quantized_sharded_pipeline(cfg, params):
+    """Quantized params shard over (stage, tp) and decode in one program."""
+    from cake_tpu.ops import sampling
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+    from cake_tpu.parallel.pipeline import build_sharded_decode
+
+    qparams = quantize_params(params)
+    plan = MeshPlan.build(cfg, num_stages=2, tp=2)
+    sp = shard_params(qparams, plan.mesh)
+    settings = SamplerSettings(temperature=0.0)
+    decode = build_sharded_decode(cfg, settings, plan, params_like=qparams)
+    cache = shard_cache(init_cache(cfg, 1, cfg.max_seq_len), plan.mesh)
+    history, hist_slot = sampling.init_history(settings.repeat_last_n)
+    tok, cache, history, hist_slot = decode(
+        sp, jnp.asarray([5], jnp.int32), cache, jnp.int32(0),
+        jax.random.PRNGKey(0), history[None, :], hist_slot,
+    )
+    # parity with the unsharded quantized model
+    logits_ref, _ = llama.forward(
+        qparams, jnp.asarray([[5]], jnp.int32),
+        init_cache(cfg, 1, cfg.max_seq_len), 0, cfg,
+    )
+    assert int(tok[0]) == int(jnp.argmax(logits_ref[0]))
