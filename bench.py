@@ -85,6 +85,8 @@ def main() -> int:
     # HBM, step down and say so (blocked inside the try so async allocation
     # failures are actually caught here, not at first use).
     quant = os.environ.get("CAKE_BENCH_QUANT", "")
+    if quant not in ("", "int8"):
+        sys.exit(f"error: CAKE_BENCH_QUANT must be 'int8', got {quant!r}")
     ladder = ["8b", "small", "tiny"]
     params = config = None
     for p in ladder[ladder.index(preset):]:

@@ -93,12 +93,18 @@ def block_forward(
     num_heads: int | None = None,
     num_kv_heads: int | None = None,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    sp_size: int = 1,
+    write_gate: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One pre-norm decoder block (transformer.rs:48-64).
 
     Under tensor parallelism (inside shard_map), ``num_heads``/``num_kv_heads``
     are the per-device local counts and ``tp_axis`` names the mesh axis the
     row-parallel projections reduce over; the norm weights are replicated.
+    ``sp_axis``/``sp_size``: sequence-parallel attention (ring prefill /
+    distributed flash decode, see :mod:`cake_tpu.ops.ring`); the MLP needs no
+    communication — it is elementwise over the sharded sequence.
     """
     h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     attn_out, k_cache, v_cache = self_attention_block(
@@ -107,6 +113,9 @@ def block_forward(
         num_heads or config.num_attention_heads,
         num_kv_heads or config.num_key_value_heads,
         tp_axis=tp_axis,
+        sp_axis=sp_axis,
+        sp_size=sp_size,
+        write_gate=write_gate,
     )
     x = x + attn_out
     h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
@@ -126,6 +135,9 @@ def forward_layers(
     num_heads: int | None = None,
     num_kv_heads: int | None = None,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    sp_size: int = 1,
+    write_gate: jax.Array | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run a contiguous run of decoder blocks via ``lax.scan``.
 
@@ -139,7 +151,8 @@ def forward_layers(
         layer, kc, vc = per_layer
         h, kc, vc = block_forward(layer, h, kc, vc, cos, sin, pos, config,
                                   num_heads=num_heads, num_kv_heads=num_kv_heads,
-                                  tp_axis=tp_axis)
+                                  tp_axis=tp_axis, sp_axis=sp_axis,
+                                  sp_size=sp_size, write_gate=write_gate)
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))

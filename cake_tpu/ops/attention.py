@@ -113,6 +113,9 @@ def self_attention_block(
     num_heads: int,
     num_kv_heads: int,
     tp_axis: str | None = None,
+    sp_axis: str | None = None,
+    sp_size: int = 1,
+    write_gate: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One attention sublayer incl. cache update.
 
@@ -123,6 +126,21 @@ def self_attention_block(
     parallel mesh axis (Megatron-style: column-parallel qkv, row-parallel
     o_proj), pass the axis name — the o_proj partial sums are psum-reduced
     over it. ``num_heads``/``num_kv_heads`` are then the *local* counts.
+
+    ``sp_axis``: sequence/context parallelism (:mod:`cake_tpu.ops.ring`).
+    The cache's sequence axis is sharded over this mesh axis; shard *i* owns
+    global positions ``[i*S_l, (i+1)*S_l)``. Two modes:
+
+    - prefill (``T > 1``): ``x`` holds this shard's slice of the *full* cache
+      window (``T == S_l``, ``pos == 0``) — ring attention over the sp ring.
+    - decode (``T == 1``): ``x`` is replicated; the owner shard commits the
+      new KV slot and exact softmax is reassembled from per-shard partials
+      (distributed flash decoding).
+
+    ``write_gate`` (scalar bool): when running inside an SPMD-uniform pipeline
+    loop every stage executes this code every step (collectives must be
+    uniform across devices — a conditional ppermute/psum deadlocks); the gate
+    makes the KV commit predicated so only the active stage's write lands.
     """
     b, t, hidden = x.shape
     d = quant.out_features(wq) // num_heads
@@ -131,12 +149,42 @@ def self_attention_block(
     k = quant.dense(x, wk).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
     v = quant.dense(x, wv).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
 
-    q = apply_rope(q, cos, sin, pos)
-    k = apply_rope(k, cos, sin, pos)
+    if sp_axis is not None and sp_size > 1:
+        from cake_tpu.ops import ring
 
-    k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, pos)
+        s_l = k_cache.shape[2]
+        sp_idx = jax.lax.axis_index(sp_axis)
+        if t > 1:
+            # Sequence-parallel prefill over the full padded cache window.
+            if t != s_l:
+                raise ValueError(
+                    f"sp prefill requires the full cache window per shard "
+                    f"(T_local {t} != S_local {s_l}); pad the prompt to "
+                    "max_seq before sharding"
+                )
+            my_off = sp_idx * t  # global position of this shard's token 0
+            q = apply_rope(q, cos, sin, my_off)
+            k = apply_rope(k, cos, sin, my_off)
+            k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, 0,
+                                               gate=write_gate)
+            out = ring.ring_attention(q, k, v, sp_axis, sp_size, q_off=my_off)
+        else:
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            shard_start = sp_idx * s_l
+            k_cache, v_cache = ring.sp_cache_write(
+                k_cache, v_cache, k, v, pos, shard_start, gate=write_gate
+            )
+            out = ring.sp_decode_attend(
+                q, k_cache, v_cache, pos, sp_axis, shard_start
+            )
+    else:
+        q = apply_rope(q, cos, sin, pos)
+        k = apply_rope(k, cos, sin, pos)
+        k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, pos,
+                                           gate=write_gate)
+        out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
 
-    out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
     out = quant.dense(out, wo)
     if tp_axis is not None:

@@ -84,6 +84,7 @@ def update_layer(
     k_new: jax.Array,
     v_new: jax.Array,
     pos: jax.Array,
+    gate: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Write ``k_new/v_new [batch, kv_heads, T, head_dim]`` into one layer's
     buffers ``[batch, kv_heads, max_seq, head_dim]`` at sequence offset ``pos``.
@@ -91,9 +92,24 @@ def update_layer(
     Replaces the reference's `process_kv` concat (cache.rs:106-135) — including
     *not* reproducing its axis-confused trimming bug (length checks on the
     heads axis, narrow on head_dim; see SURVEY.md §2).
+
+    ``gate`` (scalar bool): predicated write for SPMD-uniform pipelines — when
+    false the current slot contents are rewritten unchanged, so every device
+    executes the identical program (collectives stay uniform) and only the
+    active pipeline stage commits. Gated off, the touched region is just the
+    ``T`` slots, not the whole buffer.
     """
-    zero = jnp.zeros((), jnp.int32)
-    start = (zero, zero, jnp.asarray(pos, jnp.int32), zero)
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k_new.astype(k_cache.dtype), start)
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v_new.astype(v_cache.dtype), start)
-    return k_cache, v_cache
+    t = k_new.shape[2]
+
+    def write(cache, new):
+        new = new.astype(cache.dtype)
+        if gate is not None:
+            cur = jax.lax.dynamic_slice_in_dim(
+                cache, jnp.asarray(pos, jnp.int32), t, axis=2
+            )
+            new = jnp.where(gate, new, cur)
+        zero = jnp.zeros((), jnp.int32)
+        start = (zero, zero, jnp.asarray(pos, jnp.int32), zero)
+        return jax.lax.dynamic_update_slice(cache, new, start)
+
+    return write(k_cache, k_new), write(v_cache, v_new)

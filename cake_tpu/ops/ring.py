@@ -1,0 +1,213 @@
+"""Ring attention + sequence-parallel decode (context parallelism).
+
+The reference has **no** long-context story: a hard ``MAX_SEQ_LEN = 4096``
+cap baked into its RoPE tables and masks (`config.rs:6`, `cache.rs:40-43`),
+and no sequence/context parallelism of any kind (SURVEY.md §5). This module
+is the TPU-native capability the reference lacks, built the way the hardware
+wants it:
+
+- **Prefill — ring attention.** The sequence is sharded over an ``sp`` mesh
+  axis; each device holds one query block and one KV block. KV blocks rotate
+  around the ring with ``lax.ppermute`` (compiler-scheduled ICI DMA between
+  neighbors) while each device folds the visiting block into a blockwise
+  online softmax (running max / sum / accumulator, all f32). Attention over
+  a sequence of length S costs each chip O(S/n · S) FLOPs and only
+  neighbor-to-neighbor transfers — no all-gather of KV, no O(S²) score
+  materialization.
+- **Decode — distributed flash decoding.** The KV cache's sequence axis is
+  sharded over ``sp``; the single query token is replicated. Each device
+  attends over its local KV slice producing *partial* softmax stats
+  ``(o, m, l)``; the exact global softmax is reconstructed with one
+  ``pmax`` + two ``psum`` over the axis. Per step this moves only
+  ``[B, H, D]``-sized partials — independent of sequence length.
+
+Both paths share :func:`attend_stats`, whose masked-softmax numerics match
+:func:`cake_tpu.ops.attention._attend_xla` (f32 scores regardless of model
+dtype — the reference's attention.rs:62-77 convention) so sharded output is
+bit-comparable to the single-device oracle up to reduction order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def attend_stats(
+    q: jax.Array,  # [B, H, T, D]
+    k: jax.Array,  # [B, KH, S, D]
+    v: jax.Array,  # [B, KH, S, D]
+    q_off,  # scalar: global position of q[..., 0, :]
+    k_off,  # scalar: global position of k[..., 0, :]
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Partial causal GQA attention over one KV block.
+
+    Returns unnormalized ``(o [B,H,T,D] f32, m [B,H,T] f32, l [B,H,T] f32)``
+    — the blockwise online-softmax triple: row max, row sum of
+    ``exp(score - m)``, and the exp-weighted value accumulator. Partials from
+    different KV blocks combine exactly via :func:`merge_stats` /
+    :func:`combine_axis`.
+
+    Causality: key position ``k_off + s`` attends iff ``<= q_off + t``. Rows
+    with no valid key yield ``m = NEG_INF, l = 0, o = 0`` and drop out of any
+    merge.
+    """
+    b, n_heads, t, d = q.shape
+    kv_heads, s = k.shape[1], k.shape[2]
+    group = n_heads // kv_heads
+
+    qg = q.reshape(b, kv_heads, group, t, d)
+    scores = jnp.einsum(
+        "bkgtd,bksd->bkgts", qg, k, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(d))
+
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1) + jnp.asarray(k_off, jnp.int32)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0) + jnp.asarray(q_off, jnp.int32)
+    mask = kpos <= qpos  # [T, S]
+    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+
+    m = jnp.max(scores, axis=-1)  # [B, KH, G, T]
+    # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1, so re-mask.
+    p = jnp.where(mask[None, None, None], jnp.exp(scores - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bkgts,bksd->bkgtd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return (
+        o.reshape(b, n_heads, t, d),
+        m.reshape(b, n_heads, t),
+        l.reshape(b, n_heads, t),
+    )
+
+
+def merge_stats(o1, m1, l1, o2, m2, l2):
+    """Fold two online-softmax partials into one (associative)."""
+    m = jnp.maximum(m1, m2)
+    s1 = jnp.exp(m1 - m)
+    s2 = jnp.exp(m2 - m)
+    return (
+        o1 * s1[..., None] + o2 * s2[..., None],
+        m,
+        l1 * s1 + l2 * s2,
+    )
+
+
+def finalize_stats(o, m, l, dtype) -> jax.Array:
+    """Normalize the accumulator into attention output ``[B, H, T, D]``."""
+    del m
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(dtype)
+
+
+def combine_axis(o, m, l, axis_name: str):
+    """Exactly reduce partial stats held across a mesh axis.
+
+    One ``pmax`` (global row max) + two ``psum`` (rescaled accumulator and
+    denominator). Fully-masked shards carry ``m = NEG_INF`` and contribute 0.
+    """
+    m_g = jax.lax.pmax(m, axis_name)
+    scale = jnp.exp(m - m_g)
+    o_g = jax.lax.psum(o * scale[..., None], axis_name)
+    l_g = jax.lax.psum(l * scale, axis_name)
+    return o_g, m_g, l_g
+
+
+def ring_attention(
+    q: jax.Array,  # [B, H, T_l, D] local query block (already roped)
+    k: jax.Array,  # [B, KH, T_l, D] local key block
+    v: jax.Array,  # [B, KH, T_l, D] local value block
+    axis_name: str,
+    axis_size: int,
+    q_off,  # scalar: global position of this shard's q[..., 0, :]
+    chunk_starts: jax.Array | None = None,  # [axis_size] global start per shard
+) -> jax.Array:
+    """Causal ring attention inside ``shard_map`` over ``axis_name``.
+
+    Each of the ``axis_size`` devices holds contiguous blocks of Q and KV.
+    KV (with its block origin) rotates around the ring ``axis_size`` times via
+    ``ppermute``; each visit folds into the online softmax. Returns
+    ``[B, H, T_l, D]`` in ``q.dtype``.
+
+    ``chunk_starts[i]`` is the global position of shard *i*'s ``k[..., 0, :]``;
+    defaults to the uniform layout ``i * T_l``.
+    """
+    b, n_heads, t, d = q.shape
+    if axis_size == 1:
+        o, m, l = attend_stats(q, k, v, q_off, 0 if chunk_starts is None else chunk_starts[0])
+        return finalize_stats(o, m, l, q.dtype)
+
+    my = jax.lax.axis_index(axis_name)
+    if chunk_starts is None:
+        chunk_starts = jnp.arange(axis_size, dtype=jnp.int32) * k.shape[2]
+    # Send our KV block to the next rank each step; after `step` rotations we
+    # hold the block that originated at rank (my - step) mod n.
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    o = jnp.zeros((b, n_heads, t, d), jnp.float32)
+    m = jnp.full((b, n_heads, t), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, n_heads, t), jnp.float32)
+
+    def body(step, carry):
+        k, v, o, m, l = carry
+        src = (my - step) % axis_size
+        o_p, m_p, l_p = attend_stats(q, k, v, q_off, chunk_starts[src])
+        o, m, l = merge_stats(o, m, l, o_p, m_p, l_p)
+        # Rotate the KV block to the neighbor (the final rotation restores
+        # the original layout, so the cache leaves this function unmoved).
+        k, v = jax.lax.ppermute((k, v), axis_name, perm)
+        return k, v, o, m, l
+
+    k, v, o, m, l = jax.lax.fori_loop(0, axis_size, body, (k, v, o, m, l))
+    return finalize_stats(o, m, l, q.dtype)
+
+
+def sp_decode_attend(
+    q: jax.Array,  # [B, H, 1, D] (replicated across sp, already roped)
+    k_local: jax.Array,  # [B, KH, S_l, D] this shard's KV slice
+    v_local: jax.Array,
+    pos,  # scalar: global position of the query token
+    axis_name: str,
+    shard_start,  # scalar: global position of k_local[..., 0, :]
+) -> jax.Array:
+    """Distributed flash decoding over a sequence-sharded KV cache.
+
+    Each shard computes partial stats over its slice (keys beyond the causal
+    frontier ``pos`` masked), then the exact softmax is reassembled with one
+    pmax + two psum. Traffic per step is O(B·H·D), independent of S.
+    """
+    o, m, l = attend_stats(q, k_local, v_local, pos, shard_start)
+    o, m, l = combine_axis(o, m, l, axis_name)
+    return finalize_stats(o, m, l, q.dtype)
+
+
+def sp_cache_write(
+    k_cache: jax.Array,  # [B, KH, S_l, D] local slice
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, KH, 1, D]
+    v_new: jax.Array,
+    pos,  # scalar global write position
+    shard_start,  # scalar global position of this shard's slot 0
+    gate: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Owner-masked single-slot write into a sequence-sharded cache.
+
+    Every shard executes the same program (SPMD); only the shard whose range
+    contains ``pos`` commits the new KV — the rest rewrite their current slot
+    value, which XLA lowers to an in-place dynamic-update on donated buffers.
+    ``gate``: additional scalar predicate (pipeline-stage activity) ANDed in.
+    """
+    s_l = k_cache.shape[2]
+    local = jnp.asarray(pos, jnp.int32) - jnp.asarray(shard_start, jnp.int32)
+    owner = (local >= 0) & (local < s_l)
+    if gate is not None:
+        owner = owner & gate
+    off = jnp.clip(local, 0, s_l - 1)
+
+    def write(cache, new):
+        cur = jax.lax.dynamic_slice_in_dim(cache, off, 1, axis=2)
+        val = jnp.where(owner, new.astype(cache.dtype), cur)
+        return jax.lax.dynamic_update_slice_in_dim(cache, val, off, axis=2)
+
+    return write(k_cache, k_new), write(v_cache, v_new)

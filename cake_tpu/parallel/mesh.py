@@ -13,12 +13,19 @@ one TCP worker per host with activations serialized over sockets
   intermediate shard here; row-parallel projections psum over it. The
   reference has no tensor parallelism (SURVEY.md §2 "not present") — on TPU
   it is the main single-token latency lever, so it is first-class.
+- ``sp`` — sequence/context parallelism: the KV cache's sequence axis shards
+  here; long prefill runs ring attention around the ``sp`` ring
+  (:mod:`cake_tpu.ops.ring`) and decode reassembles exact softmax from
+  per-shard partials. The reference hard-caps context at 4096 with no
+  sequence parallelism at all (SURVEY.md §5) — on TPU this is the
+  long-context axis.
 - ``dp`` — data/batch parallelism for multi-stream serving (also absent in
   the single-request reference).
 
 All collectives ride ICI when the mesh maps onto one slice; DCN only across
-slices (mesh construction keeps axis order ``(dp, stage, tp)`` so ``tp`` —
-the chattiest axis — lands on the innermost, fastest rings).
+slices (mesh construction keeps axis order ``(dp, stage, sp, tp)`` so ``tp``
+— the chattiest axis — lands on the innermost, fastest rings, with the
+``sp`` ring next).
 """
 
 from __future__ import annotations
@@ -31,29 +38,35 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cake_tpu.models.config import LlamaConfig
 
-DP, STAGE, TP = "dp", "stage", "tp"
+DP, STAGE, SP, TP = "dp", "stage", "sp", "tp"
 
 
 def make_mesh(
     num_stages: int = 1,
     tp: int = 1,
     dp: int = 1,
+    sp: int = 1,
     devices=None,
 ) -> Mesh:
-    """Build a ``(dp, stage, tp)`` mesh from the flat device list."""
+    """Build a ``(dp, stage, sp, tp)`` mesh from the flat device list."""
     devices = list(devices if devices is not None else jax.devices())
-    need = num_stages * tp * dp
+    need = num_stages * tp * dp * sp
     if len(devices) < need:
         raise ValueError(
-            f"need {need} devices for dp={dp} x stage={num_stages} x tp={tp}, "
-            f"have {len(devices)}"
+            f"need {need} devices for dp={dp} x stage={num_stages} x sp={sp} "
+            f"x tp={tp}, have {len(devices)}"
         )
-    grid = np.array(devices[:need]).reshape(dp, num_stages, tp)
-    return Mesh(grid, (DP, STAGE, TP))
+    grid = np.array(devices[:need]).reshape(dp, num_stages, sp, tp)
+    return Mesh(grid, (DP, STAGE, SP, TP))
 
 
-def validate_shardable(config: LlamaConfig, num_stages: int, tp: int) -> None:
-    """Divisibility requirements for the (stage, tp) sharding."""
+def validate_shardable(config: LlamaConfig, num_stages: int, tp: int,
+                       sp: int = 1) -> None:
+    """Divisibility requirements for the (stage, sp, tp) sharding."""
+    if sp > 1 and config.max_seq_len % sp:
+        raise ValueError(
+            f"max_seq_len {config.max_seq_len} not divisible by sp {sp}"
+        )
     if config.num_hidden_layers % num_stages:
         raise ValueError(
             f"num_hidden_layers {config.num_hidden_layers} not divisible by "
@@ -111,8 +124,9 @@ def param_specs(params: dict | None = None) -> dict:
 
 
 # KV cache [L, B, kv_heads, max_seq, head_dim]: layers over stage, batch over
-# dp, kv heads over tp — KV memory splits across both mesh axes.
-CACHE_SPEC = P(STAGE, DP, TP, None, None)
+# dp, kv heads over tp, sequence over sp — KV memory splits across all of
+# stage, tp and sp, which is what lets 70B-class KV fit 16 GB chips.
+CACHE_SPEC = P(STAGE, DP, TP, SP, None)
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
@@ -140,17 +154,18 @@ class MeshPlan:
     num_stages: int
     tp: int
     dp: int
+    sp: int = 1
 
     @classmethod
     def build(cls, config: LlamaConfig, num_stages: int = 1, tp: int = 1,
-              dp: int = 1, devices=None) -> "MeshPlan":
-        validate_shardable(config, num_stages, tp)
-        return cls(mesh=make_mesh(num_stages, tp, dp, devices),
-                   num_stages=num_stages, tp=tp, dp=dp)
+              dp: int = 1, sp: int = 1, devices=None) -> "MeshPlan":
+        validate_shardable(config, num_stages, tp, sp)
+        return cls(mesh=make_mesh(num_stages, tp, dp, sp, devices),
+                   num_stages=num_stages, tp=tp, dp=dp, sp=sp)
 
     @classmethod
     def from_topology(cls, config: LlamaConfig, topology, tp: int = 1,
-                      dp: int = 1, devices=None) -> "MeshPlan":
+                      dp: int = 1, sp: int = 1, devices=None) -> "MeshPlan":
         """Derive the stage layout from a topology whose nodes carry mesh
         ``device`` indices.
 
@@ -187,5 +202,5 @@ class MeshPlan:
                         f"{want[0]}-{want[-1]}, got {node.layer_indices()}; "
                         "use the master/worker runtime for uneven ranges"
                     )
-        return cls.build(config, num_stages=num_stages, tp=tp, dp=dp,
+        return cls.build(config, num_stages=num_stages, tp=tp, dp=dp, sp=sp,
                          devices=devices)

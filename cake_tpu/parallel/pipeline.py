@@ -21,10 +21,14 @@ token. Here the *whole* per-token step (embed -> all pipeline stages -> norm
 
 Pipeline schedule: single-stream autoregressive decode is inherently
 sequential across layers, so the loop runs stages in turn (`lax.fori_loop`
-over S steps; stage s computes only at step s via `lax.cond`, everyone else
-passes through — matching the reference's "upstream workers idle while
-downstream compute" semantics, SURVEY.md §2) with a ppermute between steps.
-After S steps the fully-processed activation has returned to stage 0.
+over S steps with a ppermute between steps; after S steps the fully-processed
+activation has returned to stage 0). For SPMD validity every stage executes
+the layer math every step — collectives may not sit behind a per-stage
+branch — and only the active stage's effects land, via a gated KV write and
+an activation select (see `_pipeline_layers`). Wall-clock matches the
+reference's "upstream workers idle while downstream compute" semantics
+(SURVEY.md §2); inactive stages compute into a discarded select instead of
+idling.
 """
 
 from __future__ import annotations
@@ -42,7 +46,7 @@ from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops.sampling import SamplerSettings
-from cake_tpu.parallel.mesh import CACHE_SPEC, DP, STAGE, TP, MeshPlan, param_specs
+from cake_tpu.parallel.mesh import CACHE_SPEC, DP, SP, STAGE, TP, MeshPlan, param_specs
 
 
 def _local_counts(config: LlamaConfig, tp: int) -> tuple[int, int]:
@@ -61,25 +65,35 @@ def _pipeline_layers(
     num_stages: int,
     heads_l: int,
     kv_heads_l: int,
+    sp: int = 1,
 ):
-    """Run the staged pipeline loop. Returns (x_on_stage0, ck, cv)."""
+    """Run the staged pipeline loop. Returns (x_on_stage0, ck, cv).
+
+    SPMD-uniformity: every stage executes the layer math (and therefore every
+    collective — tp psum, sp ring ppermute, sp decode psum/pmax) on every
+    step. Collectives inside a per-stage ``lax.cond`` are invalid SPMD — XLA's
+    CollectivePermute is a whole-program rendezvous, so divergent branches
+    deadlock or pair mismatched iterations. Instead the *effects* are
+    predicated: the KV write is gated on ``step == my_stage`` and the
+    activation is selected. Wall-clock cost is identical — single-stream
+    pipeline stages are serialized either way ("upstream workers idle",
+    SURVEY.md §2); inactive stages just compute concurrently into a discarded
+    select instead of idling.
+    """
     my_stage = jax.lax.axis_index(STAGE)
     perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
 
-    def run(carry):
+    def body(step, carry):
         x, ck, cv = carry
+        active = step == my_stage
         h, new_cache = llama.forward_layers(
             layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
             num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+            sp_axis=SP, sp_size=sp, write_gate=active,
         )
-        return h, new_cache.k, new_cache.v
-
-    def body(step, carry):
-        x, ck, cv = jax.lax.cond(
-            step == my_stage, run, lambda c: c, carry
-        )
+        x = jnp.where(active, h, x)
         x = jax.lax.ppermute(x, STAGE, perm)
-        return x, ck, cv
+        return x, new_cache.k, new_cache.v
 
     return jax.lax.fori_loop(0, num_stages, body, (x, ck, cv))
 
@@ -89,6 +103,21 @@ def _select_stage0(x: jax.Array) -> jax.Array:
     where the pipeline completed)."""
     my_stage = jax.lax.axis_index(STAGE)
     return jax.lax.psum(jnp.where(my_stage == 0, x, jnp.zeros_like(x)), STAGE)
+
+
+def _select_last_sp(x: jax.Array, last_index: jax.Array, sp: int) -> jax.Array:
+    """Pick the hidden state at per-batch global position ``last_index`` from
+    a sequence-sharded activation ``x [B, T_l, H]``; the owner shard
+    contributes, everyone else zero, reassembled by psum over sp."""
+    idx = last_index.reshape(-1, 1, 1).astype(jnp.int32)
+    if sp == 1:
+        return jnp.take_along_axis(x, idx, axis=1)[:, 0, :]
+    t_l = x.shape[1]
+    local = idx - jax.lax.axis_index(SP) * t_l
+    ok = (local >= 0) & (local < t_l)
+    val = jnp.take_along_axis(x, jnp.clip(local, 0, t_l - 1), axis=1)[:, 0, :]
+    val = jnp.where(ok[:, 0, :], val, jnp.zeros_like(val))
+    return jax.lax.psum(val, SP)
 
 
 def _head_logits(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
@@ -117,11 +146,15 @@ def build_sharded_decode(
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
 
     def step(params, token, cache, pos, key, history, hist_slot):
-        cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+        # cache.max_seq inside shard_map is the per-shard slice; RoPE tables
+        # must cover global positions.
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta
+        )
         x = params["embed"][token[:, None]].astype(config.jax_dtype)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
-            plan.num_stages, heads_l, kv_heads_l,
+            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
         )
         x_last = _select_stage0(x[:, -1, :])
         logits = _head_logits(params, x_last, config)
@@ -157,22 +190,27 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     """Compile the multi-chip prompt pass.
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
-    (logits [B, vocab] f32, cache)``. ``T`` may be any bucketed length.
+    (logits [B, vocab] f32, cache)``. With ``plan.sp == 1``, ``T`` may be any
+    bucketed length; with sequence parallelism (``sp > 1``) ``T`` must equal
+    the cache window (pad the prompt to max_seq) — each sp shard then runs
+    ring attention over its ``T/sp`` slice (:mod:`cake_tpu.ops.ring`), and
+    positions past the prompt hold garbage KV that decode steps overwrite
+    slot-by-slot before they ever become attendable.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
 
     def step(params, tokens, cache, last_index):
-        cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta
+        )
         x = params["embed"][tokens].astype(config.jax_dtype)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
-            plan.num_stages, heads_l, kv_heads_l,
+            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
         )
         # slice the wanted position first so the cross-stage select moves
         # [B, hidden], not the whole [B, T, hidden] activation
-        x_last = jnp.take_along_axis(
-            x, last_index.reshape(-1, 1, 1).astype(jnp.int32), axis=1
-        )[:, 0, :]
+        x_last = _select_last_sp(x, last_index, plan.sp)
         x_last = _select_stage0(x_last)
         logits = _head_logits(params, x_last, config)
         return logits, KVCache(k=ck, v=cv)
@@ -182,7 +220,7 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
         mesh=plan.mesh,
         in_specs=(
             param_specs(params_like),
-            P(DP, None),
+            P(DP, SP),
             KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
             P(DP),
         ),

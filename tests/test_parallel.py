@@ -152,3 +152,93 @@ def test_from_topology_rejects_device_gaps():
     })
     with pytest.raises(ValueError, match="no gaps"):
         MeshPlan.from_topology(CFG, t)
+
+
+# ---------------------------------------------------------------------------
+# Sequence/context parallelism (sp axis): ring-attention prefill + distributed
+# flash decode must match the single-device oracle. The reference has no
+# long-context plane at all (SURVEY.md §5) — this is TPU-native capability.
+# ---------------------------------------------------------------------------
+
+
+def _padded(ids, batch=1):
+    """Pad the prompt to the full cache window (sp prefill contract)."""
+    full = ids + [0] * (CFG.max_seq_len - len(ids))
+    return jnp.tile(jnp.asarray([full], jnp.int32), (batch, 1))
+
+
+@pytest.mark.parametrize(
+    "stages,tp,dp,sp",
+    [(1, 1, 1, 2), (1, 1, 1, 4), (2, 1, 1, 2), (2, 2, 1, 2), (1, 2, 1, 4),
+     (1, 1, 2, 2)],
+)
+def test_sp_prefill_matches_unsharded(params, stages, tp, dp, sp):
+    plan = MeshPlan.build(CFG, num_stages=stages, tp=tp, dp=dp, sp=sp)
+    ids = [3, 1, 4, 1, 5, 9, 2, 6]
+    ref, _ = _reference_logits(params, ids)
+
+    prefill = build_sharded_prefill(CFG, plan)
+    sparams = shard_params(params, plan.mesh)
+    cache = shard_cache(
+        init_cache(CFG, batch=dp, max_seq=CFG.max_seq_len), plan.mesh
+    )
+    last = jnp.full((dp,), len(ids) - 1, jnp.int32)
+    logits, _ = prefill(sparams, _padded(ids, batch=dp), cache, last)
+    for b in range(dp):
+        np.testing.assert_allclose(
+            np.asarray(logits[b]), np.asarray(ref[0]), rtol=2e-4, atol=2e-4
+        )
+
+
+@pytest.mark.parametrize("stages,tp,dp,sp", [(1, 1, 1, 4), (2, 1, 1, 2),
+                                             (1, 2, 1, 2)])
+def test_sp_greedy_decode_matches_unsharded(params, stages, tp, dp, sp):
+    plan = MeshPlan.build(CFG, num_stages=stages, tp=tp, dp=dp, sp=sp)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    ids = [7, 3, 11, 2]
+    n_steps = 4
+
+    cache = init_cache(CFG, batch=1, max_seq=CFG.max_seq_len)
+    logits, cache = llama.forward(
+        params, jnp.asarray([ids], jnp.int32), cache, 0, CFG
+    )
+    expect = []
+    pos = len(ids)
+    for _ in range(n_steps):
+        t = int(jnp.argmax(logits[0]))
+        expect.append(t)
+        logits, cache = llama.forward(
+            params, jnp.asarray([[t]], jnp.int32), cache, pos, CFG
+        )
+        pos += 1
+
+    prefill = build_sharded_prefill(CFG, plan)
+    sparams = shard_params(params, plan.mesh)
+    cache_s = shard_cache(
+        init_cache(CFG, batch=dp, max_seq=CFG.max_seq_len), plan.mesh
+    )
+    last = jnp.full((dp,), len(ids) - 1, jnp.int32)
+    logits_s, cache_s = prefill(sparams, _padded(ids, batch=dp), cache_s, last)
+
+    decode = build_sharded_decode(CFG, settings, plan)
+    history = jnp.full((dp, settings.repeat_last_n), -1, jnp.int32)
+    hist_slot = jnp.int32(0)
+    key = jax.random.PRNGKey(0)
+    tok = jnp.argmax(logits_s, axis=-1).astype(jnp.int32)
+    got = [tok]
+    pos = jnp.int32(len(ids))
+    for _ in range(n_steps - 1):
+        tok, cache_s, history, hist_slot = decode(
+            sparams, tok, cache_s, pos, key, history, hist_slot
+        )
+        got.append(tok)
+        pos += 1
+
+    for b in range(dp):
+        stream = [int(t[b]) for t in got]
+        assert stream == expect, f"batch row {b}: {stream} != {expect}"
+
+
+def test_sp_validate_rejects_indivisible_window():
+    with pytest.raises(ValueError, match="sp"):
+        validate_shardable(tiny(max_seq_len=30), num_stages=1, tp=1, sp=4)
