@@ -1,0 +1,37 @@
+# Equivalent of the reference Makefile (build/test/lint/build_release targets,
+# Makefile:1-12) for the Python/C++ tree. The reference's ios_bindings/ios
+# targets map to `embed` (C-callable worker library, native/cake_embed.cc);
+# its rsync deploy targets are deployment-specific and intentionally omitted.
+
+PY ?= python
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+lint:
+	$(PY) -m compileall -q cake_tpu tests bench.py __graft_entry__.py
+	@if $(PY) -c 'import pyflakes' 2>/dev/null; then \
+	  $(PY) -m pyflakes cake_tpu tests bench.py __graft_entry__.py; fi
+
+native: native/libcakewire.so native/libcakeembed.so
+
+native/libcakewire.so: native/cake_wire.cc
+	g++ -O2 -fPIC -shared -o $@ $<
+
+# python-config fallback: venv bins often lack python-config; try the
+# interpreter-suffixed one first, then python3-config on PATH.
+PYCFG := $(shell command -v $(PY)-config || command -v python3-config)
+
+native/libcakeembed.so: native/cake_embed.cc
+	@test -n "$(PYCFG)" || { echo "no python-config found"; exit 1; }
+	g++ -O2 -fPIC -shared -o $@ $< \
+	  $$($(PYCFG) --includes) $$($(PYCFG) --ldflags --embed)
+
+bench:
+	CAKE_BENCH_PRESET=tiny JAX_PLATFORMS=cpu $(PY) bench.py
+
+clean:
+	rm -f native/*.so
+	find . -name __pycache__ -type d -exec rm -rf {} +
+
+.PHONY: test lint native bench clean

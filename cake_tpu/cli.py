@@ -58,6 +58,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="on-pod pipeline stages (mesh, not TCP)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
+    p.add_argument("--profile", default=None, metavar="DIR",
+                   help="write a jax.profiler trace of generation to DIR")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -183,21 +185,30 @@ def run_master(args) -> int:
     t_gen0 = time.perf_counter()
     n_tokens = 0
     gen_error = None
-    for i in range(args.sample_len):
-        try:
-            tok = gen.next_token(i)
-        except Exception as e:
-            # end the run with a clean newline instead of a traceback
-            # (reference: cake-cli/main.rs:51-55)
-            gen_error = e
-            break
-        n_tokens += 1
-        if tok.text:
-            print(tok.text, end="", flush=True)
-        if i == 0:
-            t_warm = time.perf_counter()  # exclude warm-up (master.rs:37-40)
-        if tok.is_end_of_stream:
-            break
+    if args.profile:
+        import jax.profiler
+
+        jax.profiler.start_trace(args.profile)
+    try:
+        for i in range(args.sample_len):
+            try:
+                tok = gen.next_token(i)
+            except Exception as e:
+                # end the run with a clean newline instead of a traceback
+                # (reference: cake-cli/main.rs:51-55)
+                gen_error = e
+                break
+            n_tokens += 1
+            if tok.text:
+                print(tok.text, end="", flush=True)
+            if i == 0:
+                t_warm = time.perf_counter()  # exclude warm-up (master.rs:37-40)
+            if tok.is_end_of_stream:
+                break
+    finally:
+        if args.profile:
+            jax.profiler.stop_trace()
+            log.info("profiler trace written to %s", args.profile)
     rest = gen.last()
     if rest:
         print(rest, end="")
@@ -207,6 +218,12 @@ def run_master(args) -> int:
         log.info("%d tokens, %.2f tok/s (excl. warm-up; TTFT %.2fs) — %s",
                  n_tokens, (n_tokens - 1) / dt,
                  t_warm - t_gen0, memory_report())
+    if hasattr(gen, "runner_stats"):
+        for s in gen.runner_stats():
+            log.info("segment %s @ %s: %d calls, %.2f ms avg%s",
+                     s["layers"], s["ident"], s["calls"], s["avg_ms"],
+                     f", handshake {s['handshake_ms']} ms"
+                     if "handshake_ms" in s else "")
     if hasattr(gen, "close"):
         gen.close()
     if gen_error is not None:

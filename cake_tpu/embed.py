@@ -1,0 +1,89 @@
+"""Embeddable worker API.
+
+Equivalent of the reference's iOS/FFI surface (`cake-ios/src/lib.rs:11-57`):
+a single ``start_worker(name, model_path, topology_path)`` export that an
+embedding application calls to turn the current process into a cake worker
+serving its topology-assigned layers. The reference exposes this through
+UniFFI to Swift; here the same contract is exposed two ways:
+
+- Python: ``cake_tpu.embed.start_worker(...)`` (blocking) or
+  ``spawn_worker(...)`` (background, returns a handle with ``.shutdown()``).
+- C: ``cake_start_worker(name, model_path, topology_path, address)`` in
+  ``native/cake_embed.cc``, a CPython-embedding shim that any C/C++ host can
+  link against (the TPU-native stand-in for the UniFFI boundary).
+
+Defaults mirror the reference: bind ``0.0.0.0:10128`` (`lib.rs:20`).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+log = logging.getLogger("cake_tpu.embed")
+
+DEFAULT_ADDRESS = "0.0.0.0:10128"
+
+
+def _build_worker(name: str, model_path: str, topology_path: str,
+                  address: str = DEFAULT_ADDRESS, quantize: str | None = None,
+                  max_seq: int | None = None):
+    from pathlib import Path
+
+    from cake_tpu.models.config import LlamaConfig
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime.worker import Worker
+    from cake_tpu.utils.weights import load_llama_params
+
+    config = LlamaConfig.from_hf_json(Path(model_path) / "config.json")
+    topology = Topology.from_path(topology_path)
+
+    def loader(lo, hi):
+        return load_llama_params(
+            model_path, config.num_hidden_layers, dtype=config.dtype,
+            layer_range=(lo, hi), include_embed=False, include_head=False,
+            quantize=quantize,
+        )["layers"]
+
+    return Worker(name, config, topology, loader, address=address,
+                  max_seq=max_seq)
+
+
+class WorkerHandle:
+    """A running background worker; ``port`` is the bound port (useful when
+    the address requested port 0) and ``shutdown()`` stops serving."""
+
+    def __init__(self, worker, thread: threading.Thread):
+        self._worker = worker
+        self._thread = thread
+        self.port: int = worker.port
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        self._worker.shutdown()
+        self._thread.join(timeout=timeout)
+
+
+def start_worker(name: str, model_path: str, topology_path: str,
+                 address: str = DEFAULT_ADDRESS,
+                 quantize: str | None = None,
+                 max_seq: int | None = None) -> None:
+    """Run a worker in the calling thread until interrupted (the blocking
+    contract of the reference export, `cake-ios/src/lib.rs:33-57`)."""
+    worker = _build_worker(name, model_path, topology_path, address, quantize,
+                           max_seq)
+    log.info("embedded worker '%s' serving on port %d", name, worker.port)
+    try:
+        worker.serve_forever()
+    except KeyboardInterrupt:
+        worker.shutdown()
+
+
+def spawn_worker(name: str, model_path: str, topology_path: str,
+                 address: str = DEFAULT_ADDRESS,
+                 quantize: str | None = None,
+                 max_seq: int | None = None) -> WorkerHandle:
+    """Start a worker on a background thread and return a handle."""
+    worker = _build_worker(name, model_path, topology_path, address, quantize,
+                           max_seq)
+    thread = worker.serve_in_background()
+    return WorkerHandle(worker, thread)

@@ -95,6 +95,13 @@ class DistributedGenerator(GeneratorBase):
             partial(sampling.sample_token, settings=self.settings)
         )
         self._t_start: float | None = None
+        # per-runner cumulative forward time (the TPU-side analogue of the
+        # reference's per-worker ops/s + handshake-latency stats, worker.rs:19);
+        # the first call per runner (prefill + XLA compile) is kept apart so
+        # avg_ms reflects steady-state decode, like tokens_per_sec
+        self._runner_time = [0.0] * len(runners)
+        self._runner_calls = [0] * len(runners)
+        self._runner_warmup = [0.0] * len(runners)
 
     def _on_new_prompt(self) -> None:
         self._t_start = None
@@ -108,8 +115,15 @@ class DistributedGenerator(GeneratorBase):
                 self.config.jax_dtype
             )
         )
-        for runner in self.runners:
+        for i, runner in enumerate(self.runners):
+            t0 = time.perf_counter()
             x = runner.forward(x, pos)
+            dt = time.perf_counter() - t0
+            if self._runner_warmup[i] == 0.0:
+                self._runner_warmup[i] = dt
+            else:
+                self._runner_time[i] += dt
+                self._runner_calls[i] += 1
         x_last = jnp.asarray(x[:, last_index, :])
         return self._head_fn(x_last)[0]
 
@@ -143,6 +157,27 @@ class DistributedGenerator(GeneratorBase):
         if self._t_start is None or len(self._generated) < 2:
             return None
         return (len(self._generated) - 1) / (time.perf_counter() - self._t_start)
+
+    def runner_stats(self) -> list[dict]:
+        """Per-segment steady-state decode latency (warm-up call reported
+        separately). Remote entries include the handshake RTT recorded at
+        connect time (client.rs:72-86 shows the same in the reference's
+        WorkerInfo)."""
+        stats = []
+        for i, r in enumerate(self.runners):
+            calls = self._runner_calls[i]
+            entry = {
+                "ident": r.ident(),
+                "layers": f"{r.start}-{r.stop - 1}",
+                "calls": calls,
+                "avg_ms": (self._runner_time[i] / calls * 1e3) if calls else 0.0,
+                "warmup_ms": self._runner_warmup[i] * 1e3,
+            }
+            info = getattr(r, "info", None)
+            if info is not None and getattr(info, "latency_ms", None):
+                entry["handshake_ms"] = round(info.latency_ms, 2)
+            stats.append(entry)
+        return stats
 
     def close(self) -> None:
         for r in self.runners:

@@ -1,0 +1,60 @@
+// C-callable embedding shim for cake-tpu workers.
+//
+// Equivalent of the reference's UniFFI export surface
+// (cake-ios/src/lib.rs:11-57): a host application links this library and
+// calls cake_start_worker(name, model_path, topology_path, address) to turn
+// the process into a cake worker serving its topology-assigned layers. The
+// reference bridges Rust->Swift via UniFFI; here the bridge is C -> embedded
+// CPython -> cake_tpu.embed.start_worker (the JAX/TPU runtime must live in
+// Python, so the FFI boundary wraps the interpreter rather than the model).
+//
+// Build:  g++ -O2 -fPIC -shared -o libcakeembed.so cake_embed.cc \
+//             $(python3-config --includes) $(python3-config --ldflags --embed)
+//
+// Contract: blocking (like the reference's block_on(Worker::run)); returns
+// 0 on clean shutdown, nonzero on error. cake_worker_api_version() lets
+// hosts check ABI compatibility.
+
+#include <Python.h>
+
+extern "C" {
+
+int cake_worker_api_version(void) { return 1; }
+
+// Start a worker and block until it stops. Returns 0 on success.
+int cake_start_worker(const char *name, const char *model_path,
+                      const char *topology_path, const char *address) {
+  if (!name || !model_path || !topology_path) return 2;
+
+  const bool owned = !Py_IsInitialized();
+  if (owned) Py_InitializeEx(0);
+
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 0;
+
+  PyObject *mod = PyImport_ImportModule("cake_tpu.embed");
+  if (!mod) {
+    PyErr_Print();
+    rc = 1;
+  } else {
+    PyObject *fn = PyObject_GetAttrString(mod, "start_worker");
+    PyObject *res =
+        fn ? PyObject_CallFunction(
+                 fn, "ssss", name, model_path, topology_path,
+                 address && *address ? address : "0.0.0.0:10128")
+           : nullptr;
+    if (!res) {
+      PyErr_Print();
+      rc = 1;
+    }
+    Py_XDECREF(res);
+    Py_XDECREF(fn);
+    Py_DECREF(mod);
+  }
+
+  PyGILState_Release(gil);
+  if (owned) Py_FinalizeEx();
+  return rc;
+}
+
+}  // extern "C"

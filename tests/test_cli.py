@@ -64,6 +64,17 @@ def test_local_generation_subprocess(model_dir):
     assert "tok/s" in r.stderr
 
 
+def test_profile_flag_writes_trace(model_dir, tmp_path):
+    trace_dir = tmp_path / "trace"
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "3",
+        "--temperature", "0", "--max-seq", "32", "--cpu",
+        "--profile", str(trace_dir),
+    ])
+    assert r.returncode == 0, r.stderr
+    assert trace_dir.exists() and any(trace_dir.rglob("*"))
+
+
 def test_missing_config_errors(tmp_path):
     r = _run_cli(["--model", str(tmp_path), "--prompt-ids", "1", "-n", "1"])
     assert r.returncode != 0

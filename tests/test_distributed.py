@@ -72,6 +72,12 @@ def test_all_remote_two_workers(params):
     got = [g.next_token(i).id for i in range(6)]
     assert got == _local_stream(params, [5, 9, 2], 6, settings)
     assert g.tokens_per_sec() is not None
+    stats = g.runner_stats()
+    assert [s["layers"] for s in stats] == ["0-1", "2-3"]
+    # 6 forwards per runner; the first (prefill + compile) is warm-up
+    assert all(s["calls"] == 5 and s["avg_ms"] > 0 for s in stats)
+    assert all(s["warmup_ms"] > 0 for s in stats)
+    assert all("handshake_ms" in s for s in stats)
     g.close()
     w1.shutdown()
     w2.shutdown()

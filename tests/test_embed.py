@@ -1,0 +1,100 @@
+"""Embeddable worker API (the reference's UniFFI surface, cake-ios/src/lib.rs).
+
+Covers the Python entry (spawn_worker against a real model dir on disk: load
+assigned layers, handshake, serve one op) and the C shim build contract
+(exported symbols of native/cake_embed.cc).
+"""
+
+import ctypes
+import json
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+import yaml
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.utils.weights import save_llama_params
+
+CFG = tiny(max_seq_len=32)
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    """Model dir + topology file, like an embedding app would ship."""
+    d = tmp_path_factory.mktemp("embed")
+    params = llama.init_params(CFG, jax.random.PRNGKey(1), dtype="float32")
+    model_dir = d / "model"
+    save_llama_params(params, model_dir)
+    (model_dir / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    topo = d / "topology.yml"
+    topo.write_text(yaml.safe_dump(
+        {"phone": {"host": "127.0.0.1:0", "layers": ["model.layers.0-3"]}}
+    ))
+    return model_dir, topo
+
+
+def test_spawn_worker_serves(bundle):
+    from cake_tpu import embed
+    from cake_tpu.runtime import protocol, wire
+    from cake_tpu.runtime.protocol import MsgType, WorkerInfo
+
+    model_dir, topo = bundle
+    h = embed.spawn_worker("phone", str(model_dir), str(topo),
+                           address="127.0.0.1:0")
+    try:
+        conn = wire.connect("127.0.0.1", h.port)
+        conn.send(MsgType.HELLO)
+        t, payload = conn.recv()
+        assert t == MsgType.WORKER_INFO
+        info = WorkerInfo.from_bytes(payload)
+        assert info.name == "phone"
+        assert info.layers == [f"model.layers.{i}" for i in range(4)]
+        x = np.zeros((1, 1, CFG.hidden_size), np.float32)
+        conn.send(MsgType.BATCH,
+                  protocol.encode_ops(x, [("model.layers.0", 0)]))
+        t, payload = conn.recv()
+        assert t == MsgType.TENSOR
+        conn.close()
+    finally:
+        h.shutdown()
+
+
+def test_spawn_worker_unknown_name_raises(bundle):
+    from cake_tpu import embed
+
+    model_dir, topo = bundle
+    with pytest.raises(ValueError, match="not present"):
+        embed.spawn_worker("nope", str(model_dir), str(topo),
+                           address="127.0.0.1:0")
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
+def test_c_shim_exports(tmp_path):
+    """The C embedding library builds and exports the stable C ABI."""
+    pycfg = next(
+        (c for c in (sys.executable + "-config", "python3-config")
+         if shutil.which(c)), None,
+    )
+    if pycfg is None:
+        pytest.skip("python-config unavailable")
+    cfg = subprocess.run([pycfg, "--includes"], capture_output=True, text=True)
+    ld = subprocess.run([pycfg, "--ldflags", "--embed"],
+                        capture_output=True, text=True)
+    so = tmp_path / "libcakeembed.so"
+    cmd = (
+        ["g++", "-O2", "-fPIC", "-shared", "-o", str(so),
+         str(REPO / "native" / "cake_embed.cc")]
+        + cfg.stdout.split() + ld.stdout.split()
+    )
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    lib = ctypes.CDLL(str(so))
+    assert lib.cake_worker_api_version() == 1
+    assert hasattr(lib, "cake_start_worker")
