@@ -27,6 +27,17 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _sync(x) -> None:
+    """Synchronize by fetching a scalar of every leaf to the host.
+    block_until_ready can return early through remote-device tunnels; an
+    actual device->host read of the dependency chain cannot. All leaves are
+    fetched so async allocation failures surface here (inside the caller's
+    try), and the slice happens on-device so only one element transfers."""
+    for leaf in jax.tree.leaves(x):
+        np.asarray(leaf.ravel()[:1])
 
 # chip kind -> approx HBM GB/s (public specs)
 _HBM_GBPS = {
@@ -91,20 +102,45 @@ def main() -> int:
     params = config = None
     for p in ladder[ladder.index(preset):]:
         cfg = _config(p)
-        try:
-            candidate = init_params(cfg, key)
-            if quant == "int8":
-                # quantize inside the ladder so an OOM here steps down too
-                from cake_tpu.ops.quant import quantize_params
+        # A freshly released chip can still hold the previous process's
+        # memory for a few seconds (remote runtime); retry before stepping
+        # down so a transient RESOURCE_EXHAUSTED doesn't shrink the model.
+        for attempt in range(3):
+            try:
+                candidate = init_params(cfg, key)
+                if quant == "int8":
+                    # quantize inside the ladder so an OOM here steps down too
+                    from cake_tpu.ops.quant import quantize_params
 
-                candidate = quantize_params(candidate)
-            candidate = jax.tree.map(lambda x: x.block_until_ready(), candidate)
-            params, config, preset = candidate, cfg, p
+                    candidate = quantize_params(candidate)
+                _sync(candidate)
+                params, config, preset = candidate, cfg, p
+                break
+            except Exception as e:
+                sys.stderr.write(
+                    f"init at preset={p} failed ({e}); "
+                    f"attempt {attempt + 1}/3\n"
+                )
+                candidate = None
+                # only a transient grant-release is worth waiting out, and
+                # never after the last attempt (we step down immediately)
+                if "RESOURCE_EXHAUSTED" not in str(e) or attempt == 2:
+                    break
+                time.sleep(15 * (attempt + 1))
+        if params is not None:
             break
-        except Exception as e:
-            sys.stderr.write(f"init at preset={p} failed ({e}); stepping down\n")
-            candidate = None
     if params is None:
+        # Accelerator unusable (e.g. a wedged remote grant holding HBM):
+        # fall back to CPU so the driver still gets a benchmark line, unless
+        # we are already on CPU.
+        if dev.platform != "cpu" and os.environ.get("CAKE_BENCH_NO_FALLBACK") != "1":
+            sys.stderr.write("no preset fits; re-running on CPU fallback\n")
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       CAKE_BENCH_NO_FALLBACK="1",
+                       CAKE_BENCH_PRESET="tiny")
+            # drop the axon sitecustomize so the TPU plugin never loads
+            env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+            os.execve(sys.executable, [sys.executable, __file__], env)
         sys.stderr.write("no preset fits this device\n")
         return 1
 
@@ -122,7 +158,7 @@ def main() -> int:
     prefill = jax.jit(partial(prefill_fn, config=config), donate_argnames=("cache",))
     t_pf0 = time.perf_counter()
     logits, cache = prefill(params, prompt, cache, jnp.asarray([7], jnp.int32))
-    logits.block_until_ready()
+    _sync(logits)
     ttft_s = time.perf_counter() - t_pf0  # includes compile (cold TTFT)
 
     tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)[:1]
@@ -135,7 +171,7 @@ def main() -> int:
         )
         tok = tok.reshape(1)
         pos += 1
-    tok.block_until_ready()
+    _sync(tok)
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -143,7 +179,7 @@ def main() -> int:
             params, tok.reshape(1), cache, jnp.int32(pos), key, history, hist_slot
         )
         pos += 1
-    tok.block_until_ready()
+    _sync(tok)
     dt = time.perf_counter() - t0
 
     toks_per_s = steps / dt

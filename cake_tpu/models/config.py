@@ -37,6 +37,10 @@ class LlamaConfig:
     num_key_value_heads: int = 8
     rms_norm_eps: float = 1e-5
     rope_theta: float = 500000.0
+    # HF `rope_scaling` dict, e.g. Llama-3.1's {"rope_type": "llama3",
+    # "factor": 8.0, ...} or {"rope_type": "linear", "factor": N}. None = no
+    # scaling (Llama-3.0, the reference's model of record).
+    rope_scaling: dict | None = None
     bos_token_id: int | None = 128000
     eos_token_id: int | Sequence[int] | None = 128001
     tie_word_embeddings: bool = False
@@ -86,6 +90,8 @@ class LlamaConfig:
         d = dataclasses.asdict(self)
         d.pop("max_seq_len")
         d.pop("dtype")
+        if d["rope_scaling"] is None:
+            d.pop("rope_scaling")
         d["model_type"] = "llama"
         return d
 

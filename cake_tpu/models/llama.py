@@ -171,7 +171,8 @@ def forward(
     Returns ``(logits [B, vocab] f32, new_cache)`` — logits taken at the last
     position and upcast to f32 exactly as the reference (llama.rs:124-143).
     """
-    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
+                           scaling=config.rope_scaling)
     x = params["embed"][tokens].astype(config.jax_dtype)
     x, cache = forward_layers(params["layers"], x, cache, cos, sin, pos, config)
     x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
@@ -190,5 +191,6 @@ def hidden_forward_layers(
 ) -> tuple[jax.Array, KVCache]:
     """Convenience wrapper that builds RoPE tables internally — the entry
     point a worker jits for its assigned block range (worker.rs:203-224)."""
-    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
+                           scaling=config.rope_scaling)
     return forward_layers(layers, x, cache, cos, sin, pos, config)

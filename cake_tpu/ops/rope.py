@@ -18,11 +18,40 @@ import jax
 import jax.numpy as jnp
 
 
-def rope_tables(head_dim: int, max_seq: int, theta: float, dtype=jnp.float32):
+def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Apply HF ``rope_scaling`` to the base frequencies.
+
+    Supports ``linear`` (uniform 1/factor) and Llama-3.1's ``llama3`` rule:
+    wavelengths shorter than ``original_max/high_freq_factor`` keep their
+    frequency, longer than ``original_max/low_freq_factor`` are divided by
+    ``factor``, and the band between interpolates smoothly. (The reference
+    predates rope scaling — cache.rs:31-50 is the unscaled table only — but
+    Llama-3.1 checkpoints require it.)
+    """
+    kind = scaling.get("rope_type", scaling.get("type", "linear"))
+    factor = float(scaling["factor"])
+    if kind == "linear":
+        return inv_freq / factor
+    if kind == "llama3":
+        lo = float(scaling["low_freq_factor"])
+        hi = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (orig / wavelen - lo) / (hi - lo)
+        interp = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        scaled = jnp.where(wavelen > orig / lo, inv_freq / factor, interp)
+        return jnp.where(wavelen < orig / hi, inv_freq, scaled)
+    raise ValueError(f"unsupported rope_scaling type '{kind}'")
+
+
+def rope_tables(head_dim: int, max_seq: int, theta: float, dtype=jnp.float32,
+                scaling: dict | None = None):
     """Precompute ``cos/sin [max_seq, head_dim // 2]`` (cache.rs:31-50)."""
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling is not None:
+        inv_freq = _scale_inv_freq(inv_freq, scaling)
     t = jnp.arange(max_seq, dtype=jnp.float32)
     freqs = jnp.outer(t, inv_freq)  # [max_seq, head_dim/2]
     return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)

@@ -149,7 +149,8 @@ def build_sharded_decode(
         # cache.max_seq inside shard_map is the per-shard slice; RoPE tables
         # must cover global positions.
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq * plan.sp, config.rope_theta
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
+            scaling=config.rope_scaling,
         )
         x = params["embed"][token[:, None]].astype(config.jax_dtype)
         x, ck, cv = _pipeline_layers(
@@ -201,7 +202,8 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
 
     def step(params, tokens, cache, last_index):
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq * plan.sp, config.rope_theta
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
+            scaling=config.rope_scaling,
         )
         x = params["embed"][tokens].astype(config.jax_dtype)
         x, ck, cv = _pipeline_layers(

@@ -66,7 +66,8 @@ def _lm_head(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
 def prefill_fn(params, tokens, cache: KVCache, last_index, config: LlamaConfig):
     """Prompt pass. ``tokens [B, T_pad]``; logits read at ``last_index``
     (the last *real* prompt position). Returns (logits [B, vocab], cache)."""
-    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
+                           scaling=config.rope_scaling)
     x = params["embed"][tokens].astype(config.jax_dtype)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, 0, config)
     x_last = jnp.take_along_axis(
@@ -87,7 +88,8 @@ def decode_step_fn(
     settings: SamplerSettings,
 ):
     """One fused decode step: forward one token + sample the next."""
-    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta)
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
+                           scaling=config.rope_scaling)
     x = params["embed"][token[:, None]].astype(config.jax_dtype)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, pos, config)
     logits = _lm_head(params, x[:, -1, :], config)

@@ -105,6 +105,9 @@ class DistributedGenerator(GeneratorBase):
 
     def _on_new_prompt(self) -> None:
         self._t_start = None
+        # each prompt's first forward is a fresh prefill — re-classify it as
+        # warm-up so avg_ms stays steady-state decode only
+        self._runner_warmup = [0.0] * len(self.runners)
         for r in self.runners:
             r.reset()
 

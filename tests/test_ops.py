@@ -48,6 +48,65 @@ def test_rope_preserves_norm():
     )
 
 
+LLAMA31_SCALING = {
+    "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+    "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+}
+
+
+def test_rope_llama3_scaling_matches_hf():
+    """Golden: Llama-3.1 frequency scaling matches transformers' llama3 rule."""
+    from types import SimpleNamespace
+
+    torch = pytest.importorskip("torch")
+    from transformers.modeling_rope_utils import ROPE_INIT_FUNCTIONS
+
+    from cake_tpu.ops.rope import _scale_inv_freq
+
+    head_dim, theta = 128, 500000.0
+    hf_cfg = SimpleNamespace(
+        rope_theta=theta, head_dim=head_dim, hidden_size=32 * head_dim,
+        num_attention_heads=32, partial_rotary_factor=1.0,
+        max_position_embeddings=8192, rope_scaling=LLAMA31_SCALING,
+    )
+    expected, _ = ROPE_INIT_FUNCTIONS["llama3"](hf_cfg, "cpu")
+    base = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    got = _scale_inv_freq(jnp.asarray(base, jnp.float32), LLAMA31_SCALING)
+    np.testing.assert_allclose(
+        np.asarray(got), expected.numpy(), rtol=1e-6, atol=0
+    )
+
+
+def test_rope_linear_scaling():
+    from cake_tpu.ops.rope import _scale_inv_freq
+
+    base = jnp.asarray([1.0, 0.1, 0.01], jnp.float32)
+    got = _scale_inv_freq(base, {"rope_type": "linear", "factor": 4.0})
+    np.testing.assert_allclose(np.asarray(got), np.asarray(base) / 4.0)
+    with pytest.raises(ValueError, match="unsupported"):
+        _scale_inv_freq(base, {"rope_type": "yarn", "factor": 2.0})
+
+
+def test_config_carries_rope_scaling_to_generation():
+    """from_hf_dict picks up rope_scaling and a scaled model generates
+    (different positional geometry => different stream than unscaled)."""
+    from cake_tpu.models.llama import init_params
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    scaling = dict(LLAMA31_SCALING, original_max_position_embeddings=32)
+    cfg = tiny(max_seq_len=64)
+    scaled = tiny(max_seq_len=64, rope_scaling=scaling)
+    assert scaled.from_hf_dict(scaled.to_hf_dict()).rope_scaling == scaling
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    streams = []
+    for c in (cfg, scaled):
+        g = LlamaGenerator(c, params,
+                           settings=SamplerSettings(temperature=0.0))
+        g.set_prompt(list(range(24)))
+        streams.append([g.next_token(i).id for i in range(6)])
+    assert streams[0] != streams[1]
+
+
 def test_swiglu_matches_manual():
     rs = np.random.RandomState(0)
     x = rs.randn(2, 3, 8).astype(np.float32)
