@@ -53,10 +53,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="f16 maps to bf16 on TPU")
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="quantize linear weights on load (per-channel int8)")
+    p.add_argument("--decode-block", type=int, default=8, dest="decode_block",
+                   help="fused decode steps per dispatch in the all-local "
+                        "path (1 = one program per token)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
+    p.add_argument("--sp", type=int, default=1,
+                   help="sequence-parallel width (ring attention prefill)")
+    p.add_argument("--device", type=int, default=None,
+                   help="device ordinal (reference --device GPU ordinal, "
+                        "lib.rs:17-19; here an index into jax.devices())")
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of generation to DIR")
@@ -143,7 +151,21 @@ def run_master(args) -> int:
     settings = _settings(args)
 
     t0 = time.perf_counter()
-    if args.topology:
+    use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1
+    if use_mesh and args.topology:
+        sys.exit(
+            "error: --stages/--tp/--sp (single-program mesh) and --topology "
+            "(cross-host workers) are mutually exclusive"
+        )
+    if use_mesh:
+        from cake_tpu.runtime.mesh_generator import MeshGenerator
+
+        params = load_llama_params(args.model, config.num_hidden_layers,
+                                   dtype=config.dtype, quantize=args.quantize)
+        gen = MeshGenerator(config, params, tokenizer=tokenizer,
+                            settings=settings, max_seq=args.max_seq,
+                            num_stages=args.stages, tp=args.tp, sp=args.sp)
+    elif args.topology:
         from cake_tpu.parallel.topology import Topology
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 
@@ -169,7 +191,8 @@ def run_master(args) -> int:
         params = load_llama_params(args.model, config.num_hidden_layers,
                                    dtype=config.dtype, quantize=args.quantize)
         gen = LlamaGenerator(config, params, tokenizer=tokenizer,
-                             settings=settings, max_seq=args.max_seq)
+                             settings=settings, max_seq=args.max_seq,
+                             block_size=args.decode_block)
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
              memory_report())
 
@@ -242,6 +265,16 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.device is not None:
+        import jax
+
+        devices = jax.devices()
+        if not 0 <= args.device < len(devices):
+            sys.exit(
+                f"error: --device {args.device} out of range "
+                f"(have {len(devices)} devices)"
+            )
+        jax.config.update("jax_default_device", devices[args.device])
     if args.mode == "worker":
         return run_worker(args)
     return run_master(args)

@@ -98,6 +98,46 @@ def decode_step_fn(
     return next_tok, cache, history, hist_slot
 
 
+def decode_scan_fn(
+    params,
+    token,  # [1] int32 — previous sampled token
+    cache: KVCache,
+    pos,  # scalar int32 — position of `token`'s KV slot
+    key0,  # BASE stream key (unfolded); see key schedule note below
+    history,
+    hist_slot,
+    config: LlamaConfig,
+    settings: SamplerSettings,
+    steps: int,
+    index0=0,  # absolute token index of the first emitted token
+):
+    """``steps`` fused decode steps in ONE dispatch (lax.scan over
+    decode_step_fn). Sampling is already on-device, so the token feedback
+    loop needs no host round-trip; emitting K tokens per dispatch amortizes
+    dispatch/tunnel latency that otherwise dominates single-token decode.
+
+    Key schedule: step ``i`` samples with ``fold_in(key0, index0 + i)`` —
+    the SAME schedule as the single-step path (``fold_in(base_key, index)``),
+    so a given seed produces an identical stochastic stream at every block
+    size. Returns (tokens [steps], cache, history, hist_slot)."""
+
+    def body(carry, i):
+        token, cache, pos, history, hist_slot = carry
+        tok, cache, history, hist_slot = decode_step_fn(
+            params, token, cache, pos,
+            jax.random.fold_in(key0, jnp.asarray(index0, jnp.int32) + i),
+            history, hist_slot, config=config, settings=settings,
+        )
+        return (tok.reshape(1), cache, pos + 1, history, hist_slot), tok
+
+    (_, cache, _, history, hist_slot), toks = jax.lax.scan(
+        body,
+        (token, cache, jnp.asarray(pos, jnp.int32), history, hist_slot),
+        jnp.arange(steps, dtype=jnp.int32),
+    )
+    return toks, cache, history, hist_slot
+
+
 class GeneratorBase:
     """Shared Generator-trait state machine (model/mod.rs:21-29,46-58):
     prompt validation + per-stream reset, repeat-penalty history seeding,
@@ -228,23 +268,45 @@ class LlamaGenerator(GeneratorBase):
         settings: SamplerSettings | None = None,
         max_seq: int | None = None,
         cache_dtype=None,
+        block_size: int = 1,
     ):
+        """``block_size > 1`` fuses that many decode steps into one dispatch
+        (lax.scan; sampling stays on-device) and streams the buffered tokens
+        one at a time — dispatch latency amortizes ~K-fold, which dominates
+        single-token decode on remote-attached chips. The sampling key
+        schedule is block-size-invariant (absolute token index), so a given
+        seed yields the same stream at any block size."""
         super().__init__(config, tokenizer, settings, max_seq)
         self.params = params
+        self.block_size = max(1, block_size)
+        self._block_buf: list[int] = []
         self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
                                 dtype=cache_dtype)
         self._prefill = jax.jit(
             partial(prefill_fn, config=config),
             donate_argnames=("cache",),
         )
-        self._decode = jax.jit(
+        # single-step program: block_size 1, and the tail of the KV window
+        self._decode_single = jax.jit(
             partial(decode_step_fn, config=config, settings=self.settings),
             donate_argnames=("cache",),
         )
+        self._decode = (
+            jax.jit(
+                partial(decode_scan_fn, config=config, settings=self.settings,
+                        steps=self.block_size),
+                donate_argnames=("cache",),
+            )
+            if self.block_size > 1 else self._decode_single
+        )
+
+    def _on_new_prompt(self) -> None:
+        self._block_buf = []
 
     def next_token(self, index: int) -> Token:
         """index 0: prefill the whole prompt; index>0: one-token decode
-        (context windowing per llama.rs:228-232)."""
+        (context windowing per llama.rs:228-232), or pop from the current
+        fused block when block_size > 1."""
         if index == 0:
             self._require_prompt()
             n = len(self._prompt_tokens)
@@ -262,17 +324,33 @@ class LlamaGenerator(GeneratorBase):
                 self._history, self._hist_slot, tok
             )
             self._pos = n
-        else:
-            self._check_capacity()
-            step_key = jax.random.fold_in(self._key, index)
-            tok, self.cache, self._history, self._hist_slot = self._decode(
+            return self._finish_token(int(tok))
+        if self._block_buf:
+            return self._finish_token(self._block_buf.pop(0))
+        self._check_capacity()
+        if self.block_size > 1 and self._pos + self.block_size <= self.max_seq:
+            toks, self.cache, self._history, self._hist_slot = self._decode(
                 self.params,
                 jnp.asarray([self._last_token], jnp.int32),
                 self.cache,
                 jnp.int32(self._pos),
-                step_key,
+                self._key,  # base key; scan folds with the absolute index
                 self._history,
                 self._hist_slot,
+                index0=jnp.int32(index),
             )
-            self._pos += 1
+            self._pos += self.block_size
+            self._block_buf = [int(t) for t in toks]
+            return self._finish_token(self._block_buf.pop(0))
+        # single-step path (block_size == 1, or the tail of the KV window)
+        tok, self.cache, self._history, self._hist_slot = self._decode_single(
+            self.params,
+            jnp.asarray([self._last_token], jnp.int32),
+            self.cache,
+            jnp.int32(self._pos),
+            jax.random.fold_in(self._key, index),
+            self._history,
+            self._hist_slot,
+        )
+        self._pos += 1
         return self._finish_token(int(tok))

@@ -1,0 +1,115 @@
+"""Generator over the single-program on-pod mesh pipeline.
+
+This is the third execution path behind the Generator-trait surface
+(`model/mod.rs:21-29`): ``LlamaGenerator`` runs all-local, the
+``DistributedGenerator`` walks cross-host runners the way the reference
+master walks Forwarders (llama.rs:88-119), and this one compiles the whole
+per-token step over a ``(dp, stage, sp, tp)`` device mesh
+(parallel/pipeline.py) so stage hops are ICI ``ppermute``s inside one XLA
+program instead of per-token RPCs.
+
+Use when all devices are visible to one process (a TPU slice): the
+reference's layer-range semantics collapse into the stage axis
+(parallel/mesh.py:MeshPlan.from_topology maps a uniform topology onto it).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops import sampling
+from cake_tpu.ops.kvcache import init_cache
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+from cake_tpu.parallel.pipeline import (
+    build_sharded_decode,
+    build_sharded_prefill,
+)
+from cake_tpu.runtime.generator import GeneratorBase, Token, _bucket
+
+
+class MeshGenerator(GeneratorBase):
+    """Single-stream generator whose per-token step is one compiled program
+    over a device mesh. ``params`` may live on host or a single device; they
+    are sharded onto the mesh here."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        plan: MeshPlan | None = None,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        num_stages: int = 1,
+        tp: int = 1,
+        sp: int = 1,
+        devices=None,
+    ):
+        super().__init__(config, tokenizer, settings, max_seq)
+        if plan is None:
+            plan = MeshPlan.build(
+                config, num_stages=num_stages, tp=tp, dp=1, sp=sp,
+                devices=devices,
+            )
+        if plan.dp != 1:
+            raise ValueError(
+                "MeshGenerator is single-stream; build the plan with dp=1"
+            )
+        if self.max_seq % plan.sp:
+            raise ValueError(
+                f"max_seq {self.max_seq} not divisible by sp {plan.sp}"
+            )
+        self.plan = plan
+        self.params = shard_params(params, plan.mesh)
+        self.cache = shard_cache(
+            init_cache(config, batch=1, max_seq=self.max_seq), plan.mesh
+        )
+        self._prefill = build_sharded_prefill(config, plan,
+                                              params_like=self.params)
+        self._decode = build_sharded_decode(config, self.settings, plan,
+                                            params_like=self.params)
+
+    def next_token(self, index: int) -> Token:
+        if index == 0:
+            self._require_prompt()
+            n = len(self._prompt_tokens)
+            # sp shards the prompt axis: prefill runs ring attention over the
+            # full cache window (pipeline.py build_sharded_prefill contract);
+            # without sp, bucketed lengths keep compile count O(log max_seq).
+            t_pad = (
+                self.max_seq if self.plan.sp > 1 else _bucket(n, self.max_seq)
+            )
+            padded = self._prompt_tokens + [0] * (t_pad - n)
+            tokens = jnp.asarray([padded], jnp.int32)
+            logits, self.cache = self._prefill(
+                self.params, tokens, self.cache,
+                jnp.asarray([n - 1], jnp.int32),
+            )
+            step_key = jax.random.fold_in(self._key, 0)
+            tok = sampling.sample_token(
+                logits[0], step_key, self._history, self.settings
+            )
+            self._history, self._hist_slot = sampling.push_history(
+                self._history, self._hist_slot, tok
+            )
+            self._pos = n
+            tok_id = int(tok)
+        else:
+            self._check_capacity()
+            step_key = jax.random.fold_in(self._key, index)
+            tok, self.cache, history2d, self._hist_slot = self._decode(
+                self.params,
+                jnp.asarray([self._last_token], jnp.int32),
+                self.cache,
+                jnp.int32(self._pos),
+                step_key,
+                self._history[None, :],
+                self._hist_slot,
+            )
+            self._history = history2d[0]
+            self._pos += 1
+            tok_id = int(tok[0])
+        return self._finish_token(tok_id)

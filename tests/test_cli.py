@@ -64,6 +64,48 @@ def test_local_generation_subprocess(model_dir):
     assert "tok/s" in r.stderr
 
 
+def test_mesh_pipeline_generation_subprocess(model_dir):
+    """--stages/--tp drive the single-program mesh pipeline end-to-end."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
+         "--prompt-ids", "3,5,7", "-n", "4", "--temperature", "0",
+         "--max-seq", "32", "--cpu", "--stages", "2", "--tp", "2"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "tok/s" in r.stderr
+
+
+def test_device_ordinal_selection(model_dir):
+    """--device N pins jax_default_device (reference --device, lib.rs:17-19)."""
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "2",
+        "--temperature", "0", "--max-seq", "32", "--cpu", "--device", "0",
+    ])
+    assert r.returncode == 0, r.stderr
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "2",
+        "--cpu", "--device", "99",
+    ])
+    assert r.returncode != 0
+    assert "out of range" in r.stderr
+
+
+def test_mesh_and_topology_flags_conflict(model_dir):
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "1", "-n", "1",
+        "--stages", "2", "--topology", "t.yml",
+    ])
+    assert r.returncode != 0
+    assert "mutually exclusive" in r.stderr
+
+
 def test_profile_flag_writes_trace(model_dir, tmp_path):
     trace_dir = tmp_path / "trace"
     r = _run_cli([

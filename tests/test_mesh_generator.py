@@ -1,0 +1,73 @@
+"""MeshGenerator: the Generator surface over the single-program mesh
+pipeline must match the all-local generator token-for-token (the same
+golden-parity bar the cross-host runtime is held to in test_distributed)."""
+
+import jax
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.generator import LlamaGenerator
+from cake_tpu.runtime.mesh_generator import MeshGenerator
+
+CFG = tiny(max_seq_len=64)
+GREEDY = dict(temperature=0.0, repeat_penalty=1.1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(5))
+
+
+def _local_stream(params, prompt, n, settings):
+    g = LlamaGenerator(CFG, params, settings=settings)
+    g.set_prompt(prompt)
+    return [g.next_token(i).id for i in range(n)]
+
+
+@pytest.mark.parametrize(
+    "axes",
+    [
+        dict(num_stages=2),
+        dict(tp=2),
+        dict(num_stages=2, tp=2),
+        dict(num_stages=2, tp=2, sp=2),
+    ],
+    ids=lambda a: "-".join(f"{k}{v}" for k, v in a.items()),
+)
+def test_greedy_parity_with_local(params, axes):
+    settings = SamplerSettings(**GREEDY)
+    g = MeshGenerator(CFG, params, settings=settings, **axes)
+    g.set_prompt([5, 9, 2, 11])
+    got = [g.next_token(i).id for i in range(6)]
+    assert got == _local_stream(params, [5, 9, 2, 11], 6, settings)
+
+
+def test_second_prompt_resets_stream(params):
+    settings = SamplerSettings(**GREEDY)
+    g = MeshGenerator(CFG, params, settings=settings, num_stages=2, tp=2)
+    g.set_prompt([3, 1, 4])
+    first = [g.next_token(i).id for i in range(5)]
+    g.set_prompt([3, 1, 4])
+    assert [g.next_token(i).id for i in range(5)] == first
+    # and a different prompt actually changes the stream
+    g.set_prompt([9, 8, 7, 6, 5])
+    assert [g.next_token(i).id for i in range(5)] != first
+
+
+def test_dp_plan_rejected(params):
+    from cake_tpu.parallel.mesh import MeshPlan
+
+    plan = MeshPlan.build(CFG, num_stages=2, dp=2)
+    with pytest.raises(ValueError, match="dp=1"):
+        MeshGenerator(CFG, params, plan=plan)
+
+
+def test_topology_and_mesh_flags_conflict():
+    from cake_tpu.cli import build_parser
+
+    args = build_parser().parse_args(
+        ["--model", "x", "--stages", "2", "--topology", "t.yml"]
+    )
+    assert args.stages == 2 and args.topology == "t.yml"
