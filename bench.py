@@ -228,16 +228,11 @@ def main() -> int:
         tok, cache, history, hist_slot = out
         return tok, cache, history, hist_slot, pos + 1
 
-    # warm-up (compile + 2 dispatches)
-    for _ in range(3):
-        tok, cache, history, hist_slot, pos = step_once(
-            tok, cache, history, hist_slot, pos
-        )
-    _sync(tok)
-
     # never overrun the KV window: prompt(8) + 3 warm-up dispatches + timed
     # dispatches must fit max_seq (dynamic_update_slice would clamp silently
-    # and the timed loop would rewrite the last slot at wrong positions)
+    # and the timed loop would rewrite the last slot at wrong positions).
+    # Checked BEFORE warm-up so an invalid combination fails fast instead of
+    # burning compiles on clamped writes.
     per = max(1, multistep)
     max_dispatches = (config.max_seq_len - 8) // per - 3
     if max_dispatches < 1:
@@ -246,6 +241,14 @@ def main() -> int:
             f"CAKE_BENCH_MULTISTEP={multistep}"
         )
     dispatches = max(1, min(steps // per, max_dispatches))
+
+    # warm-up (compile + 2 dispatches)
+    for _ in range(3):
+        tok, cache, history, hist_slot, pos = step_once(
+            tok, cache, history, hist_slot, pos
+        )
+    _sync(tok)
+
     t0 = time.perf_counter()
     for _ in range(dispatches):
         tok, cache, history, hist_slot, pos = step_once(

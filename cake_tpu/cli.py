@@ -54,8 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="quantize linear weights on load (per-channel int8)")
     p.add_argument("--decode-block", type=int, default=8, dest="decode_block",
-                   help="fused decode steps per dispatch in the all-local "
-                        "path (1 = one program per token)")
+                   help="fused decode steps per dispatch (all-local and mesh "
+                        "paths; 1 = one program per token)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -164,7 +164,8 @@ def run_master(args) -> int:
                                    dtype=config.dtype, quantize=args.quantize)
         gen = MeshGenerator(config, params, tokenizer=tokenizer,
                             settings=settings, max_seq=args.max_seq,
-                            num_stages=args.stages, tp=args.tp, sp=args.sp)
+                            num_stages=args.stages, tp=args.tp, sp=args.sp,
+                            block_size=args.decode_block)
     elif args.topology:
         from cake_tpu.parallel.topology import Topology
         from cake_tpu.runtime.master import DistributedGenerator, build_runners

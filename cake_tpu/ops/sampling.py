@@ -101,8 +101,11 @@ def sample_tokens(
     history: jax.Array,  # [B, repeat_last_n] int32
     settings: SamplerSettings,
 ) -> jax.Array:
-    """Batched :func:`sample_token` -> [B] int32 (vmapped, per-row keys)."""
-    keys = jax.random.split(key, logits.shape[0])
+    """Batched :func:`sample_token` -> [B] int32 (vmapped, per-row keys).
+    At B == 1 the row uses ``key`` itself (no split) so the single-stream
+    batched path reproduces :func:`sample_token` exactly."""
+    b = logits.shape[0]
+    keys = key[None] if b == 1 else jax.random.split(key, b)
     return jax.vmap(lambda l, k, h: sample_token(l, k, h, settings))(
         logits, keys, history
     )

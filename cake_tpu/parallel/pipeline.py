@@ -127,25 +127,37 @@ def _head_logits(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
     return jax.lax.all_gather(logits_local, TP, axis=-1, tiled=True)
 
 
-def _dp_fold(key: jax.Array) -> jax.Array:
-    """Give each dp shard a distinct sampling key stream."""
+def _dp_fold(key: jax.Array, dp: int) -> jax.Array:
+    """Give each dp shard a distinct sampling key stream; identity at
+    dp == 1 so the single-stream mesh path reproduces the local generator's
+    key schedule exactly."""
+    if dp == 1:
+        return key
     return jax.random.fold_in(key, jax.lax.axis_index(DP))
 
 
 def build_sharded_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
-    params_like: dict | None = None,
+    params_like: dict | None = None, steps: int = 1,
 ):
     """Compile the fused multi-chip decode step.
 
     Signature: ``(params, token [B], cache, pos, key, history [B, N],
-    hist_slot) -> (next_token [B], cache, history, hist_slot)``.
-    ``params_like``: pass the params pytree (or a structural twin) when some
-    linears are int8-quantized so the shard_map specs match.
+    hist_slot) -> (next_token, cache, history, hist_slot)`` for
+    ``steps == 1``; with ``steps > 1`` the signature gains a trailing
+    ``index0`` argument (absolute token index of the first emitted token)
+    and ``next_token`` is ``[steps, B]``. The K-token loop — pipeline,
+    sampling, token feedback — then runs inside the one compiled program
+    (lax.scan), amortizing dispatch latency exactly like the single-chip
+    ``decode_scan_fn``; per-step sampling keys are ``fold_in(key,
+    index0 + i)``, the same token-index schedule as every other execution
+    path, so one seed yields one stream regardless of sharding or block
+    size. ``params_like``: pass the params pytree (or a structural twin)
+    when some linears are int8-quantized so the shard_map specs match.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
 
-    def step(params, token, cache, pos, key, history, hist_slot):
+    def one_step(params, token, cache, pos, key, history, hist_slot):
         # cache.max_seq inside shard_map is the per-shard slice; RoPE tables
         # must cover global positions.
         cos, sin = rope_tables(
@@ -159,24 +171,46 @@ def build_sharded_decode(
         )
         x_last = _select_stage0(x[:, -1, :])
         logits = _head_logits(params, x_last, config)
-        tok = sampling.sample_tokens(logits, _dp_fold(key), history, settings)
+        tok = sampling.sample_tokens(logits, _dp_fold(key, plan.dp), history,
+                                     settings)
         history, hist_slot = sampling.push_history_batched(history, hist_slot, tok)
         return tok, KVCache(k=ck, v=cv), history, hist_slot
+
+    in_specs = [
+        param_specs(params_like),
+        P(DP),
+        KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+        P(),
+        P(None),
+        P(DP, None),
+        P(),
+    ]
+    if steps == 1:
+        step = one_step
+    else:
+        def step(params, token, cache, pos, key, history, hist_slot, index0):
+            def body(carry, i):
+                token, cache, history, hist_slot = carry
+                tok, cache, history, hist_slot = one_step(
+                    params, token, cache, pos + i,
+                    jax.random.fold_in(key, index0 + i), history, hist_slot,
+                )
+                return (tok, cache, history, hist_slot), tok
+
+            (_, cache, history, hist_slot), toks = jax.lax.scan(
+                body, (token, cache, history, hist_slot),
+                jnp.arange(steps, dtype=jnp.int32),
+            )
+            return toks, cache, history, hist_slot
+
+        in_specs.append(P())  # index0
 
     sharded = jax.shard_map(
         step,
         mesh=plan.mesh,
-        in_specs=(
-            param_specs(params_like),
-            P(DP),
-            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
-            P(),
-            P(None),
-            P(DP, None),
-            P(),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(
-            P(DP),
+            P(DP) if steps == 1 else P(None, DP),
             KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
             P(DP, None),
             P(),

@@ -165,6 +165,9 @@ class GeneratorBase:
         self._pos = 0
         self._last_token: int | None = None
         self._eos_ids = set(config.eos_ids())
+        # fused block-decode buffer (subclasses with block_size > 1)
+        self.block_size = 1
+        self._block_buf: list[int] = []
 
     # -- prompt handling ----------------------------------------------------
     def set_prompt(self, prompt: str | list[int]) -> None:
@@ -211,6 +214,7 @@ class GeneratorBase:
                 jnp.asarray(tail, jnp.int32)
             )
             self._hist_slot = jnp.int32(len(tail))
+        self._block_buf = []
         self._on_new_prompt()
 
     def _on_new_prompt(self) -> None:
@@ -234,6 +238,20 @@ class GeneratorBase:
         is_eos = tok_id in self._eos_ids
         text = self.stream.next_token(tok_id) if self.stream else None
         return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
+
+    def _decode_next(self, index: int, run_block, run_single) -> Token:
+        """Shared block-decode control flow: pop the buffer, else dispatch a
+        fused ``block_size``-step block (``run_block(index) -> list[int]``,
+        which must advance ``_pos``/history), else a single step
+        (``run_single(index) -> int``) for block_size == 1 or the tail of
+        the KV window."""
+        if self._block_buf:
+            return self._finish_token(self._block_buf.pop(0))
+        self._check_capacity()
+        if self.block_size > 1 and self._pos + self.block_size <= self.max_seq:
+            self._block_buf = run_block(index)
+            return self._finish_token(self._block_buf.pop(0))
+        return self._finish_token(run_single(index))
 
     # -- Generator trait surface --------------------------------------------
     def next_token(self, index: int) -> Token:  # pragma: no cover - abstract
@@ -279,7 +297,6 @@ class LlamaGenerator(GeneratorBase):
         super().__init__(config, tokenizer, settings, max_seq)
         self.params = params
         self.block_size = max(1, block_size)
-        self._block_buf: list[int] = []
         self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
                                 dtype=cache_dtype)
         self._prefill = jax.jit(
@@ -300,8 +317,32 @@ class LlamaGenerator(GeneratorBase):
             if self.block_size > 1 else self._decode_single
         )
 
-    def _on_new_prompt(self) -> None:
-        self._block_buf = []
+    def _run_block(self, index: int) -> list[int]:
+        toks, self.cache, self._history, self._hist_slot = self._decode(
+            self.params,
+            jnp.asarray([self._last_token], jnp.int32),
+            self.cache,
+            jnp.int32(self._pos),
+            self._key,  # base key; scan folds with the absolute index
+            self._history,
+            self._hist_slot,
+            index0=jnp.int32(index),
+        )
+        self._pos += self.block_size
+        return [int(t) for t in toks]
+
+    def _run_single(self, index: int) -> int:
+        tok, self.cache, self._history, self._hist_slot = self._decode_single(
+            self.params,
+            jnp.asarray([self._last_token], jnp.int32),
+            self.cache,
+            jnp.int32(self._pos),
+            jax.random.fold_in(self._key, index),
+            self._history,
+            self._hist_slot,
+        )
+        self._pos += 1
+        return int(tok)
 
     def next_token(self, index: int) -> Token:
         """index 0: prefill the whole prompt; index>0: one-token decode
@@ -325,32 +366,4 @@ class LlamaGenerator(GeneratorBase):
             )
             self._pos = n
             return self._finish_token(int(tok))
-        if self._block_buf:
-            return self._finish_token(self._block_buf.pop(0))
-        self._check_capacity()
-        if self.block_size > 1 and self._pos + self.block_size <= self.max_seq:
-            toks, self.cache, self._history, self._hist_slot = self._decode(
-                self.params,
-                jnp.asarray([self._last_token], jnp.int32),
-                self.cache,
-                jnp.int32(self._pos),
-                self._key,  # base key; scan folds with the absolute index
-                self._history,
-                self._hist_slot,
-                index0=jnp.int32(index),
-            )
-            self._pos += self.block_size
-            self._block_buf = [int(t) for t in toks]
-            return self._finish_token(self._block_buf.pop(0))
-        # single-step path (block_size == 1, or the tail of the KV window)
-        tok, self.cache, self._history, self._hist_slot = self._decode_single(
-            self.params,
-            jnp.asarray([self._last_token], jnp.int32),
-            self.cache,
-            jnp.int32(self._pos),
-            jax.random.fold_in(self._key, index),
-            self._history,
-            self._hist_slot,
-        )
-        self._pos += 1
-        return self._finish_token(int(tok))
+        return self._decode_next(index, self._run_block, self._run_single)

@@ -47,7 +47,15 @@ class MeshGenerator(GeneratorBase):
         tp: int = 1,
         sp: int = 1,
         devices=None,
+        block_size: int = 1,
     ):
+        """``block_size > 1`` runs K pipeline+sample steps inside the one
+        compiled mesh program per dispatch (build_sharded_decode steps=K) and
+        streams the buffered tokens. The sampling key schedule folds the
+        absolute token index — the same schedule as the local and
+        distributed paths — so one seed yields one stochastic stream
+        regardless of sharding or block size (modulo the dp fold, identity
+        at dp=1)."""
         super().__init__(config, tokenizer, settings, max_seq)
         if plan is None:
             plan = MeshPlan.build(
@@ -63,14 +71,23 @@ class MeshGenerator(GeneratorBase):
                 f"max_seq {self.max_seq} not divisible by sp {plan.sp}"
             )
         self.plan = plan
+        self.block_size = max(1, block_size)
+        self._block_buf: list[int] = []
         self.params = shard_params(params, plan.mesh)
         self.cache = shard_cache(
             init_cache(config, batch=1, max_seq=self.max_seq), plan.mesh
         )
         self._prefill = build_sharded_prefill(config, plan,
                                               params_like=self.params)
-        self._decode = build_sharded_decode(config, self.settings, plan,
-                                            params_like=self.params)
+        self._decode_single = build_sharded_decode(
+            config, self.settings, plan, params_like=self.params
+        )
+        self._decode_block = (
+            build_sharded_decode(config, self.settings, plan,
+                                 params_like=self.params,
+                                 steps=self.block_size)
+            if self.block_size > 1 else None
+        )
 
     def next_token(self, index: int) -> Token:
         if index == 0:
@@ -98,18 +115,34 @@ class MeshGenerator(GeneratorBase):
             self._pos = n
             tok_id = int(tok)
         else:
-            self._check_capacity()
-            step_key = jax.random.fold_in(self._key, index)
-            tok, self.cache, history2d, self._hist_slot = self._decode(
-                self.params,
-                jnp.asarray([self._last_token], jnp.int32),
-                self.cache,
-                jnp.int32(self._pos),
-                step_key,
-                self._history[None, :],
-                self._hist_slot,
-            )
-            self._history = history2d[0]
-            self._pos += 1
-            tok_id = int(tok[0])
+            return self._decode_next(index, self._run_block, self._run_single)
         return self._finish_token(tok_id)
+
+    def _run_block(self, index: int) -> list[int]:
+        toks, self.cache, history2d, self._hist_slot = self._decode_block(
+            self.params,
+            jnp.asarray([self._last_token], jnp.int32),
+            self.cache,
+            jnp.int32(self._pos),
+            self._key,  # program folds fold_in(key, index0 + i) per step
+            self._history[None, :],
+            self._hist_slot,
+            jnp.int32(index),
+        )
+        self._history = history2d[0]
+        self._pos += self.block_size
+        return [int(t[0]) for t in toks]
+
+    def _run_single(self, index: int) -> int:
+        tok, self.cache, history2d, self._hist_slot = self._decode_single(
+            self.params,
+            jnp.asarray([self._last_token], jnp.int32),
+            self.cache,
+            jnp.int32(self._pos),
+            jax.random.fold_in(self._key, index),
+            self._history[None, :],
+            self._hist_slot,
+        )
+        self._history = history2d[0]
+        self._pos += 1
+        return int(tok[0])

@@ -64,10 +64,34 @@ def test_dp_plan_rejected(params):
         MeshGenerator(CFG, params, plan=plan)
 
 
-def test_topology_and_mesh_flags_conflict():
-    from cake_tpu.cli import build_parser
+def test_block_decode_greedy_parity(params):
+    """Mesh block decode (K steps inside the compiled program) streams the
+    same greedy tokens as single-step mesh and all-local generation."""
+    settings = SamplerSettings(**GREEDY)
+    g = MeshGenerator(CFG, params, settings=settings, num_stages=2, tp=2,
+                      block_size=4)
+    g.set_prompt([5, 9, 2, 11])
+    got = [g.next_token(i).id for i in range(9)]
+    assert got == _local_stream(params, [5, 9, 2, 11], 9, settings)
 
-    args = build_parser().parse_args(
-        ["--model", "x", "--stages", "2", "--topology", "t.yml"]
-    )
-    assert args.stages == 2 and args.topology == "t.yml"
+
+def test_sampled_stream_invariant_across_paths(params):
+    """One seed -> one stochastic stream, regardless of execution path:
+    local, local blocked, mesh, mesh blocked all reproduce the same tokens
+    (token-index key schedule everywhere; dp fold and batch split are
+    identity in the single-stream case)."""
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=11)
+    local = _local_stream(params, [5, 9, 2], 9, settings)
+
+    def mesh_stream(**kw):
+        g = MeshGenerator(CFG, params, settings=settings, **kw)
+        g.set_prompt([5, 9, 2])
+        return [g.next_token(i).id for i in range(9)]
+
+    assert mesh_stream(num_stages=2) == local
+    assert mesh_stream(num_stages=2, block_size=4) == local
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    g = LlamaGenerator(CFG, params, settings=settings, block_size=4)
+    g.set_prompt([5, 9, 2])
+    assert [g.next_token(i).id for i in range(9)] == local
