@@ -29,6 +29,7 @@ from cake_tpu.ops import sampling
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.runner import BlockRunner, LocalRunner, RemoteRunner
 from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import wire
 from cake_tpu.runtime.generator import GeneratorBase, Token, _bucket, _lm_head
 
 log = logging.getLogger("cake_tpu.master")
@@ -102,6 +103,8 @@ class DistributedGenerator(GeneratorBase):
         self._runner_time = [0.0] * len(runners)
         self._runner_calls = [0] * len(runners)
         self._runner_warmup = [0.0] * len(runners)
+        self.recoveries = 0  # successful mid-stream reconnect+replay count
+        self._timing_paused = False  # replay forwards are not decode samples
 
     def _on_new_prompt(self) -> None:
         self._t_start = None
@@ -122,13 +125,38 @@ class DistributedGenerator(GeneratorBase):
             t0 = time.perf_counter()
             x = runner.forward(x, pos)
             dt = time.perf_counter() - t0
-            if self._runner_warmup[i] == 0.0:
+            if self._timing_paused:
+                pass  # recovery replay: prefill-sized, not steady-state
+            elif self._runner_warmup[i] == 0.0:
                 self._runner_warmup[i] = dt
             else:
                 self._runner_time[i] += dt
                 self._runner_calls[i] += 1
         x_last = jnp.asarray(x[:, last_index, :])
         return self._head_fn(x_last)[0]
+
+    def _replay_context(self) -> jax.Array:
+        """Failure recovery the reference lacks (SURVEY §5: a dropped worker
+        connection just ends the generation, client.rs:52-61): reconnect
+        every segment — a fresh connection means a fresh worker-side KV
+        cache (worker.rs:52-61) — and rebuild all segment caches by
+        replaying prompt + generated-so-far in one pass. Returns logits at
+        the last context position, ready to sample the next token."""
+        for r in self.runners:
+            r.reset()
+        ctx = self._prompt_tokens + self._generated
+        n = len(ctx)
+        if n >= self.max_seq:
+            raise RuntimeError("cannot recover: context exceeds max_seq")
+        t_pad = _bucket(n, self.max_seq)
+        self._timing_paused = True
+        try:
+            logits = self._forward(ctx + [0] * (t_pad - n), 0, n - 1)
+        finally:
+            self._timing_paused = False
+        self._pos = n
+        self.recoveries += 1
+        return logits
 
     # -- Generator trait ----------------------------------------------------
     def next_token(self, index: int) -> Token:
@@ -142,8 +170,14 @@ class DistributedGenerator(GeneratorBase):
             self._pos = n
         else:
             self._check_capacity()
-            logits = self._forward([self._last_token], self._pos, 0)
-            self._pos += 1
+            try:
+                logits = self._forward([self._last_token], self._pos, 0)
+                self._pos += 1
+            except (RuntimeError, OSError, wire.WireError) as e:
+                log.warning("segment forward failed (%s); reconnecting and "
+                            "replaying %d-token context", e,
+                            len(self._prompt_tokens) + len(self._generated))
+                logits = self._replay_context()
 
         step_key = jax.random.fold_in(self._key, index)
         tok = self._sample_fn(logits, step_key, self._history)

@@ -72,7 +72,6 @@ class MeshGenerator(GeneratorBase):
             )
         self.plan = plan
         self.block_size = max(1, block_size)
-        self._block_buf: list[int] = []
         self.params = shard_params(params, plan.mesh)
         self.cache = shard_cache(
             init_cache(config, batch=1, max_seq=self.max_seq), plan.mesh

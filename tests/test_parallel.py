@@ -242,3 +242,19 @@ def test_sp_greedy_decode_matches_unsharded(params, stages, tp, dp, sp):
 def test_sp_validate_rejects_indivisible_window():
     with pytest.raises(ValueError, match="sp"):
         validate_shardable(tiny(max_seq_len=30), num_stages=1, tp=1, sp=4)
+
+
+def test_70b_and_8b_shardability_envelopes():
+    """The BASELINE deployment shapes divide cleanly: 8B across 4 stages
+    (config 3) and 70B across 16 stages with tp/sp (configs 4-5)."""
+    from cake_tpu.models.config import llama3_70b, llama3_8b
+    from cake_tpu.parallel.mesh import validate_shardable
+
+    validate_shardable(llama3_8b(), num_stages=4, tp=1)
+    validate_shardable(llama3_8b(), num_stages=4, tp=2, sp=2)
+    c70 = llama3_70b()
+    validate_shardable(c70, num_stages=16, tp=1)
+    validate_shardable(c70, num_stages=16, tp=4, sp=4)
+    validate_shardable(c70, num_stages=8, tp=8, sp=2)
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_shardable(c70, num_stages=3, tp=1)

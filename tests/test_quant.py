@@ -152,3 +152,20 @@ def test_quantized_sharded_pipeline(cfg, params):
         init_cache(cfg, 1, cfg.max_seq_len), 0, cfg,
     )
     assert int(tok[0]) == int(jnp.argmax(logits_ref[0]))
+
+
+def test_quantized_block_decode_matches_single(cfg, params):
+    """int8 weights + fused multi-step decode: the blocked stream equals the
+    single-step quantized stream (quant.dense inside lax.scan)."""
+    from cake_tpu.ops.quant import quantize_params
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    qp = quantize_params(params)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    a = LlamaGenerator(cfg, qp, settings=settings)
+    a.set_prompt([5, 9, 2])
+    single = [a.next_token(i).id for i in range(9)]
+    b = LlamaGenerator(cfg, qp, settings=settings, block_size=4)
+    b.set_prompt([5, 9, 2])
+    assert [b.next_token(i).id for i in range(9)] == single
