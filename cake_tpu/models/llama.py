@@ -81,6 +81,63 @@ def init_params(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
     }
 
 
+def init_params_int8(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init params with every linear quantized to int8 — *without*
+    ever materializing the full bf16/f32 model on device.
+
+    :func:`init_params` + ``quantize_params`` peaks at full-precision bytes
+    plus int8 bytes, which cannot fit Llama-3-8B on a 16 GiB v5e chip
+    (~14.5 GiB usable). Here each stacked linear is generated and quantized
+    inside one jitted ``lax.map`` over layers, so the f32 temporaries are
+    per-layer-sized and freed at jit exit; peak stays near the int8 total.
+    """
+    from functools import partial as _partial
+
+    from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear
+
+    dt = dtype or config.jax_dtype
+    L = config.num_hidden_layers
+    keys = iter(jax.random.split(key, len(_LAYER_SHAPES) + 3))
+
+    @_partial(jax.jit, static_argnums=(1, 2, 3))
+    def qdense(k, shape, fan_in, stacked):
+        def one(kk):
+            w = jax.random.normal(kk, shape, jnp.float32) / jnp.sqrt(fan_in)
+            ql = quantize_linear(w)  # the one quantization convention
+            return ql.q, ql.scale
+
+        if not stacked:
+            return one(k)
+        return jax.lax.map(one, jax.random.split(k, L))
+
+    layers = {}
+    for name, shape_fn in _LAYER_SHAPES.items():
+        shape = shape_fn(config)
+        k = next(keys)
+        if name in LAYER_LINEARS:
+            q, scale = qdense(k, shape, shape[0], True)
+            layers[name] = QuantizedLinear(q=q, scale=scale)
+        else:  # norms
+            layers[name] = jnp.ones((L,) + shape, dt)
+
+    embed = (
+        jax.random.normal(
+            next(keys), (config.vocab_size, config.hidden_size), jnp.float32
+        )
+        / jnp.sqrt(config.hidden_size)
+    ).astype(dt)
+    hq, hscale = qdense(
+        next(keys), (config.hidden_size, config.vocab_size),
+        config.hidden_size, False,
+    )
+    return {
+        "embed": embed,
+        "layers": layers,
+        "norm_f": jnp.ones((config.hidden_size,), dt),
+        "lm_head": QuantizedLinear(q=hq, scale=hscale),
+    }
+
+
 def block_forward(
     layer: Params,  # one layer's weights (no leading L axis)
     x: jax.Array,  # [B, T, hidden]
@@ -96,6 +153,7 @@ def block_forward(
     sp_axis: str | None = None,
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
+    sp_prefill: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One pre-norm decoder block (transformer.rs:48-64).
 
@@ -116,6 +174,7 @@ def block_forward(
         sp_axis=sp_axis,
         sp_size=sp_size,
         write_gate=write_gate,
+        sp_prefill=sp_prefill,
     )
     x = x + attn_out
     h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
@@ -138,6 +197,7 @@ def forward_layers(
     sp_axis: str | None = None,
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
+    sp_prefill: bool | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run a contiguous run of decoder blocks via ``lax.scan``.
 
@@ -152,7 +212,8 @@ def forward_layers(
         h, kc, vc = block_forward(layer, h, kc, vc, cos, sin, pos, config,
                                   num_heads=num_heads, num_kv_heads=num_kv_heads,
                                   tp_axis=tp_axis, sp_axis=sp_axis,
-                                  sp_size=sp_size, write_gate=write_gate)
+                                  sp_size=sp_size, write_gate=write_gate,
+                                  sp_prefill=sp_prefill)
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))

@@ -28,6 +28,8 @@ fetched. This XLA path remains the fallback and the parity oracle
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 
@@ -35,6 +37,8 @@ from cake_tpu.ops import kvcache as kv
 from cake_tpu.ops import pallas as pk
 from cake_tpu.ops import quant
 from cake_tpu.ops.rope import apply_rope
+
+log = logging.getLogger("cake_tpu.attention")
 
 NEG_INF = -1e30
 
@@ -52,15 +56,31 @@ def attend(
     pos,  # scalar: absolute position of q[..., 0, :]
     impl: str = "auto",  # auto | xla | flash
 ) -> jax.Array:
-    """Masked GQA attention over a fixed-size KV buffer. Returns [B,H,T,D]."""
+    """Masked GQA attention over a fixed-size KV buffer. Returns [B,H,T,D].
+
+    ``pos`` may be scalar or ``[B]`` (per-row causal frontiers — the
+    multi-stream serving path; per-row is supported by the XLA path and the
+    flash decode kernel, T>1 per-row routes to XLA).
+    """
     t, d = q.shape[2], q.shape[3]
     s = k_all.shape[2]
+    per_row = jnp.asarray(pos).ndim == 1
+    if per_row and t > 1 and impl != "xla":
+        impl = "xla"  # per-row prefill: XLA only (not a served path)
     if impl == "auto":
-        impl = (
-            "flash"
-            if pk.kernels_enabled() and (pk.interpret_default() or _flash_ok(t, s, d))
-            else "xla"
-        )
+        if pk.kernels_enabled() and (pk.interpret_default() or _flash_ok(t, s, d)):
+            impl = "flash"
+        else:
+            impl = "xla"
+            if pk.kernels_enabled():
+                # Runs at trace time (once per compiled shape), so this is a
+                # one-line notice, not per-step spam: a misaligned config
+                # must not silently lose the kernels.
+                log.warning(
+                    "flash kernels enabled but shape (T=%d, S=%d, D=%d) is "
+                    "not lane-aligned (need D%%128==0 and S%%128==0); "
+                    "falling back to the XLA attention path", t, s, d,
+                )
     if impl == "flash":
         if t == 1:
             return pk.flash_decode(q, k_all, v_all, pos)
@@ -74,7 +94,8 @@ def _attend_xla(
     v_all: jax.Array,
     pos,
 ) -> jax.Array:
-    """Reference-math XLA path (full [T, S] scores, mask by iota compare)."""
+    """Reference-math XLA path (full [T, S] scores, mask by iota compare).
+    ``pos`` scalar or ``[B]`` (per-row causal frontier)."""
     b, n_heads, t, d = q.shape
     kv_heads, s = k_all.shape[1], k_all.shape[2]
     group = n_heads // kv_heads
@@ -86,10 +107,16 @@ def _attend_xla(
     ) / jnp.sqrt(jnp.float32(d))
 
     # Causal frontier: key position valid iff kpos <= pos + t_idx.
+    pos = jnp.asarray(pos, jnp.int32)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1)
-    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0) + jnp.asarray(pos, jnp.int32)
-    mask = kpos <= qpos  # [T, S]
-    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    if pos.ndim == 0:
+        mask = (kpos <= qpos + pos)[None, None, None]  # [1,1,1,T,S]
+    else:
+        mask = (kpos[None] <= qpos[None] + pos[:, None, None])[
+            :, None, None
+        ]  # [B,1,1,T,S]
+    scores = jnp.where(mask, scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
@@ -116,6 +143,7 @@ def self_attention_block(
     sp_axis: str | None = None,
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
+    sp_prefill: bool | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One attention sublayer incl. cache update.
 
@@ -131,11 +159,16 @@ def self_attention_block(
     The cache's sequence axis is sharded over this mesh axis; shard *i* owns
     global positions ``[i*S_l, (i+1)*S_l)``. Two modes:
 
-    - prefill (``T > 1``): ``x`` holds this shard's slice of the *full* cache
-      window (``T == S_l``, ``pos == 0``) — ring attention over the sp ring.
+    - prefill: ``x`` holds this shard's chunk of the (bucketed) prompt —
+      ring attention over the sp ring, chunked cache write.
     - decode (``T == 1``): ``x`` is replicated; the owner shard commits the
       new KV slot and exact softmax is reassembled from per-shard partials
       (distributed flash decoding).
+
+    ``sp_prefill`` selects the mode explicitly (the pipeline builders pass
+    it); ``None`` falls back to the ``T > 1`` heuristic, which is WRONG for
+    one-token-per-shard prefill chunks — callers that can produce
+    ``T_local == 1`` prefill must pass the flag.
 
     ``write_gate`` (scalar bool): when running inside an SPMD-uniform pipeline
     loop every stage executes this code every step (collectives must be
@@ -152,21 +185,35 @@ def self_attention_block(
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
+        if jnp.asarray(pos).ndim:
+            raise ValueError(
+                "per-row positions are not supported with sequence "
+                "parallelism (sp is the long-context single-stream plane); "
+                "use sp=1 for multi-stream serving"
+            )
         s_l = k_cache.shape[2]
         sp_idx = jax.lax.axis_index(sp_axis)
-        if t > 1:
-            # Sequence-parallel prefill over the full padded cache window.
-            if t != s_l:
+        is_prefill = sp_prefill if sp_prefill is not None else t > 1
+        if is_prefill:
+            # Sequence-parallel prefill: the prompt (bucketed to a multiple
+            # of sp) is sharded over the ring; ring attention costs are
+            # prompt-proportional, not window-proportional.
+            if t > s_l:
                 raise ValueError(
-                    f"sp prefill requires the full cache window per shard "
-                    f"(T_local {t} != S_local {s_l}); pad the prompt to "
-                    "max_seq before sharding"
+                    f"sp prefill chunk (T_local {t}) exceeds the cache "
+                    f"window per shard (S_local {s_l})"
                 )
             my_off = sp_idx * t  # global position of this shard's token 0
             q = apply_rope(q, cos, sin, my_off)
             k = apply_rope(k, cos, sin, my_off)
-            k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, 0,
-                                               gate=write_gate)
+            if t == s_l:
+                # chunk layout == cache layout: write in place, no gather
+                k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, 0,
+                                                   gate=write_gate)
+            else:
+                k_cache, v_cache = ring.sp_chunked_cache_write(
+                    k_cache, v_cache, k, v, sp_axis, sp_size, gate=write_gate
+                )
             out = ring.ring_attention(q, k, v, sp_axis, sp_size, q_off=my_off)
         else:
             q = apply_rope(q, cos, sin, pos)

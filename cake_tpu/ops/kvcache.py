@@ -98,18 +98,31 @@ def update_layer(
     executes the identical program (collectives stay uniform) and only the
     active pipeline stage commits. Gated off, the touched region is just the
     ``T`` slots, not the whole buffer.
+
+    ``pos`` may be a scalar (all batch rows write at the same offset — the
+    single-stream paths) or ``[batch]`` (each row at its own offset — the
+    multi-stream serving path, where right-padded prompts of different
+    lengths decode concurrently).
     """
     t = k_new.shape[2]
+    pos = jnp.asarray(pos, jnp.int32)
 
     def write(cache, new):
         new = new.astype(cache.dtype)
-        if gate is not None:
-            cur = jax.lax.dynamic_slice_in_dim(
-                cache, jnp.asarray(pos, jnp.int32), t, axis=2
-            )
-            new = jnp.where(gate, new, cur)
-        zero = jnp.zeros((), jnp.int32)
-        start = (zero, zero, jnp.asarray(pos, jnp.int32), zero)
-        return jax.lax.dynamic_update_slice(cache, new, start)
+        if pos.ndim == 0:
+            if gate is not None:
+                cur = jax.lax.dynamic_slice_in_dim(cache, pos, t, axis=2)
+                new = jnp.where(gate, new, cur)
+            zero = jnp.zeros((), jnp.int32)
+            return jax.lax.dynamic_update_slice(cache, new, (zero, zero, pos, zero))
+
+        def one(c, n, p):  # c [KH, S, D], n [KH, T, D]
+            if gate is not None:
+                cur = jax.lax.dynamic_slice_in_dim(c, p, t, axis=1)
+                n = jnp.where(gate, n, cur)
+            zero = jnp.zeros((), jnp.int32)
+            return jax.lax.dynamic_update_slice(c, n, (zero, p, zero))
+
+        return jax.vmap(one)(cache, new, pos)
 
     return write(k_cache, k_new), write(v_cache, v_new)

@@ -190,7 +190,7 @@ def flash_attention(
 
 
 def _decode_kernel(
-    pos_ref,  # [1] int32
+    pos_ref,  # [B] int32 (per-row causal frontier; row b reads pos_ref[b])
     q_ref,  # [1, 1, G, D]
     k_ref,  # [1, 1, BK, D]
     v_ref,  # [1, 1, BK, D]
@@ -205,7 +205,7 @@ def _decode_kernel(
     num_kv_blocks: int,
 ):
     kb = pl.program_id(2)
-    pos = pos_ref[0]
+    pos = pos_ref[pl.program_id(0)]
 
     @pl.when(kb == 0)
     def _init():
@@ -260,7 +260,9 @@ def flash_decode(
 
     The GQA group is folded into q rows so each (batch, kv-head) grid cell is
     one [group, D] x [D, BK] matmul; KV blocks past ``pos`` are neither read
-    nor computed.
+    nor computed. ``pos`` may be scalar (shared frontier) or ``[B]``
+    (per-row frontiers — multi-stream serving): it is broadcast to a [B]
+    prefetch and each batch grid row clamps its own KV fetch window.
     """
     b, h, t, d = q.shape
     assert t == 1, "flash_decode requires T == 1"
@@ -272,7 +274,7 @@ def flash_decode(
         from cake_tpu.ops.pallas import interpret_default
 
         interpret = interpret_default()
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (b,))
     scale = 1.0 / math.sqrt(d)
     qg = q.reshape(b, kvh, group, d)
 
@@ -280,7 +282,7 @@ def flash_decode(
         return (bi, khi, 0, 0)
 
     def kv_map(bi, khi, kb, pos_ref):
-        return (bi, khi, jnp.minimum(kb, jax.lax.div(pos_ref[0], bk)), 0)
+        return (bi, khi, jnp.minimum(kb, jax.lax.div(pos_ref[bi], bk)), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

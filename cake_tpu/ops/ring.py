@@ -182,6 +182,50 @@ def sp_decode_attend(
     return finalize_stats(o, m, l, q.dtype)
 
 
+def sp_chunked_cache_write(
+    k_cache: jax.Array,  # [B, KH, S_l, D] local slice of the range-sharded cache
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [B, KH, T_l, D] this shard's prefill chunk (roped)
+    v_new: jax.Array,
+    axis_name: str,
+    axis_size: int,
+    gate: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Write chunk-sharded prefill KV into the range-sharded cache layout.
+
+    Chunked sp prefill shards the *prompt* (shard ``i`` computes KV for
+    global positions ``[i*T_l, (i+1)*T_l)``, ``T_pad = T_l * sp`` ≪ max_seq),
+    but the decode cache layout owns *ranges* of the full window (shard ``i``
+    holds ``[i*S_l, (i+1)*S_l)``). The two only coincide when the prompt is
+    padded to the full window (``T_l == S_l`` — the round-1 contract). Here
+    the roped KV is all-gathered over sp — prompt-proportional traffic, NOT
+    window-proportional — and each shard slices the window it owns; positions
+    past the prompt stay zero and are overwritten slot-by-slot by decode
+    before they ever become attendable (same invariant as the local bucketed
+    prefill path).
+
+    ``gate``: pipeline-stage activity predicate; inactive stages keep their
+    cache unchanged.
+    """
+    s_l = k_cache.shape[2]
+    shard_start = jax.lax.axis_index(axis_name) * s_l
+
+    def write(cache, new):
+        allkv = jax.lax.all_gather(new, axis_name, axis=2, tiled=True)
+        # Pad the gathered [B, KH, T_pad, D] so the window slice below is
+        # always in-bounds: dynamic_slice clamps start to [0, T_pad], and a
+        # shard whose range begins past the prompt reads only zeros.
+        padded = jnp.pad(allkv, ((0, 0), (0, 0), (0, s_l), (0, 0)))
+        win = jax.lax.dynamic_slice_in_dim(
+            padded, shard_start, s_l, axis=2
+        ).astype(cache.dtype)
+        if gate is not None:
+            win = jnp.where(gate, win, cache)
+        return win
+
+    return write(k_cache, k_new), write(v_cache, v_new)
+
+
 def sp_cache_write(
     k_cache: jax.Array,  # [B, KH, S_l, D] local slice
     v_cache: jax.Array,

@@ -28,7 +28,11 @@ def _scale_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
     predates rope scaling — cache.rs:31-50 is the unscaled table only — but
     Llama-3.1 checkpoints require it.)
     """
-    kind = scaling.get("rope_type", scaling.get("type", "linear"))
+    kind = scaling.get("rope_type", scaling.get("type"))
+    if kind is None:
+        raise ValueError(
+            f"rope_scaling config has no 'rope_type'/'type' key: {scaling}"
+        )
     factor = float(scaling["factor"])
     if kind == "linear":
         return inv_freq / factor
@@ -65,13 +69,25 @@ def apply_rope(
 ) -> jax.Array:
     """Rotate ``x [batch, heads, T, head_dim]`` for absolute positions
     ``pos .. pos+T`` (the reference's ``cosine/sine(index_pos, seq_len)``
-    slice, cache.rs:71-78)."""
+    slice, cache.rs:71-78).
+
+    ``pos`` may be a scalar (shared by all batch rows) or ``[batch]``
+    (per-row positions — the multi-stream serving path)."""
     b, h, t, d = x.shape
     half = d // 2
-    cos_t = jax.lax.dynamic_slice_in_dim(cos, jnp.asarray(pos, jnp.int32), t, axis=0)
-    sin_t = jax.lax.dynamic_slice_in_dim(sin, jnp.asarray(pos, jnp.int32), t, axis=0)
-    cos_t = cos_t[None, None, :, :]  # [1,1,T,half]
-    sin_t = sin_t[None, None, :, :]
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, t, axis=0)
+        sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, t, axis=0)
+        cos_t = cos_t[None, None, :, :]  # [1,1,T,half]
+        sin_t = sin_t[None, None, :, :]
+    else:
+        def rows(table):  # [B, 1, T, half] — per-row table slices
+            return jax.vmap(
+                lambda p: jax.lax.dynamic_slice_in_dim(table, p, t, axis=0)
+            )(pos)[:, None, :, :]
+
+        cos_t, sin_t = rows(cos), rows(sin)
     x1, x2 = x[..., :half], x[..., half:]
     rotated = jnp.concatenate(
         [x1 * cos_t - x2 * sin_t, x1 * sin_t + x2 * cos_t], axis=-1

@@ -111,6 +111,25 @@ def sample_tokens(
     )
 
 
+def sample_tokens_keyed(
+    logits: jax.Array,  # [B, vocab] f32
+    row_keys: jax.Array,  # [B, 2] uint32 — one PRNG key per stream
+    history: jax.Array,  # [B, repeat_last_n] int32
+    settings: SamplerSettings,
+) -> jax.Array:
+    """Batched sampling with *explicit per-row keys* -> [B] int32.
+
+    Unlike :func:`sample_tokens` (which derives row keys from one key by
+    batch-size-dependent splitting), each stream here owns its key, so a
+    stream's stochastic output depends only on (its key, its logits, its
+    history) — invariant to batch composition and mesh layout. This is the
+    multi-stream serving contract: stream key = fold_in(base, stream_id),
+    stepped by fold_in(. , token_index) in the caller/program."""
+    return jax.vmap(lambda l, k, h: sample_token(l, k, h, settings))(
+        logits, row_keys, history
+    )
+
+
 def push_history(history: jax.Array, slot: jax.Array, token: jax.Array):
     """Write ``token`` into the ring buffer at ``slot % len`` and bump slot."""
     n = history.shape[0]
@@ -119,12 +138,18 @@ def push_history(history: jax.Array, slot: jax.Array, token: jax.Array):
 
 
 def push_history_batched(history: jax.Array, slot: jax.Array, tokens: jax.Array):
-    """Batched ring-buffer write: ``history [B, N]``, ``tokens [B]``, shared
-    scalar ``slot``. Single source of the ring semantics for the sharded
-    decode path."""
+    """Batched ring-buffer write: ``history [B, N]``, ``tokens [B]``. ``slot``
+    is a shared scalar (single-stream paths: every row at the same ring
+    position) or ``[B]`` (multi-stream serving: each stream's ring is seeded
+    with its own prompt tail, so slots differ per row). Single source of the
+    ring semantics for the sharded decode path."""
     n = history.shape[1]
+    slot = jnp.asarray(slot, jnp.int32)
     idx = jnp.mod(slot, n)
-    return history.at[:, idx].set(tokens), slot + 1
+    if slot.ndim == 0:
+        return history.at[:, idx].set(tokens), slot + 1
+    b = history.shape[0]
+    return history.at[jnp.arange(b), idx].set(tokens), slot + 1
 
 
 def init_history(repeat_last_n: int) -> tuple[jax.Array, jax.Array]:

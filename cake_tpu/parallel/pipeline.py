@@ -66,6 +66,7 @@ def _pipeline_layers(
     heads_l: int,
     kv_heads_l: int,
     sp: int = 1,
+    sp_prefill: bool = False,
 ):
     """Run the staged pipeline loop. Returns (x_on_stage0, ck, cv).
 
@@ -89,7 +90,7 @@ def _pipeline_layers(
         h, new_cache = llama.forward_layers(
             layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
             num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
-            sp_axis=SP, sp_size=sp, write_gate=active,
+            sp_axis=SP, sp_size=sp, write_gate=active, sp_prefill=sp_prefill,
         )
         x = jnp.where(active, h, x)
         x = jax.lax.ppermute(x, STAGE, perm)
@@ -138,7 +139,7 @@ def _dp_fold(key: jax.Array, dp: int) -> jax.Array:
 
 def build_sharded_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
-    params_like: dict | None = None, steps: int = 1,
+    params_like: dict | None = None, steps: int = 1, per_row: bool = False,
 ):
     """Compile the fused multi-chip decode step.
 
@@ -154,8 +155,20 @@ def build_sharded_decode(
     path, so one seed yields one stream regardless of sharding or block
     size. ``params_like``: pass the params pytree (or a structural twin)
     when some linears are int8-quantized so the shard_map specs match.
+
+    ``per_row=True`` is the multi-stream serving mode: ``pos`` becomes
+    ``[B]`` (each stream decodes at its own position — right-padded prompts
+    of different lengths run concurrently) and ``key`` becomes per-stream
+    keys ``[B, 2] uint32``; the program folds the absolute token index into
+    each stream's key (``fold_in(row_key, index0 + i)``), so a stream's
+    output depends only on (its key, its prompt) — invariant to batch
+    composition and mesh layout. The signature always ends with ``index0``
+    in this mode. Requires ``plan.sp == 1``.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if per_row and plan.sp != 1:
+        raise ValueError("per_row decode requires sp == 1 (sequence "
+                         "parallelism is the single-stream long-context plane)")
 
     def one_step(params, token, cache, pos, key, history, hist_slot):
         # cache.max_seq inside shard_map is the per-shard slice; RoPE tables
@@ -168,32 +181,41 @@ def build_sharded_decode(
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
             plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+            sp_prefill=False,
         )
         x_last = _select_stage0(x[:, -1, :])
         logits = _head_logits(params, x_last, config)
-        tok = sampling.sample_tokens(logits, _dp_fold(key, plan.dp), history,
-                                     settings)
+        if per_row:
+            tok = sampling.sample_tokens_keyed(logits, key, history, settings)
+        else:
+            tok = sampling.sample_tokens(logits, _dp_fold(key, plan.dp),
+                                         history, settings)
         history, hist_slot = sampling.push_history_batched(history, hist_slot, tok)
         return tok, KVCache(k=ck, v=cv), history, hist_slot
+
+    def fold_key(key, index):
+        if per_row:
+            return jax.vmap(lambda k: jax.random.fold_in(k, index))(key)
+        return jax.random.fold_in(key, index)
 
     in_specs = [
         param_specs(params_like),
         P(DP),
         KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
-        P(),
-        P(None),
+        P(DP) if per_row else P(),
+        P(DP, None) if per_row else P(None),
         P(DP, None),
-        P(),
+        P(DP) if per_row else P(),  # hist_slot: per-stream ring positions
     ]
-    if steps == 1:
+    if steps == 1 and not per_row:
         step = one_step
     else:
         def step(params, token, cache, pos, key, history, hist_slot, index0):
             def body(carry, i):
                 token, cache, history, hist_slot = carry
                 tok, cache, history, hist_slot = one_step(
-                    params, token, cache, pos + i,
-                    jax.random.fold_in(key, index0 + i), history, hist_slot,
+                    params, token, cache, pos + i, fold_key(key, index0 + i),
+                    history, hist_slot,
                 )
                 return (tok, cache, history, hist_slot), tok
 
@@ -201,6 +223,8 @@ def build_sharded_decode(
                 body, (token, cache, history, hist_slot),
                 jnp.arange(steps, dtype=jnp.int32),
             )
+            if steps == 1:
+                return toks[0], cache, history, hist_slot
             return toks, cache, history, hist_slot
 
         in_specs.append(P())  # index0
@@ -213,7 +237,7 @@ def build_sharded_decode(
             P(DP) if steps == 1 else P(None, DP),
             KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
             P(DP, None),
-            P(),
+            P(DP) if per_row else P(),
         ),
         check_vma=False,
     )
@@ -226,11 +250,14 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
     (logits [B, vocab] f32, cache)``. With ``plan.sp == 1``, ``T`` may be any
-    bucketed length; with sequence parallelism (``sp > 1``) ``T`` must equal
-    the cache window (pad the prompt to max_seq) — each sp shard then runs
-    ring attention over its ``T/sp`` slice (:mod:`cake_tpu.ops.ring`), and
-    positions past the prompt hold garbage KV that decode steps overwrite
-    slot-by-slot before they ever become attendable.
+    bucketed length; with sequence parallelism (``sp > 1``) ``T`` must be a
+    multiple of sp no larger than max_seq — each sp shard runs ring attention
+    over its ``T/sp`` chunk (:mod:`cake_tpu.ops.ring`), so prefill FLOPs and
+    ring traffic scale with the prompt, not the window, and the roped KV is
+    redistributed into the range-sharded cache layout
+    (``ring.sp_chunked_cache_write``). Positions past the prompt hold zero KV
+    that decode steps overwrite slot-by-slot before they ever become
+    attendable.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
 
@@ -240,9 +267,13 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             scaling=config.rope_scaling,
         )
         x = params["embed"][tokens].astype(config.jax_dtype)
+        # sp_prefill explicit: a bucketed prompt can give each shard a
+        # ONE-token chunk, which the T>1 heuristic would misroute to the
+        # decode branch (silently wrong logits — r2 code-review finding)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
             plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+            sp_prefill=True,
         )
         # slice the wanted position first so the cross-stage select moves
         # [B, hidden], not the whole [B, T, hidden] activation

@@ -1,0 +1,308 @@
+"""Multi-stream serving: N prompts decode concurrently over the mesh batch.
+
+The reference is strictly single-request — "no batching of concurrent
+requests" (SURVEY.md §0; one master walks one stream, master.rs:21-65). This
+is the TPU-native capability on top of the same pipeline: the batch axis of
+the fused mesh program (parallel/pipeline.py) shards over the ``dp`` mesh
+axis, and every decode dispatch advances *all* streams by one token (or one
+``block_size`` block).
+
+Per-stream independence is real, not cosmetic:
+
+- **positions**: prompts are right-padded to a shared bucket but each stream
+  decodes at its own position (``pos [B]`` — per-row RoPE slices, KV writes,
+  and causal frontiers down through the Pallas decode kernel), so a token's
+  positional geometry is identical to a single-stream run of the same prompt.
+- **sampling keys**: stream ``s`` owns ``fold_in(PRNGKey(seed), stream_id)``,
+  stepped by the absolute token index inside the compiled program
+  (pipeline per_row mode). A stream's stochastic output depends only on
+  (seed, stream_id, prompt) — invariant to batch composition, dp layout, and
+  block size.
+- **repeat-penalty history**: per-stream ring buffers seeded with each
+  prompt's tail, with per-stream ring slots (``hist_slot [B]``).
+- **EOS / detok**: tracked per stream; a finished stream stops emitting while
+  the batch keeps running (its rows keep computing into discarded outputs —
+  the SPMD analogue of the pipeline's gated inactive stages).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cake_tpu.models.config import LlamaConfig
+from cake_tpu.ops import sampling
+from cake_tpu.ops.kvcache import init_cache
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+from cake_tpu.parallel.pipeline import (
+    build_sharded_decode,
+    build_sharded_prefill,
+)
+from cake_tpu.runtime.generator import Token, _bucket
+from cake_tpu.utils.token_stream import TokenOutputStream
+
+
+@dataclasses.dataclass
+class _Stream:
+    stream_id: int
+    prompt: list[int]
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    active: bool = True  # False: batch-padding dummy, never emitted
+    detok: TokenOutputStream | None = None
+
+
+class BatchGenerator:
+    """Serve N prompts concurrently over one sharded model instance.
+
+    ``batch`` rows are sharded over the plan's dp axis (``N`` is padded up to
+    a multiple of dp with inactive dummy rows). ``block_size > 1`` fuses that
+    many decode steps per dispatch, same key schedule.
+    """
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        plan: MeshPlan | None = None,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        num_stages: int = 1,
+        tp: int = 1,
+        dp: int = 1,
+        devices=None,
+        block_size: int = 1,
+    ):
+        if plan is None:
+            plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
+                                  dp=dp, sp=1, devices=devices)
+        if plan.sp != 1:
+            raise ValueError(
+                "BatchGenerator requires sp == 1 (sequence parallelism is "
+                "the single-stream long-context plane)"
+            )
+        self.config = config
+        self.plan = plan
+        self.settings = settings or SamplerSettings()
+        self.max_seq = max_seq or config.max_seq_len
+        self.tokenizer = tokenizer
+        self.block_size = max(1, block_size)
+        self.params = shard_params(params, plan.mesh)
+        self._prefill = build_sharded_prefill(config, plan,
+                                              params_like=self.params)
+        self._decode_single = build_sharded_decode(
+            config, self.settings, plan, params_like=self.params, per_row=True
+        )
+        self._decode_block = (
+            build_sharded_decode(config, self.settings, plan,
+                                 params_like=self.params,
+                                 steps=self.block_size, per_row=True)
+            if self.block_size > 1 else None
+        )
+        self._base_key = jax.random.PRNGKey(self.settings.seed)
+        self.streams: list[_Stream] = []
+        self._eos_ids = set(config.eos_ids())
+
+    # -- prompt intake -------------------------------------------------------
+    def set_prompts(
+        self,
+        prompts: list[list[int] | str],
+        stream_ids: list[int] | None = None,
+    ) -> None:
+        """Admit a batch of prompts. ``stream_ids`` pin each stream's
+        sampling-key identity (default: its index) — the handle that makes a
+        stream reproducible in any batch composition."""
+        if not prompts:
+            raise ValueError("empty batch")
+        ids_list = []
+        for p in prompts:
+            if isinstance(p, str):
+                if self.tokenizer is None:
+                    raise ValueError("string prompt requires a tokenizer")
+                enc = self.tokenizer.encode(p)
+                ids = list(getattr(enc, "ids", enc))
+                if self.config.bos_token_id is not None and (
+                    not ids or ids[0] != self.config.bos_token_id
+                ):
+                    ids = [self.config.bos_token_id] + ids
+            else:
+                ids = list(p)
+            if not ids:
+                raise ValueError("empty prompt")
+            if len(ids) >= self.max_seq:
+                raise ValueError(
+                    f"prompt length {len(ids)} >= max_seq {self.max_seq}"
+                )
+            bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
+            if bad:
+                # out-of-range ids would clamp in the embed gather and
+                # silently corrupt just this stream — fail like the
+                # single-stream set_prompt does
+                raise ValueError(
+                    f"prompt token ids out of range "
+                    f"[0, {self.config.vocab_size}): {bad[:5]}"
+                )
+            ids_list.append(ids)
+        if stream_ids is None:
+            stream_ids = list(range(len(ids_list)))
+        if len(stream_ids) != len(ids_list):
+            raise ValueError("stream_ids/prompts length mismatch")
+
+        # pad the batch to a dp multiple with inactive dummies (they compute,
+        # they are never emitted)
+        n_active = len(ids_list)
+        dp = self.plan.dp
+        batch = -(-n_active // dp) * dp
+        self.streams = [
+            _Stream(
+                stream_id=sid, prompt=ids,
+                detok=TokenOutputStream(self.tokenizer)
+                if self.tokenizer else None,
+            )
+            for sid, ids in zip(stream_ids, ids_list)
+        ]
+        for _ in range(batch - n_active):
+            self.streams.append(
+                _Stream(stream_id=-1, prompt=list(ids_list[0]), active=False)
+            )
+        b = len(self.streams)
+
+        # shared prompt bucket; per-stream true positions
+        n_max = max(len(s.prompt) for s in self.streams)
+        t_pad = _bucket(n_max, self.max_seq)
+        tokens = np.zeros((b, t_pad), np.int32)
+        last = np.zeros((b,), np.int32)
+        for i, s in enumerate(self.streams):
+            tokens[i, : len(s.prompt)] = s.prompt
+            last[i] = len(s.prompt) - 1
+        self._pos = np.asarray([len(s.prompt) for s in self.streams], np.int32)
+
+        # per-stream keys + histories seeded with each prompt's tail
+        keys = [
+            jax.random.fold_in(self._base_key, max(s.stream_id, 0))
+            for s in self.streams
+        ]
+        self._keys = jnp.stack(keys)  # [B, 2] uint32
+        n_hist = self.settings.repeat_last_n
+        hist = np.full((b, n_hist), -1, np.int32)
+        slots = np.zeros((b,), np.int32)
+        for i, s in enumerate(self.streams):
+            tail = s.prompt[-n_hist:]
+            hist[i, : len(tail)] = tail
+            slots[i] = len(tail)
+        self._history = jnp.asarray(hist)
+        self._hist_slot = jnp.asarray(slots)
+
+        self.cache = shard_cache(
+            init_cache(self.config, batch=b, max_seq=self.max_seq),
+            self.plan.mesh,
+        )
+        logits, self.cache = self._prefill(
+            self.params, jnp.asarray(tokens), self.cache, jnp.asarray(last)
+        )
+
+        # first token per stream: fold_in(stream_key, 0) — the same absolute
+        # token-index schedule the in-program decode steps continue
+        keys0 = jax.vmap(lambda k: jax.random.fold_in(k, 0))(self._keys)
+        toks = sampling.sample_tokens_keyed(
+            logits, keys0, self._history, self.settings
+        )
+        self._history, self._hist_slot = sampling.push_history_batched(
+            self._history, self._hist_slot, toks
+        )
+        self._last_tokens = toks.astype(jnp.int32)
+        self._index = 1  # absolute token index of the NEXT emitted token
+        self._emitted_first = False
+        self._block_buf: list[np.ndarray] = []
+
+    # -- stepping ------------------------------------------------------------
+    def _emit(self, row: np.ndarray) -> list[Token | None]:
+        """Turn one [B] token row into per-stream Tokens (None when done or
+        dummy), updating per-stream bookkeeping."""
+        out: list[Token | None] = []
+        for i, s in enumerate(self.streams):
+            if not s.active or s.done:
+                out.append(None)
+                continue
+            tok_id = int(row[i])
+            s.generated.append(tok_id)
+            window_full = len(s.prompt) + len(s.generated) >= self.max_seq
+            s.done = (tok_id in self._eos_ids) or window_full
+            text = s.detok.next_token(tok_id) if s.detok else None
+            out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done))
+        return out
+
+    def step(self) -> list[Token | None]:
+        """Advance every live stream one token; returns one entry per active
+        stream slot (None for finished/dummy streams)."""
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        if not self._emitted_first:
+            self._emitted_first = True
+            return self._emit(np.asarray(self._last_tokens))
+        if self._block_buf:
+            return self._emit(self._block_buf.pop(0))
+
+        # Capacity is per-stream: a finished stream's row keeps advancing
+        # (its clamped writes touch only its own cache row, whose output is
+        # discarded), so only LIVE streams gate block decode and exhaustion —
+        # a long stream hitting its window must not kill shorter ones.
+        live = [
+            self._pos[i]
+            for i, s in enumerate(self.streams)
+            if s.active and not s.done
+        ]
+        if not live:
+            return [None] * len(self.streams)
+        can_block = (
+            self._decode_block is not None
+            and int(max(live)) + self.block_size <= self.max_seq
+        )
+        if can_block:
+            toks, self.cache, self._history, self._hist_slot = (
+                self._decode_block(
+                    self.params, self._last_tokens, self.cache,
+                    jnp.asarray(self._pos), self._keys, self._history,
+                    self._hist_slot, jnp.int32(self._index),
+                )
+            )
+            rows = np.asarray(toks)  # [steps, B]
+            self._pos = self._pos + self.block_size
+            self._index += self.block_size
+            self._last_tokens = toks[-1].astype(jnp.int32)
+            self._block_buf = [rows[i] for i in range(rows.shape[0])]
+            return self._emit(self._block_buf.pop(0))
+
+        if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
+            raise RuntimeError("KV cache exhausted")  # window-full streams done
+        tok, self.cache, self._history, self._hist_slot = self._decode_single(
+            self.params, self._last_tokens, self.cache,
+            jnp.asarray(self._pos), self._keys, self._history,
+            self._hist_slot, jnp.int32(self._index),
+        )
+        self._pos = self._pos + 1
+        self._index += 1
+        self._last_tokens = tok.astype(jnp.int32)
+        return self._emit(np.asarray(tok))
+
+    def generate(self, max_new_tokens: int) -> list[list[int]]:
+        """Run all streams to EOS or ``max_new_tokens``; returns per-stream
+        generated ids (active streams only, in prompt order)."""
+        for _ in range(max_new_tokens):
+            self.step()
+            if all(s.done for s in self.streams if s.active):
+                break
+        return [s.generated for s in self.streams if s.active]
+
+    def texts(self) -> list[str | None]:
+        """Each active stream's full generated text (None w/o tokenizer)."""
+        return [
+            self.tokenizer.decode(s.generated) if self.tokenizer else None
+            for s in self.streams
+            if s.active
+        ]

@@ -92,12 +92,14 @@ class MeshGenerator(GeneratorBase):
         if index == 0:
             self._require_prompt()
             n = len(self._prompt_tokens)
-            # sp shards the prompt axis: prefill runs ring attention over the
-            # full cache window (pipeline.py build_sharded_prefill contract);
-            # without sp, bucketed lengths keep compile count O(log max_seq).
-            t_pad = (
-                self.max_seq if self.plan.sp > 1 else _bucket(n, self.max_seq)
-            )
+            # Bucketed prefill lengths keep compile count O(log max_seq).
+            # With sp the bucket must also divide into equal per-shard
+            # chunks: ring attention + the chunked cache write
+            # (ring.sp_chunked_cache_write) then cost prompt-proportional
+            # FLOPs/traffic instead of window-proportional.
+            t_pad = _bucket(n, self.max_seq)
+            if t_pad % self.plan.sp:
+                t_pad += self.plan.sp - t_pad % self.plan.sp
             padded = self._prompt_tokens + [0] * (t_pad - n)
             tokens = jnp.asarray([padded], jnp.int32)
             logits, self.cache = self._prefill(

@@ -64,12 +64,21 @@ def test_dp_plan_rejected(params):
         MeshGenerator(CFG, params, plan=plan)
 
 
-def test_block_decode_greedy_parity(params):
+@pytest.mark.parametrize(
+    "axes",
+    [
+        dict(num_stages=2, tp=2),
+        # block decode through the ring-attention KV layout: per-shard cache
+        # slices + global RoPE positions inside the lax.scan block path
+        dict(num_stages=2, tp=2, sp=2),
+    ],
+    ids=lambda a: "-".join(f"{k}{v}" for k, v in a.items()),
+)
+def test_block_decode_greedy_parity(params, axes):
     """Mesh block decode (K steps inside the compiled program) streams the
     same greedy tokens as single-step mesh and all-local generation."""
     settings = SamplerSettings(**GREEDY)
-    g = MeshGenerator(CFG, params, settings=settings, num_stages=2, tp=2,
-                      block_size=4)
+    g = MeshGenerator(CFG, params, settings=settings, block_size=4, **axes)
     g.set_prompt([5, 9, 2, 11])
     got = [g.next_token(i).id for i in range(9)]
     assert got == _local_stream(params, [5, 9, 2, 11], 9, settings)

@@ -85,6 +85,10 @@ def test_rope_linear_scaling():
     np.testing.assert_allclose(np.asarray(got), np.asarray(base) / 4.0)
     with pytest.raises(ValueError, match="unsupported"):
         _scale_inv_freq(base, {"rope_type": "yarn", "factor": 2.0})
+    # a malformed scaling dict with no type key must fail loudly, not be
+    # silently applied as linear interpolation
+    with pytest.raises(ValueError, match="no 'rope_type'"):
+        _scale_inv_freq(base, {"factor": 4.0})
 
 
 def test_config_carries_rope_scaling_to_generation():

@@ -161,18 +161,27 @@ def test_from_topology_rejects_device_gaps():
 # ---------------------------------------------------------------------------
 
 
-def _padded(ids, batch=1):
-    """Pad the prompt to the full cache window (sp prefill contract)."""
-    full = ids + [0] * (CFG.max_seq_len - len(ids))
+def _padded(ids, batch=1, t_pad=None):
+    """Pad the prompt to ``t_pad`` (default: full window). Chunked sp
+    prefill only needs a multiple of sp — prompt-proportional, T ≪ max_seq."""
+    t_pad = t_pad or CFG.max_seq_len
+    full = ids + [0] * (t_pad - len(ids))
     return jnp.tile(jnp.asarray([full], jnp.int32), (batch, 1))
 
 
 @pytest.mark.parametrize(
-    "stages,tp,dp,sp",
-    [(1, 1, 1, 2), (1, 1, 1, 4), (2, 1, 1, 2), (2, 2, 1, 2), (1, 2, 1, 4),
-     (1, 1, 2, 2)],
+    "stages,tp,dp,sp,t_pad",
+    [
+        # chunked prefill: T=16 ≪ max_seq=32, per-shard chunk < cache slice
+        (1, 1, 1, 2, 16), (1, 1, 1, 4, 16), (2, 1, 1, 2, 16),
+        (2, 2, 1, 2, 16), (1, 2, 1, 4, 16), (1, 1, 2, 2, 16),
+        # minimal bucket: T_l = 2 per shard
+        (1, 1, 1, 4, 8),
+        # full-window contract still works (t == s_l fast path)
+        (2, 2, 1, 2, None), (1, 1, 1, 4, None),
+    ],
 )
-def test_sp_prefill_matches_unsharded(params, stages, tp, dp, sp):
+def test_sp_prefill_matches_unsharded(params, stages, tp, dp, sp, t_pad):
     plan = MeshPlan.build(CFG, num_stages=stages, tp=tp, dp=dp, sp=sp)
     ids = [3, 1, 4, 1, 5, 9, 2, 6]
     ref, _ = _reference_logits(params, ids)
@@ -183,11 +192,35 @@ def test_sp_prefill_matches_unsharded(params, stages, tp, dp, sp):
         init_cache(CFG, batch=dp, max_seq=CFG.max_seq_len), plan.mesh
     )
     last = jnp.full((dp,), len(ids) - 1, jnp.int32)
-    logits, _ = prefill(sparams, _padded(ids, batch=dp), cache, last)
+    logits, _ = prefill(
+        sparams, _padded(ids, batch=dp, t_pad=t_pad), cache, last
+    )
     for b in range(dp):
         np.testing.assert_allclose(
             np.asarray(logits[b]), np.asarray(ref[0]), rtol=2e-4, atol=2e-4
         )
+
+
+@pytest.mark.parametrize("sp", [2, 4])
+def test_sp_prefill_one_token_per_shard_chunk(params, sp):
+    """T_pad == sp gives every shard a ONE-token prefill chunk; the explicit
+    sp_prefill flag must keep it on the ring/chunked-write path (the T>1
+    heuristic misrouted this to decode — silently wrong logits, r2
+    code-review finding)."""
+    plan = MeshPlan.build(CFG, sp=sp)
+    ids = [3, 1] if sp == 2 else [3, 1, 4]
+    ref, _ = _reference_logits(params, ids)
+    prefill = build_sharded_prefill(CFG, plan)
+    sparams = shard_params(params, plan.mesh)
+    cache = shard_cache(init_cache(CFG, batch=1, max_seq=CFG.max_seq_len),
+                        plan.mesh)
+    logits, _ = prefill(
+        sparams, _padded(ids, t_pad=sp), cache,
+        jnp.asarray([len(ids) - 1], jnp.int32),
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits[0]), np.asarray(ref[0]), rtol=2e-4, atol=2e-4
+    )
 
 
 @pytest.mark.parametrize("stages,tp,dp,sp", [(1, 1, 1, 4), (2, 1, 1, 2),
@@ -218,7 +251,11 @@ def test_sp_greedy_decode_matches_unsharded(params, stages, tp, dp, sp):
         init_cache(CFG, batch=dp, max_seq=CFG.max_seq_len), plan.mesh
     )
     last = jnp.full((dp,), len(ids) - 1, jnp.int32)
-    logits_s, cache_s = prefill(sparams, _padded(ids, batch=dp), cache_s, last)
+    # chunked prefill (T=8 ≪ max_seq) feeding decode: the chunked cache
+    # write must land KV exactly where sp decode attends for it
+    logits_s, cache_s = prefill(
+        sparams, _padded(ids, batch=dp, t_pad=8), cache_s, last
+    )
 
     decode = build_sharded_decode(CFG, settings, plan)
     history = jnp.full((dp, settings.repeat_last_n), -1, jnp.int32)
