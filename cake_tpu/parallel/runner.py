@@ -149,11 +149,13 @@ class RemoteRunner(BlockRunner):
         self.conn.send(self._MsgType.BATCH, self._protocol.encode_ops(x, ops))
         t, payload = self.conn.recv()
         if t == self._MsgType.ERROR:
-            raise RuntimeError(
+            raise self._protocol.WorkerOpError(
                 f"worker {self.addr}: {self._protocol.decode_error(payload)}"
             )
         if t != self._MsgType.TENSOR:
-            raise RuntimeError(f"unexpected reply type {t}")
+            # protocol desync is a transport-level fault: classify as a wire
+            # error so the master's reconnect+replay recovery applies
+            raise self._wire.WireError(f"unexpected reply type {t}")
         return self._protocol.decode_tensor(payload)
 
     def ident(self) -> str:

@@ -104,7 +104,10 @@ class DistributedGenerator(GeneratorBase):
         self._runner_calls = [0] * len(runners)
         self._runner_warmup = [0.0] * len(runners)
         self.recoveries = 0  # successful mid-stream reconnect+replay count
+        self._consec_recoveries = 0  # capped so a dead link can't loop forever
         self._timing_paused = False  # replay forwards are not decode samples
+
+    MAX_CONSEC_RECOVERIES = 3
 
     def _on_new_prompt(self) -> None:
         self._t_start = None
@@ -146,7 +149,7 @@ class DistributedGenerator(GeneratorBase):
             r.reset()
         ctx = self._prompt_tokens + self._generated
         n = len(ctx)
-        if n >= self.max_seq:
+        if n > self.max_seq:
             raise RuntimeError("cannot recover: context exceeds max_seq")
         t_pad = _bucket(n, self.max_seq)
         self._timing_paused = True
@@ -173,7 +176,17 @@ class DistributedGenerator(GeneratorBase):
             try:
                 logits = self._forward([self._last_token], self._pos, 0)
                 self._pos += 1
-            except (RuntimeError, OSError, wire.WireError) as e:
+                self._consec_recoveries = 0
+            # Transport failures only: a worker-reported op error
+            # (protocol.WorkerOpError) is deterministic — replaying the
+            # context would just re-run the same failing op at prefill cost.
+            except (OSError, wire.WireError) as e:
+                self._consec_recoveries += 1
+                if self._consec_recoveries > self.MAX_CONSEC_RECOVERIES:
+                    raise RuntimeError(
+                        f"giving up after {self.MAX_CONSEC_RECOVERIES} "
+                        f"consecutive recovery attempts"
+                    ) from e
                 log.warning("segment forward failed (%s); reconnecting and "
                             "replaying %d-token context", e,
                             len(self._prompt_tokens) + len(self._generated))

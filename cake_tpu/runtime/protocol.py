@@ -143,6 +143,13 @@ def decode_ops(buf: bytes) -> tuple[np.ndarray, list[tuple[str, int]]]:
     return x, ops
 
 
+class WorkerOpError(RuntimeError):
+    """A worker-reported op failure (MsgType.ERROR reply). Deterministic
+    model-side errors — distinct from transport failures (OSError /
+    wire.WireError), which warrant reconnect+replay recovery; these do not
+    (the same op would fail again after replay)."""
+
+
 def encode_error(msg: str) -> bytes:
     return msg.encode()
 

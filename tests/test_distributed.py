@@ -209,6 +209,63 @@ def test_mid_stream_worker_restart_recovers(params):
     w2.shutdown()
 
 
+def test_worker_op_error_not_retried(params):
+    """A worker-reported op error is deterministic: it must surface
+    immediately, NOT trigger reconnect + full-context replay (which would
+    re-run the same failing op at prefill cost every token)."""
+    from cake_tpu.runtime import protocol
+
+    settings = SamplerSettings(temperature=0.0)
+    g = DistributedGenerator(CFG, _head_params(params),
+                             build_runners(CFG, Topology.from_dict({}),
+                                           _loader(params)),
+                             settings=settings)
+    g.set_prompt([5, 9, 2])
+    g.next_token(0)
+
+    def boom(x, pos):
+        raise protocol.WorkerOpError("worker 127.0.0.1:1: bad op")
+
+    g.runners[0].forward = boom
+    with pytest.raises(protocol.WorkerOpError):
+        g.next_token(1)
+    assert g.recoveries == 0
+    g.close()
+
+
+def test_recovery_attempts_capped(params):
+    """A permanently failing transport gives up after MAX_CONSEC_RECOVERIES
+    instead of replaying the context forever."""
+    from cake_tpu.runtime import wire
+
+    settings = SamplerSettings(temperature=0.0)
+    g = DistributedGenerator(CFG, _head_params(params),
+                             build_runners(CFG, Topology.from_dict({}),
+                                           _loader(params)),
+                             settings=settings)
+    g.set_prompt([5, 9, 2])
+    g.next_token(0)
+
+    calls = {"n": 0}
+    real_forward = g.runners[0].forward
+
+    def flaky(x, pos):
+        calls["n"] += 1
+        # single-token decode forwards fail; replay prefills (T>1) succeed
+        if np.asarray(x).shape[1] == 1:
+            raise wire.WireError("connection reset")
+        return real_forward(x, pos)
+
+    g.runners[0].forward = flaky
+    # each failing decode step replays successfully and yields a token, but
+    # the consecutive-recovery counter never resets; the cap must trip
+    with pytest.raises(RuntimeError, match="consecutive recovery"):
+        for i in range(1, 10):
+            g.next_token(i)
+    assert g.recoveries == DistributedGenerator.MAX_CONSEC_RECOVERIES
+    g.close()
+
+
 def test_worker_down_for_good_still_fails(params):
     """If the worker never comes back, recovery raises (reference behavior:
     the run errors out, cake-cli/main.rs:51-55)."""
