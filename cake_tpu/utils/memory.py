@@ -23,6 +23,66 @@ def human_bytes(n: float) -> str:
     return f"{n:.1f} PiB"
 
 
+def hbm_budget(
+    config,
+    num_stages: int = 1,
+    tp: int = 1,
+    sp: int = 1,
+    max_seq: int | None = None,
+    batch: int = 1,
+    quant: str | None = None,
+    cache_bytes_per_el: int = 2,
+) -> dict:
+    """Per-chip HBM budget (bytes) for a (stage, tp, sp) mesh layout.
+
+    Mirrors the sharding actually used (parallel/mesh.py param_specs +
+    CACHE_SPEC): stacked layers shard over stage, linear in/out features over
+    tp, KV sequence over sp and kv-heads over tp; **embed is replicated** on
+    every chip and lm_head shards its vocab over tp. ``quant='int8'`` prices
+    the linears at 1 byte + f32 scales (ops/quant.py layout).
+
+    This is the planning arithmetic behind BASELINE.md configs 4/5 (70B on
+    v5e-16): it makes the "int8 is load-bearing, not optional" claim of
+    SURVEY.md §7 checkable.
+    """
+    c = config
+    el = 2 if c.dtype in ("bfloat16", "float16") else 4
+    lin_el, scale_el = (1, 4) if quant == "int8" else (el, 0)
+    S = max_seq or c.max_seq_len
+    d = c.head_dim
+
+    # per-layer linear params (full, unsharded)
+    qkv_out = (c.num_attention_heads + 2 * c.num_key_value_heads) * d
+    lin = c.hidden_size * qkv_out  # wq+wk+wv
+    lin += c.num_attention_heads * d * c.hidden_size  # wo
+    lin += 3 * c.hidden_size * c.intermediate_size  # gate/up/down
+    lin_out = qkv_out + c.hidden_size + 2 * c.intermediate_size + c.hidden_size
+    norms = 2 * c.hidden_size
+
+    layers_per_chip = c.num_hidden_layers / num_stages
+    layer_bytes = layers_per_chip * (
+        lin / tp * lin_el + lin_out / tp * scale_el + norms * el
+    )
+    embed_bytes = c.vocab_size * c.hidden_size * el  # replicated
+    head_bytes = (
+        c.hidden_size * c.vocab_size / tp * lin_el
+        + (c.vocab_size / tp) * scale_el
+        + c.hidden_size * el
+    )
+    kv_bytes = (
+        layers_per_chip * batch * (c.num_key_value_heads / tp)
+        * (S / sp) * d * 2 * cache_bytes_per_el
+    )
+    total = layer_bytes + embed_bytes + head_bytes + kv_bytes
+    return {
+        "layers": int(layer_bytes),
+        "embed_replicated": int(embed_bytes),
+        "head": int(head_bytes),
+        "kv_cache": int(kv_bytes),
+        "total": int(total),
+    }
+
+
 def memory_report() -> str:
     parts = [f"rss {human_bytes(rss_bytes())}"]
     try:
