@@ -13,7 +13,7 @@ lint:
 	@if $(PY) -c 'import pyflakes' 2>/dev/null; then \
 	  $(PY) -m pyflakes cake_tpu tests bench.py __graft_entry__.py; fi
 
-native: native/libcakewire.so native/libcakeembed.so
+native: native/libcakewire.so native/libcakeembed.so native/cake_host_demo
 
 native/libcakewire.so: native/cake_wire.cc
 	g++ -O2 -fPIC -shared -o $@ $<
@@ -27,11 +27,19 @@ native/libcakeembed.so: native/cake_embed.cc
 	g++ -O2 -fPIC -shared -o $@ $< \
 	  $$($(PYCFG) --includes) $$($(PYCFG) --ldflags --embed)
 
+# Runnable C host (the reference's worker-app equivalent): links the embed
+# library and serves topology-assigned layers via cake_start_worker.
+native/cake_host_demo: native/cake_host_demo.c native/libcakeembed.so
+	gcc -O2 -o $@ $< -Lnative -lcakeembed -Wl,-rpath,'$$ORIGIN'
+
 bench:
 	CAKE_BENCH_PRESET=tiny JAX_PLATFORMS=cpu $(PY) bench.py
 
+kernel-check:
+	$(PY) -m cake_tpu.tools.kernel_check --json-out KERNELS_TPU.json
+
 clean:
-	rm -f native/*.so
+	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
 .PHONY: test lint native bench clean

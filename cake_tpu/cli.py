@@ -32,6 +32,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--model", required=True,
                    help="checkpoint directory (config.json + safetensors)")
+    p.add_argument("--fetch", default=None, metavar="SRC",
+                   help="populate --model first from hf://org/name[@rev] or "
+                        "a local dir (idempotent; unlike the reference's "
+                        "forced hub re-download, cake/mod.rs:88-96)")
+    p.add_argument("--refetch", action="store_true",
+                   help="with --fetch: re-copy/re-download even if --model "
+                        "already holds a complete checkpoint")
     p.add_argument("--mode", choices=["master", "worker"], default="master")
     p.add_argument("--name", default=None, help="worker name in the topology")
     p.add_argument("--address", default="127.0.0.1:10128",
@@ -151,26 +158,62 @@ def run_master(args) -> int:
     settings = _settings(args)
 
     t0 = time.perf_counter()
-    use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1
-    if use_mesh and args.topology:
+    # One config plane drives both deployments (the reference's contract,
+    # topology.rs:41-84): a topology whose nodes carry mesh `device:` indices
+    # selects the single-program mesh pipeline (stage count and layer ranges
+    # from the YAML via MeshPlan.from_topology); host-addressed nodes select
+    # the cross-host master/worker runtime.
+    topology = None
+    topo_mesh = False
+    if args.topology:
+        from cake_tpu.parallel.topology import Topology
+
+        topology = Topology.from_path(args.topology)
+        with_dev = [n.name for n in topology if n.device is not None]
+        without = [n.name for n in topology if n.device is None]
+        if with_dev and without:
+            sys.exit(
+                f"error: topology mixes mesh nodes (device: {with_dev}) "
+                f"with host-addressed workers ({without}); a deployment is "
+                "one or the other"
+            )
+        topo_mesh = bool(with_dev)
+    use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
+    if topo_mesh and args.stages > 1:
         sys.exit(
-            "error: --stages/--tp/--sp (single-program mesh) and --topology "
-            "(cross-host workers) are mutually exclusive"
+            "error: --stages conflicts with a device-indexed topology "
+            "(the stage count comes from the topology's device entries)"
+        )
+    if use_mesh and topology is not None and not topo_mesh:
+        sys.exit(
+            "error: --stages/--tp/--sp (single-program mesh) and a "
+            "host-addressed --topology (cross-host workers) are mutually "
+            "exclusive; give topology nodes `device:` indices to drive the "
+            "mesh from YAML"
         )
     if use_mesh:
         from cake_tpu.runtime.mesh_generator import MeshGenerator
 
+        plan = None
+        if topo_mesh:
+            from cake_tpu.parallel.mesh import MeshPlan
+
+            try:
+                plan = MeshPlan.from_topology(config, topology, tp=args.tp,
+                                              sp=args.sp)
+            except ValueError as e:
+                sys.exit(f"error: {e}")
+            log.info("mesh plan from topology: %d stages x tp=%d x sp=%d",
+                     plan.num_stages, plan.tp, plan.sp)
         params = load_llama_params(args.model, config.num_hidden_layers,
                                    dtype=config.dtype, quantize=args.quantize)
-        gen = MeshGenerator(config, params, tokenizer=tokenizer,
+        gen = MeshGenerator(config, params, plan=plan, tokenizer=tokenizer,
                             settings=settings, max_seq=args.max_seq,
                             num_stages=args.stages, tp=args.tp, sp=args.sp,
                             block_size=args.decode_block)
     elif args.topology:
-        from cake_tpu.parallel.topology import Topology
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 
-        topology = Topology.from_path(args.topology)
         head = load_llama_params(
             args.model, config.num_hidden_layers, dtype=config.dtype,
             layer_range=(0, 0), quantize=args.quantize,
@@ -276,6 +319,13 @@ def main(argv=None) -> int:
                 f"(have {len(devices)} devices)"
             )
         jax.config.update("jax_default_device", devices[args.device])
+    if args.fetch:
+        from cake_tpu.utils.fetch import fetch_checkpoint
+
+        try:
+            fetch_checkpoint(args.fetch, args.model, force=args.refetch)
+        except Exception as e:
+            sys.exit(f"error: fetch from {args.fetch} failed: {e}")
     if args.mode == "worker":
         return run_worker(args)
     return run_master(args)

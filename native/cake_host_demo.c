@@ -1,0 +1,41 @@
+/* Minimal C host application for the cake-tpu embeddable worker.
+ *
+ * The runnable-host equivalent of the reference's SwiftUI worker app
+ * (cake-ios-worker-app/Cake Worker/ContentView.swift:28-56: pick a folder,
+ * call startWorker(name:modelPath:topologyPath:)). A real embedding target
+ * (iOS app, daemon, game engine) links libcakeembed.so and makes this one
+ * call; this demo is that host reduced to argv.
+ *
+ * Build:
+ *   gcc -O2 -o cake_host_demo cake_host_demo.c -L. -lcakeembed
+ * Run:
+ *   ./cake_host_demo <name> <model_dir> <topology.yml> [bind_address]
+ *
+ * Blocks serving ops (like the reference's block_on(Worker::run)) until
+ * killed; exits nonzero if the worker fails to start.
+ */
+
+#include <stdio.h>
+
+extern int cake_worker_api_version(void);
+extern int cake_start_worker(const char *name, const char *model_path,
+                             const char *topology_path, const char *address);
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: %s <name> <model_dir> <topology.yml> [bind_address]\n",
+            argv[0]);
+    return 2;
+  }
+  if (cake_worker_api_version() != 1) {
+    fprintf(stderr, "unsupported cake embed ABI\n");
+    return 3;
+  }
+  const char *address = argc > 4 ? argv[4] : "";
+  fprintf(stderr, "cake_host_demo: starting worker '%s' on %s\n", argv[1],
+          address[0] ? address : "0.0.0.0:10128");
+  int rc = cake_start_worker(argv[1], argv[2], argv[3], address);
+  fprintf(stderr, "cake_host_demo: worker exited rc=%d\n", rc);
+  return rc;
+}

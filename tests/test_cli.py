@@ -97,13 +97,72 @@ def test_device_ordinal_selection(model_dir):
     assert "out of range" in r.stderr
 
 
-def test_mesh_and_topology_flags_conflict(model_dir):
+def test_mesh_and_host_topology_flags_conflict(model_dir, tmp_path):
+    topo = tmp_path / "t.yml"
+    topo.write_text("w1:\n  host: 127.0.0.1:10128\n  layers:\n"
+                    "    - model.layers.0-1\n")
     r = _run_cli([
         "--model", str(model_dir), "--prompt-ids", "1", "-n", "1",
-        "--stages", "2", "--topology", "t.yml",
+        "--stages", "2", "--topology", str(topo),
     ])
     assert r.returncode != 0
     assert "mutually exclusive" in r.stderr
+
+
+def test_device_topology_drives_mesh_path(model_dir, tmp_path):
+    """A topology whose nodes carry `device:` indices selects the
+    single-program mesh pipeline from YAML (the reference's one-config-plane
+    contract, topology.rs:41-84) — no --stages flag needed."""
+    topo = tmp_path / "mesh.yml"
+    topo.write_text(
+        "s0:\n  device: 0\n  layers:\n    - model.layers.0-1\n"
+        "s1:\n  device: 1\n  layers:\n    - model.layers.2-3\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
+         "--prompt-ids", "3,5,7", "-n", "4", "--temperature", "0",
+         "--max-seq", "32", "--cpu", "--topology", str(topo)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "mesh plan from topology: 2 stages" in r.stderr
+    assert "tok/s" in r.stderr
+
+
+def test_mixed_host_device_topology_rejected(model_dir, tmp_path):
+    """Half-migrated YAML (some nodes device-indexed, some host-addressed)
+    must fail loudly, not silently drop the host workers."""
+    topo = tmp_path / "mixed.yml"
+    topo.write_text(
+        "s0:\n  device: 0\n  layers:\n    - model.layers.0-1\n"
+        "w1:\n  host: 127.0.0.1:10128\n  layers:\n    - model.layers.2-3\n"
+    )
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "1", "-n", "1",
+        "--topology", str(topo),
+    ])
+    assert r.returncode != 0
+    assert "mixes mesh nodes" in r.stderr
+
+
+def test_device_topology_conflicts_with_stages(model_dir, tmp_path):
+    topo = tmp_path / "mesh.yml"
+    topo.write_text(
+        "s0:\n  device: 0\n  layers:\n    - model.layers.0-1\n"
+        "s1:\n  device: 1\n  layers:\n    - model.layers.2-3\n"
+    )
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "1", "-n", "1",
+        "--stages", "2", "--topology", str(topo),
+    ])
+    assert r.returncode != 0
+    assert "--stages conflicts" in r.stderr
 
 
 def test_profile_flag_writes_trace(model_dir, tmp_path):

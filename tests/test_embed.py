@@ -7,6 +7,7 @@ assigned layers, handshake, serve one op) and the C shim build contract
 
 import ctypes
 import json
+import os
 import shutil
 import subprocess
 import sys
@@ -75,9 +76,10 @@ def test_spawn_worker_unknown_name_raises(bundle):
                            address="127.0.0.1:0")
 
 
-@pytest.mark.skipif(shutil.which("g++") is None, reason="no C++ toolchain")
-def test_c_shim_exports(tmp_path):
-    """The C embedding library builds and exports the stable C ABI."""
+def _build_embed_lib(tmp_path):
+    """Build libcakeembed.so; returns its path (or skips the test)."""
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain")
     pycfg = next(
         (c for c in (sys.executable + "-config", "python3-config")
          if shutil.which(c)), None,
@@ -95,6 +97,78 @@ def test_c_shim_exports(tmp_path):
     )
     r = subprocess.run(cmd, capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
+    return so
+
+
+def test_c_shim_exports(tmp_path):
+    """The C embedding library builds and exports the stable C ABI."""
+    so = _build_embed_lib(tmp_path)
     lib = ctypes.CDLL(str(so))
     assert lib.cake_worker_api_version() == 1
     assert hasattr(lib, "cake_start_worker")
+
+
+def test_c_host_serves_op_end_to_end(bundle, tmp_path):
+    """A real C host (native/cake_host_demo.c — the reference's runnable
+    worker app, ContentView.swift:28-56) links the embed library, calls
+    cake_start_worker through the C ABI, and serves a layer op to a Python
+    client over the wire."""
+    import socket
+    import time
+
+    from cake_tpu.runtime import protocol, wire
+    from cake_tpu.runtime.protocol import MsgType, WorkerInfo
+
+    so = _build_embed_lib(tmp_path)
+    gcc = shutil.which("gcc") or shutil.which("g++")
+    host_bin = tmp_path / "cake_host_demo"
+    r = subprocess.run(
+        [gcc, "-O2", "-o", str(host_bin),
+         str(REPO / "native" / "cake_host_demo.c"),
+         f"-L{tmp_path}", "-lcakeembed", f"-Wl,-rpath,{tmp_path}"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+    # pick a free port for the host to bind
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    model_dir, topo = bundle
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)  # embedded CPython must find cake_tpu
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [str(host_bin), "phone", str(model_dir), str(topo),
+         f"127.0.0.1:{port}"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        conn = None
+        for _ in range(120):  # embedded interpreter + jax import takes a bit
+            if proc.poll() is not None:
+                out, err = proc.communicate()
+                pytest.fail(f"host exited early rc={proc.returncode}: "
+                            f"{err.decode()[-2000:]}")
+            try:
+                conn = wire.connect("127.0.0.1", port, timeout_ms=1000)
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert conn is not None, "host never started listening"
+        conn.send(MsgType.HELLO)
+        t, payload = conn.recv()
+        assert t == MsgType.WORKER_INFO
+        assert WorkerInfo.from_bytes(payload).name == "phone"
+        x = np.zeros((1, 1, CFG.hidden_size), np.float32)
+        conn.send(MsgType.BATCH,
+                  protocol.encode_ops(x, [("model.layers.0", 0)]))
+        t, payload = conn.recv()
+        assert t == MsgType.TENSOR
+        assert protocol.decode_tensor(payload).shape == x.shape
+        conn.close()
+    finally:
+        proc.terminate()
+        proc.wait(timeout=30)

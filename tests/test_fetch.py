@@ -1,0 +1,89 @@
+"""Checkpoint fetch plane (CLI --fetch / utils.fetch).
+
+Replaces the reference's hub convenience WITHOUT its quirk: the reference
+re-downloads `meta-llama/Meta-Llama-3-8B` on every master start even when
+--model points at local files (cake/mod.rs:80-96, local loading commented
+out). Here fetch is explicit and idempotent; hub access is exercised via a
+stub (zero-egress environment)."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from cake_tpu.utils.fetch import DEFAULT_PATTERNS, fetch_checkpoint
+
+
+@pytest.fixture()
+def src_dir(tmp_path):
+    d = tmp_path / "src"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({"hidden_size": 64}))
+    (d / "tokenizer.json").write_text("{}")
+    (d / "model.safetensors").write_bytes(b"\x00" * 16)
+    (d / "model.safetensors.index.json").write_text("{}")
+    (d / "README.md").write_text("not an inference file")
+    return d
+
+
+def test_local_fetch_copies_inference_set(src_dir, tmp_path):
+    dest = fetch_checkpoint(f"file://{src_dir}", tmp_path / "model")
+    names = sorted(p.name for p in dest.iterdir())
+    assert names == ["config.json", "model.safetensors",
+                     "model.safetensors.index.json", "tokenizer.json"]
+    # README filtered out: only the inference file set travels
+    assert not (dest / "README.md").exists()
+
+
+def test_fetch_is_idempotent_not_forced(src_dir, tmp_path):
+    """Unlike the reference's always-re-download, present files are kept."""
+    dest = tmp_path / "model"
+    fetch_checkpoint(str(src_dir), dest)
+    marker = dest / "config.json"
+    marker.write_text("locally edited")
+    fetch_checkpoint(str(src_dir), dest)
+    assert marker.read_text() == "locally edited"
+    fetch_checkpoint(str(src_dir), dest, force=True)
+    assert marker.read_text() != "locally edited"
+
+
+def test_missing_source_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        fetch_checkpoint(str(tmp_path / "nope"), tmp_path / "model")
+
+
+def test_hub_fetch_wiring(tmp_path, monkeypatch):
+    """hf:// parses repo@revision and calls snapshot_download with the
+    inference allow-list (stubbed: zero-egress environment)."""
+    calls = {}
+
+    def fake_snapshot_download(repo_id, revision, local_dir, allow_patterns):
+        calls.update(repo_id=repo_id, revision=revision, local_dir=local_dir,
+                     allow_patterns=allow_patterns)
+        Path(local_dir, "config.json").write_text("{}")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download",
+                        fake_snapshot_download)
+    dest = fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@main",
+                            tmp_path / "model")
+    assert calls["repo_id"] == "meta-llama/Meta-Llama-3-8B"
+    assert calls["revision"] == "main"
+    assert set(DEFAULT_PATTERNS) <= set(calls["allow_patterns"])
+    assert (dest / "config.json").exists()
+
+
+def test_hub_fetch_skips_when_populated(tmp_path, monkeypatch):
+    dest = tmp_path / "model"
+    dest.mkdir()
+    (dest / "config.json").write_text("{}")
+    (dest / "model.safetensors").write_bytes(b"\x00")
+
+    def boom(**kw):  # pragma: no cover - must not be reached
+        raise AssertionError("hub hit despite populated dir")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
