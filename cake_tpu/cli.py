@@ -47,6 +47,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt", default="Why is the sky blue?")
     p.add_argument("--prompt-ids", default=None, dest="prompt_ids",
                    help="comma-separated token ids (bypasses the tokenizer)")
+    p.add_argument("--prompts-file", default=None, dest="prompts_file",
+                   help="serve N prompts concurrently (one per line; or "
+                        "comma-separated id lists with --prompt-ids-file "
+                        "semantics when every line is numeric) over the "
+                        "batched mesh pipeline")
+    p.add_argument("--dp", type=int, default=1,
+                   help="data-parallel width for --prompts-file serving")
     p.add_argument("--seed", type=int, default=299792458)
     p.add_argument("-n", "--sample-len", type=int, default=100, dest="sample_len")
     p.add_argument("--temperature", type=float, default=1.0)
@@ -146,6 +153,63 @@ def run_worker(args) -> int:
         worker.serve_forever()
     except KeyboardInterrupt:
         worker.shutdown()
+    return 0
+
+
+def run_serve(args) -> int:
+    """Concurrent multi-prompt serving over the batched mesh pipeline
+    (--prompts-file): capability the single-request reference does not have
+    (SURVEY.md §0)."""
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.utils.memory import memory_report
+    from cake_tpu.utils.weights import load_llama_params
+
+    if args.topology:
+        sys.exit("error: --prompts-file serving runs the mesh pipeline; "
+                 "--topology (cross-host workers) is not supported here")
+    config = _load_config(args)
+    tokenizer = _load_tokenizer(args.model)
+    settings = _settings(args)
+
+    prompts: list = []
+    with open(args.prompts_file) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            toks = [t.strip() for t in line.split(",")]
+            if all(t.isdigit() for t in toks):
+                prompts.append([int(t) for t in toks])
+            elif tokenizer is None:
+                sys.exit("error: text prompts require a tokenizer.json; "
+                         "use comma-separated token ids per line")
+            else:
+                prompts.append(line)
+    if not prompts:
+        sys.exit(f"error: no prompts in {args.prompts_file}")
+
+    t0 = time.perf_counter()
+    params = load_llama_params(args.model, config.num_hidden_layers,
+                               dtype=config.dtype, quantize=args.quantize)
+    gen = BatchGenerator(config, params, tokenizer=tokenizer,
+                         settings=settings, max_seq=args.max_seq,
+                         num_stages=args.stages, tp=args.tp, dp=args.dp,
+                         block_size=args.decode_block)
+    gen.set_prompts(prompts)
+    log.info("model loaded in %.1fs (%s); serving %d streams",
+             time.perf_counter() - t0, memory_report(), len(prompts))
+    t_gen0 = time.perf_counter()
+    outs = gen.generate(args.sample_len)
+    dt = time.perf_counter() - t_gen0
+    total = sum(len(o) for o in outs)
+    texts = gen.texts()
+    for i, o in enumerate(outs):
+        if texts[i] is not None:
+            print(f"[{i}] {texts[i]}")
+        else:
+            print(f"[{i}] {','.join(map(str, o))}")
+    log.info("%d streams, %d tokens, %.2f tok/s aggregate — %s",
+             len(outs), total, total / dt, memory_report())
     return 0
 
 
@@ -328,6 +392,8 @@ def main(argv=None) -> int:
             sys.exit(f"error: fetch from {args.fetch} failed: {e}")
     if args.mode == "worker":
         return run_worker(args)
+    if args.prompts_file:
+        return run_serve(args)
     return run_master(args)
 
 

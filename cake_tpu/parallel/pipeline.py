@@ -158,12 +158,14 @@ def build_sharded_decode(
 
     ``per_row=True`` is the multi-stream serving mode: ``pos`` becomes
     ``[B]`` (each stream decodes at its own position — right-padded prompts
-    of different lengths run concurrently) and ``key`` becomes per-stream
-    keys ``[B, 2] uint32``; the program folds the absolute token index into
-    each stream's key (``fold_in(row_key, index0 + i)``), so a stream's
+    of different lengths run concurrently), ``key`` becomes per-stream
+    keys ``[B, 2] uint32``, and ``index0`` becomes ``[B]`` (each stream's
+    absolute token index — a stream admitted into a running batch starts
+    its own schedule at 1); the program folds each stream's token index
+    into its key (``fold_in(row_key, index0[b] + i)``), so a stream's
     output depends only on (its key, its prompt) — invariant to batch
-    composition and mesh layout. The signature always ends with ``index0``
-    in this mode. Requires ``plan.sp == 1``.
+    composition, mesh layout, and admission time. The signature always
+    ends with ``index0`` in this mode. Requires ``plan.sp == 1``.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     if per_row and plan.sp != 1:
@@ -194,8 +196,8 @@ def build_sharded_decode(
         return tok, KVCache(k=ck, v=cv), history, hist_slot
 
     def fold_key(key, index):
-        if per_row:
-            return jax.vmap(lambda k: jax.random.fold_in(k, index))(key)
+        if per_row:  # key [B, 2], index [B] (per-stream schedules)
+            return jax.vmap(jax.random.fold_in)(key, index)
         return jax.random.fold_in(key, index)
 
     in_specs = [
@@ -227,7 +229,7 @@ def build_sharded_decode(
                 return toks[0], cache, history, hist_slot
             return toks, cache, history, hist_slot
 
-        in_specs.append(P())  # index0
+        in_specs.append(P(DP) if per_row else P())  # index0
 
     sharded = jax.shard_map(
         step,

@@ -23,6 +23,15 @@ Per-stream independence is real, not cosmetic:
 - **EOS / detok**: tracked per stream; a finished stream stops emitting while
   the batch keeps running (its rows keep computing into discarded outputs —
   the SPMD analogue of the pipeline's gated inactive stages).
+
+Caveat (int8 weights only): ``ops.quant.quant_matmul`` auto-selects its
+backend by row count (XLA gemv below ~16 rows, the Pallas kernel above —
+the measured perf crossover), so with quantized weights and temperature > 0
+a stream's low-order logit bits can differ between batch-size *buckets*
+(e.g. batch 8 vs 16), which near a top-k/top-p boundary may flip a sampled
+token. Within a fixed batch size the invariants hold exactly; set
+``CAKE_PALLAS=0`` to pin one backend and recover strict cross-bucket
+reproducibility. bf16 weights are unaffected.
 """
 
 from __future__ import annotations
@@ -109,6 +118,36 @@ class BatchGenerator:
         self._eos_ids = set(config.eos_ids())
 
     # -- prompt intake -------------------------------------------------------
+    def _encode(self, p) -> list[int]:
+        """Tokenize/validate one prompt (the single-stream set_prompt rules:
+        BOS prepend, non-empty, fits the window, ids in vocab range)."""
+        if isinstance(p, str):
+            if self.tokenizer is None:
+                raise ValueError("string prompt requires a tokenizer")
+            enc = self.tokenizer.encode(p)
+            ids = list(getattr(enc, "ids", enc))
+            if self.config.bos_token_id is not None and (
+                not ids or ids[0] != self.config.bos_token_id
+            ):
+                ids = [self.config.bos_token_id] + ids
+        else:
+            ids = list(p)
+        if not ids:
+            raise ValueError("empty prompt")
+        if len(ids) >= self.max_seq:
+            raise ValueError(
+                f"prompt length {len(ids)} >= max_seq {self.max_seq}"
+            )
+        bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
+        if bad:
+            # out-of-range ids would clamp in the embed gather and silently
+            # corrupt just this stream — fail like single-stream set_prompt
+            raise ValueError(
+                f"prompt token ids out of range "
+                f"[0, {self.config.vocab_size}): {bad[:5]}"
+            )
+        return ids
+
     def set_prompts(
         self,
         prompts: list[list[int] | str],
@@ -119,35 +158,7 @@ class BatchGenerator:
         stream reproducible in any batch composition."""
         if not prompts:
             raise ValueError("empty batch")
-        ids_list = []
-        for p in prompts:
-            if isinstance(p, str):
-                if self.tokenizer is None:
-                    raise ValueError("string prompt requires a tokenizer")
-                enc = self.tokenizer.encode(p)
-                ids = list(getattr(enc, "ids", enc))
-                if self.config.bos_token_id is not None and (
-                    not ids or ids[0] != self.config.bos_token_id
-                ):
-                    ids = [self.config.bos_token_id] + ids
-            else:
-                ids = list(p)
-            if not ids:
-                raise ValueError("empty prompt")
-            if len(ids) >= self.max_seq:
-                raise ValueError(
-                    f"prompt length {len(ids)} >= max_seq {self.max_seq}"
-                )
-            bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
-            if bad:
-                # out-of-range ids would clamp in the embed gather and
-                # silently corrupt just this stream — fail like the
-                # single-stream set_prompt does
-                raise ValueError(
-                    f"prompt token ids out of range "
-                    f"[0, {self.config.vocab_size}): {bad[:5]}"
-                )
-            ids_list.append(ids)
+        ids_list = [self._encode(p) for p in prompts]
         if stream_ids is None:
             stream_ids = list(range(len(ids_list)))
         if len(stream_ids) != len(ids_list):
@@ -216,17 +227,105 @@ class BatchGenerator:
             self._history, self._hist_slot, toks
         )
         self._last_tokens = toks.astype(jnp.int32)
-        self._index = 1  # absolute token index of the NEXT emitted token
+        # per-stream absolute token index of the NEXT token (per-row so a
+        # stream admitted later starts its own schedule at 1)
+        self._index = np.ones((b,), np.int32)
         self._emitted_first = False
         self._block_buf: list[np.ndarray] = []
+        # emission rows already recorded (admit() flushing the block buffer)
+        # but not yet handed to a step() caller
+        self._pending_rows: list[list[Token | None]] = []
+
+    def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
+        """Admit a new prompt into a finished slot of a RUNNING batch
+        (continuous-batching-lite: fixed batch geometry, slot reuse).
+
+        Prefills the new prompt alone (bucketed, prompt-proportional) and
+        splices its KV row, key, history, position, and token index into the
+        slot; the other streams are untouched mid-flight. Per-row token
+        indices in the compiled program mean the admitted stream's sampling
+        schedule starts at 0 regardless of when it joined — its output is
+        identical to the same (seed, stream_id, prompt) in any other batch.
+
+        Returns ``(slot, first Token)`` — the first token is sampled here
+        from the prefill logits and recorded; subsequent ``step()`` calls
+        carry the stream forward. Raises if no stream is done.
+        """
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        # Buffered block rows belong to the pre-admission state: record them
+        # before the slot's column changes meaning, and queue the emitted
+        # rows so streaming step() consumers still receive every Token.
+        while self._block_buf:
+            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
+        slot = next(
+            (i for i, s in enumerate(self.streams) if not s.active or s.done),
+            None,
+        )
+        if slot is None:
+            raise RuntimeError("no free slot: every stream is still live")
+        ids = self._encode(prompt)
+
+        # prefill the new prompt alone (dp rows of it when dp > 1 — the
+        # prefill program's batch axis shards over dp; extras are discarded)
+        dp = self.plan.dp
+        t_pad = _bucket(len(ids), self.max_seq)
+        tokens = np.zeros((dp, t_pad), np.int32)
+        tokens[:, : len(ids)] = ids
+        row_cache = shard_cache(
+            init_cache(self.config, batch=dp, max_seq=self.max_seq),
+            self.plan.mesh,
+        )
+        logits, row_cache = self._prefill(
+            self.params, jnp.asarray(tokens), row_cache,
+            jnp.full((dp,), len(ids) - 1, jnp.int32),
+        )
+        self.cache = type(self.cache)(
+            k=self.cache.k.at[:, slot].set(row_cache.k[:, 0]),
+            v=self.cache.v.at[:, slot].set(row_cache.v[:, 0]),
+        )
+
+        key = jax.random.fold_in(self._base_key, stream_id)
+        n_hist = self.settings.repeat_last_n
+        hist_row = np.full((n_hist,), -1, np.int32)
+        tail = ids[-n_hist:]
+        hist_row[: len(tail)] = tail
+        tok = sampling.sample_token(
+            logits[0], jax.random.fold_in(key, 0), jnp.asarray(hist_row),
+            self.settings,
+        )
+        tok_id = int(tok)
+        hist_row[len(tail) % n_hist] = tok_id
+
+        self._keys = self._keys.at[slot].set(key)
+        self._history = self._history.at[slot].set(jnp.asarray(hist_row))
+        self._hist_slot = self._hist_slot.at[slot].set(len(tail) + 1)
+        self._last_tokens = self._last_tokens.at[slot].set(tok_id)
+        self._pos = np.asarray(self._pos).copy()
+        self._pos[slot] = len(ids)
+        self._index = np.asarray(self._index).copy()
+        self._index[slot] = 1
+
+        s = _Stream(
+            stream_id=stream_id, prompt=ids,
+            detok=TokenOutputStream(self.tokenizer) if self.tokenizer else None,
+        )
+        self.streams[slot] = s
+        s.generated.append(tok_id)
+        window_full = len(ids) + 1 >= self.max_seq
+        s.done = (tok_id in self._eos_ids) or window_full
+        text = s.detok.next_token(tok_id) if s.detok else None
+        return slot, Token(id=tok_id, text=text, is_end_of_stream=s.done)
 
     # -- stepping ------------------------------------------------------------
-    def _emit(self, row: np.ndarray) -> list[Token | None]:
+    def _emit(self, row: np.ndarray,
+              skip: list[bool] | None = None) -> list[Token | None]:
         """Turn one [B] token row into per-stream Tokens (None when done or
-        dummy), updating per-stream bookkeeping."""
+        dummy), updating per-stream bookkeeping. ``skip[i]`` excludes a
+        stream from this row without marking it done."""
         out: list[Token | None] = []
         for i, s in enumerate(self.streams):
-            if not s.active or s.done:
+            if not s.active or s.done or (skip is not None and skip[i]):
                 out.append(None)
                 continue
             tok_id = int(row[i])
@@ -244,7 +343,15 @@ class BatchGenerator:
             raise RuntimeError("set_prompts first")
         if not self._emitted_first:
             self._emitted_first = True
-            return self._emit(np.asarray(self._last_tokens))
+            # skip streams that already recorded tokens — a stream admit()ed
+            # into a dummy slot before the first step() had its first token
+            # returned by admit(), and must not be double-recorded here
+            return self._emit(
+                np.asarray(self._last_tokens),
+                skip=[bool(s.generated) for s in self.streams],
+            )
+        if self._pending_rows:
+            return self._pending_rows.pop(0)
         if self._block_buf:
             return self._emit(self._block_buf.pop(0))
 
@@ -268,12 +375,12 @@ class BatchGenerator:
                 self._decode_block(
                     self.params, self._last_tokens, self.cache,
                     jnp.asarray(self._pos), self._keys, self._history,
-                    self._hist_slot, jnp.int32(self._index),
+                    self._hist_slot, jnp.asarray(self._index),
                 )
             )
             rows = np.asarray(toks)  # [steps, B]
             self._pos = self._pos + self.block_size
-            self._index += self.block_size
+            self._index = self._index + self.block_size
             self._last_tokens = toks[-1].astype(jnp.int32)
             self._block_buf = [rows[i] for i in range(rows.shape[0])]
             return self._emit(self._block_buf.pop(0))
@@ -283,10 +390,10 @@ class BatchGenerator:
         tok, self.cache, self._history, self._hist_slot = self._decode_single(
             self.params, self._last_tokens, self.cache,
             jnp.asarray(self._pos), self._keys, self._history,
-            self._hist_slot, jnp.int32(self._index),
+            self._hist_slot, jnp.asarray(self._index),
         )
         self._pos = self._pos + 1
-        self._index += 1
+        self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)
         return self._emit(np.asarray(tok))
 

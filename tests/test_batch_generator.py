@@ -128,6 +128,91 @@ def test_short_stream_survives_long_stream_window_exhaustion(params):
         assert solo.generate(20)[0] == outs[1]
 
 
+@pytest.mark.parametrize("block_size", [1, 4])
+def test_admit_refills_finished_slot(params, block_size):
+    """Continuous-batching-lite: when a stream finishes, admit() splices a
+    new prompt into its slot mid-run. The admitted stream reproduces its
+    solo run exactly (per-row positions AND per-row token indices), and the
+    untouched neighbor stream is bit-identical to its own solo run."""
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=11)
+    cfg = tiny(max_seq_len=32)
+    long_prompt = list(range(2, 28))  # 26 tokens -> done after 6
+    g = BG(cfg, params, settings=settings, dp=1, block_size=block_size)
+    g.set_prompts([long_prompt, PROMPTS[1]], stream_ids=[0, 1])
+    for _ in range(6):
+        g.step()
+    assert g.streams[0].done and not g.streams[1].done
+
+    slot, first = g.admit(PROMPTS[2], stream_id=7)
+    assert slot == 0
+    collected = [first.id]
+    for _ in range(7):
+        row = g.step()
+        if row[0] is not None:
+            collected.append(row[0].id)
+
+    solo = BG(cfg, params, settings=settings, dp=1, block_size=block_size)
+    solo.set_prompts([PROMPTS[2]], stream_ids=[7])
+    assert collected == solo.generate(24)[0][: len(collected)]
+
+    s1 = g.streams[1].generated
+    solo1 = BG(cfg, params, settings=settings, dp=1, block_size=block_size)
+    solo1.set_prompts([PROMPTS[1]], stream_ids=[1])
+    assert s1 == solo1.generate(24)[0][: len(s1)]
+
+
+def test_admit_into_dummy_slot_before_first_step(params):
+    """admit() may claim a dp-padding dummy slot before the first step();
+    the admitted stream's first token is returned by admit() once, not
+    re-emitted by the first step() (code-review r2 regression)."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=2)
+    g.set_prompts(PROMPTS)  # 3 prompts -> 4 rows, slot 3 is a dummy
+    slot, first = g.admit(PROMPTS[0], stream_id=9)
+    assert slot == 3
+    rows = [g.step() for _ in range(8)]
+    got = [first.id] + [r[slot].id for r in rows if r[slot] is not None]
+    want = _single_stream(params, PROMPTS[0], len(got), settings)
+    assert got == want
+    # exactly one copy of the first token
+    assert g.streams[slot].generated == got
+
+
+def test_admit_flush_preserves_streamed_tokens(params):
+    """Tokens buffered by block decode at admission time still reach the
+    streaming step() consumer (queued rows), not just the generated lists."""
+    settings = SamplerSettings(**GREEDY)
+    cfg = tiny(max_seq_len=32)
+    long_prompt = list(range(2, 28))
+    g = BG(cfg, params, settings=settings, dp=1, block_size=4)
+    g.set_prompts([long_prompt, PROMPTS[1]], stream_ids=[0, 1])
+    received = {0: [], 1: [], 7: []}
+
+    def collect(row, admitted_slot=None):
+        for i, t in enumerate(row):
+            if t is not None:
+                sid = g.streams[i].stream_id
+                received[sid].append(t.id)
+
+    for _ in range(6):
+        collect(g.step())
+    slot, first = g.admit(PROMPTS[2], stream_id=7)
+    received[7].append(first.id)
+    for _ in range(8):
+        collect(g.step())
+    # every recorded token reached the streaming consumer, in order
+    for s in g.streams:
+        assert received[s.stream_id] == s.generated
+
+
+def test_admit_requires_free_slot(params):
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1)
+    g.set_prompts(PROMPTS)
+    with pytest.raises(RuntimeError, match="no free slot"):
+        g.admit([1, 2, 3], stream_id=9)
+
+
 def test_batch_padding_to_dp_multiple(params):
     """3 prompts on dp=2 pad to 4 rows with an inactive dummy; outputs still
     match, dummy never surfaces."""

@@ -165,6 +165,29 @@ def test_device_topology_conflicts_with_stages(model_dir, tmp_path):
     assert "--stages conflicts" in r.stderr
 
 
+def test_prompts_file_serves_batch(model_dir, tmp_path):
+    """--prompts-file decodes N prompts concurrently over the batched mesh
+    pipeline and prints one output line per stream."""
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("3,5,7\n2,4\n9,1,6,2\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    r = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
+         "--prompts-file", str(pf), "-n", "4", "--temperature", "0",
+         "--max-seq", "32", "--cpu", "--dp", "2", "--stages", "2", "-v"],
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("[")]
+    assert len(lines) == 3 and lines[0].startswith("[0] ")
+    assert "3 streams" in r.stderr and "aggregate" in r.stderr
+
+
 def test_profile_flag_writes_trace(model_dir, tmp_path):
     trace_dir = tmp_path / "trace"
     r = _run_cli([
