@@ -131,6 +131,159 @@ def _reexec(cpu: bool = False, **env_overrides) -> None:
     os.execve(sys.executable, [sys.executable, __file__], env)
 
 
+def _run_prefill(config, params, preset, quant, dev) -> int:
+    """Prefill (TTFT-side) throughput: tokens/s of one warm prompt pass at
+    T = CAKE_BENCH_SEQ/2 against a CAKE_BENCH_SEQ KV window. This is where
+    the Pallas flash kernel carries the long-context story (132x over
+    XLA-materialized scores at T=2048/S=8192 on v5e — KERNELS_TPU.json);
+    the reference hard-caps context at 4096 and materializes full score
+    matrices (attention.rs:59-80)."""
+    from cake_tpu.ops.kvcache import init_cache
+    from cake_tpu.runtime.generator import prefill_fn
+
+    t = config.max_seq_len // 2
+    prefill = jax.jit(partial(prefill_fn, config=config),
+                      donate_argnames=("cache",))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, config.vocab_size, (1, t)),
+        jnp.int32,
+    )
+    last = jnp.asarray([t - 1], jnp.int32)
+
+    cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+    t0 = time.perf_counter()
+    logits, cache = prefill(params, tokens, cache, last)
+    _sync(logits)
+    ttft_cold = time.perf_counter() - t0  # includes compile
+
+    iters = 8
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+        logits, cache = prefill(params, tokens, cache, last)
+    _sync(logits)
+    dt = (time.perf_counter() - t0) / iters
+
+    wtag = "int8" if quant == "int8" else "bf16"
+    # vs_baseline: fraction of the chip's bf16 peak the prompt pass sustains
+    # (2 * params * T flops, attention excluded — conservative)
+    flops = 2.0 * sum(
+        x.size for x in jax.tree.leaves(params)
+    ) * t
+    peak = 197e12 if "v5" in dev.device_kind.lower() else 50e12
+    print(json.dumps({
+        "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",
+        "value": round(t / dt, 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(flops / dt / peak, 4),
+    }))
+    sys.stderr.write(
+        f"device={dev.device_kind} T={t} window={config.max_seq_len} "
+        f"warm_prefill={dt * 1e3:.1f}ms ttft_cold={ttft_cold:.2f}s "
+        f"mfu~{flops / dt / peak:.2f}\n"
+    )
+    return 0
+
+
+def _run_batched(config, params, preset, quant, settings, dev,
+                 batch, steps, multistep) -> int:
+    """Multi-stream aggregate decode throughput (CAKE_BENCH_BATCH=N).
+
+    Drives the serving stack itself — the per-row mesh decode program
+    (parallel/pipeline per_row mode on a 1-device mesh), N streams at their
+    own positions with per-stream keys. Weight reads amortize over the
+    batch, so aggregate tok/s can exceed the single-stream weights-bound
+    roofline (``vs_baseline > 1``) — the axis the single-request reference
+    has no answer to (SURVEY.md §0: no batching of concurrent requests).
+    """
+    from cake_tpu.ops.kvcache import init_cache
+    from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+    from cake_tpu.parallel.pipeline import (
+        build_sharded_decode,
+        build_sharded_prefill,
+    )
+
+    plan = MeshPlan.build(config, devices=jax.devices()[:1])
+    params = shard_params(params, plan.mesh)
+    cache = shard_cache(
+        init_cache(config, batch=batch, max_seq=config.max_seq_len),
+        plan.mesh,
+    )
+    prefill = build_sharded_prefill(config, plan, params_like=params)
+    decode = build_sharded_decode(config, settings, plan, params_like=params,
+                                  steps=multistep, per_row=True)
+
+    prompt_len = 8
+    tokens = jnp.tile(
+        jnp.asarray([[1, 5, 9, 14, 3, 8, 2, 4]], jnp.int32), (batch, 1)
+    )
+    t_pf0 = time.perf_counter()
+    logits, cache = prefill(
+        params, tokens, cache,
+        jnp.full((batch,), prompt_len - 1, jnp.int32),
+    )
+    _sync(logits)
+    ttft_s = time.perf_counter() - t_pf0
+
+    base = jax.random.PRNGKey(settings.seed)
+    keys = jnp.stack([jax.random.fold_in(base, i) for i in range(batch)])
+    tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    pos = jnp.full((batch,), prompt_len, jnp.int32)
+    history = jnp.full((batch, settings.repeat_last_n), -1, jnp.int32)
+    hist_slot = jnp.zeros((batch,), jnp.int32)
+
+    per = max(1, multistep)
+    max_dispatches = (config.max_seq_len - prompt_len) // per - 3
+    if max_dispatches < 1:
+        sys.exit(
+            f"error: CAKE_BENCH_SEQ={config.max_seq_len} too small for "
+            f"CAKE_BENCH_MULTISTEP={multistep}"
+        )
+    dispatches = max(1, min(steps // per, max_dispatches))
+
+    index = jnp.ones((batch,), jnp.int32)  # per-stream token indices
+
+    def step_once(tok, cache, history, hist_slot, pos, index):
+        toks, cache, history, hist_slot = decode(
+            params, tok, cache, pos, keys, history, hist_slot, index,
+        )
+        # per_row decode returns [B] for steps==1, [steps, B] otherwise
+        last = toks if per == 1 else toks[-1]
+        return (last.astype(jnp.int32), cache, history, hist_slot,
+                pos + per, index + per)
+
+    for _ in range(3):  # compile + warm-up
+        tok, cache, history, hist_slot, pos, index = step_once(
+            tok, cache, history, hist_slot, pos, index
+        )
+    _sync(tok)
+    t0 = time.perf_counter()
+    for _ in range(dispatches):
+        tok, cache, history, hist_slot, pos, index = step_once(
+            tok, cache, history, hist_slot, pos, index
+        )
+    _sync(tok)
+    dt = time.perf_counter() - t0
+
+    agg_tok_s = dispatches * per * batch / dt
+    model_gb = _param_bytes(params) / 1e9
+    roofline = _hbm_gbps(dev) / model_gb  # single-stream weights-bound ideal
+    wtag = "int8" if quant == "int8" else "bf16"
+    print(json.dumps({
+        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_b{batch}",
+        "value": round(agg_tok_s, 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(agg_tok_s / roofline, 4),
+    }))
+    sys.stderr.write(
+        f"device={dev.device_kind} params={model_gb:.2f}GB batch={batch} "
+        f"single-stream roofline={roofline:.1f}tok/s "
+        f"per-stream {agg_tok_s / batch:.1f}tok/s ttft_cold={ttft_s:.2f}s "
+        f"timed_tokens={dispatches * per * batch} multistep={per}\n"
+    )
+    return 0
+
+
 def main() -> int:
     preset = os.environ.get("CAKE_BENCH_PRESET", "8b")
     if (os.environ.get("CAKE_BENCH_NO_FALLBACK") != "1"
@@ -235,10 +388,16 @@ def main() -> int:
         return 1
 
     settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    multistep = int(os.environ.get("CAKE_BENCH_MULTISTEP", "16"))
+    batch = int(os.environ.get("CAKE_BENCH_BATCH", "1"))
+    if os.environ.get("CAKE_BENCH_PREFILL") == "1":
+        return _run_prefill(config, params, preset, quant, dev)
+    if batch > 1:
+        return _run_batched(config, params, preset, quant, settings, dev,
+                            batch, steps, multistep)
     cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
     history, hist_slot = init_history(settings.repeat_last_n)
 
-    multistep = int(os.environ.get("CAKE_BENCH_MULTISTEP", "16"))
     if multistep > 1:
         decode = jax.jit(
             partial(decode_scan_fn, config=config, settings=settings,

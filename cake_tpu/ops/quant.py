@@ -111,12 +111,23 @@ def quant_matmul(
     from cake_tpu.ops import pallas as pk
 
     if impl == "auto":
+        # The compiled kernel needs enough rows to tile the MXU; skinny
+        # inputs run XLA's gemv path, which is ~67% faster at M=1 on v5e
+        # (measured single-stream 8B int8: 84.7 vs 50.7 tok/s) and ~40%
+        # faster at M=8 (batched decode). The crossover is ~M=16, where the
+        # kernel's int8-in-VMEM streaming starts winning (522 vs 505
+        # aggregate tok/s at batch 16) — see BASELINE.md r2.
+        m = x.size // x.shape[-1]
         impl = (
             "pallas"
             if pk.kernels_enabled()
             and (
                 pk.interpret_default()
-                or (q.shape[0] % 256 == 0 and q.shape[1] % 256 == 0)
+                or (
+                    m >= 16
+                    and q.shape[0] % 256 == 0
+                    and q.shape[1] % 256 == 0
+                )
             )
             else "xla"
         )

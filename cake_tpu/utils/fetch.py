@@ -69,10 +69,15 @@ def fetch_checkpoint(
 
 
 def _hub_populated(dest: Path) -> bool:
-    """Is this dir a COMPLETE checkpoint? config + (every shard the index
-    names, or at least one monolithic safetensors). A partial/interrupted
-    download fails this and gets repaired by the hub call."""
+    """Is this dir a COMPLETE checkpoint? config + tokenizer + (every shard
+    the index names, or at least one monolithic safetensors). A partial or
+    interrupted download fails this and gets repaired by the hub call
+    (snapshot_download is incremental — only missing files transfer)."""
     if not (dest / "config.json").exists():
+        return False
+    if not (dest / "tokenizer.json").exists():
+        # may simply not exist upstream — the (cheap, incremental) hub call
+        # settles it rather than guessing offline
         return False
     idx = dest / "model.safetensors.index.json"
     if idx.exists():

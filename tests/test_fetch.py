@@ -78,6 +78,7 @@ def test_hub_fetch_skips_when_populated(tmp_path, monkeypatch):
     dest = tmp_path / "model"
     dest.mkdir()
     (dest / "config.json").write_text("{}")
+    (dest / "tokenizer.json").write_text("{}")
     (dest / "model.safetensors").write_bytes(b"\x00")
 
     def boom(**kw):  # pragma: no cover - must not be reached
@@ -87,3 +88,23 @@ def test_hub_fetch_skips_when_populated(tmp_path, monkeypatch):
 
     monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
     fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+
+
+def test_hub_fetch_repairs_partial_checkout(tmp_path, monkeypatch):
+    """config+weights without a tokenizer is NOT 'populated': the hub call
+    runs (incremental) so an interrupted download self-repairs."""
+    dest = tmp_path / "model"
+    dest.mkdir()
+    (dest / "config.json").write_text("{}")
+    (dest / "model.safetensors").write_bytes(b"\x00")
+    calls = {"n": 0}
+
+    def fake(repo_id, revision, local_dir, allow_patterns):
+        calls["n"] += 1
+        Path(local_dir, "tokenizer.json").write_text("{}")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", fake)
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert calls["n"] == 1 and (dest / "tokenizer.json").exists()
