@@ -53,13 +53,27 @@ _HBM_GBPS = {
     "cpu": 50.0,
 }
 
+# chip kind -> approx bf16 peak TFLOP/s (public specs)
+_PEAK_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 1.0,
+}
 
-def _hbm_gbps(device) -> float:
+
+def _device_spec(device, table, default):
     kind = getattr(device, "device_kind", "cpu").lower()
-    for k, v in _HBM_GBPS.items():
+    for k, v in table.items():
         if k in kind:
             return v
-    return 819.0
+    return default
+
+
+def _hbm_gbps(device) -> float:
+    return _device_spec(device, _HBM_GBPS, 819.0)
 
 
 def _config(preset: str):
@@ -170,7 +184,7 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     flops = 2.0 * sum(
         x.size for x in jax.tree.leaves(params)
     ) * t
-    peak = 197e12 if "v5" in dev.device_kind.lower() else 50e12
+    peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
     print(json.dumps({
         "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",
         "value": round(t / dt, 3),

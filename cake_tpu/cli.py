@@ -79,6 +79,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--device", type=int, default=None,
                    help="device ordinal (reference --device GPU ordinal, "
                         "lib.rs:17-19; here an index into jax.devices())")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="multi-host pod: jax.distributed coordinator "
+                        "address (same command on every host; pairs with "
+                        "--num-processes/--process-id, or auto-resolved on "
+                        "Cloud TPU)")
+    p.add_argument("--num-processes", type=int, default=None,
+                   dest="num_processes")
+    p.add_argument("--process-id", type=int, default=None, dest="process_id")
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of generation to DIR")
@@ -373,6 +381,16 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if args.process_id is not None and not (args.coordinator
+                                            or args.num_processes):
+        sys.exit("error: --process-id requires --coordinator and/or "
+                 "--num-processes (it would otherwise be silently ignored)")
+    if args.coordinator or args.num_processes:
+        from cake_tpu.parallel.distributed import initialize
+
+        initialize(coordinator=args.coordinator,
+                   num_processes=args.num_processes,
+                   process_id=args.process_id)
     if args.device is not None:
         import jax
 

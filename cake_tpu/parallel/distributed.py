@@ -1,0 +1,65 @@
+"""Multi-host bootstrap: one SPMD program across TPU pod hosts.
+
+The reference scales across hosts with hand-rolled TCP between a master and
+workers (`cake-core/src/cake/{client,worker}.rs`) — every hop serializes
+tensors through sockets. On a TPU pod the idiomatic scale-out is the other
+way around: every host runs the SAME program under `jax.distributed`, the
+global mesh spans all hosts' chips, and stage/tp/sp/dp collectives ride ICI
+(DCN only across slices) with zero application-level serialization. The
+cross-host TCP plane (runtime/{master,worker}) remains for heterogeneous or
+non-pod deployments; this module is the pod path.
+
+Usage (same command on every host; the env is auto-populated on Cloud TPU):
+
+    cake_tpu.parallel.distributed.initialize()          # env-driven
+    # or explicitly:
+    initialize(coordinator="10.0.0.2:8476", num_processes=4, process_id=h)
+
+then build the mesh over `jax.devices()` (all hosts' chips) as usual —
+`MeshPlan.build(...)` already consumes the global device list.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("cake_tpu.distributed")
+
+
+def initialize(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> dict:
+    """Join (or trivially form) the multi-host runtime; returns a summary.
+
+    With no arguments on Cloud TPU, `jax.distributed.initialize()` resolves
+    everything from the TPU metadata/env. A single-process call (or
+    ``num_processes=1``) is a no-op beyond importing jax — the same code
+    path runs laptop, single VM, and pod.
+    """
+    import jax
+
+    if num_processes is None:
+        env_n = int(os.environ.get("CAKE_NUM_PROCESSES", "1"))
+        if env_n > 1:
+            num_processes = env_n
+    if num_processes is not None or coordinator is not None:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    info = {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+    log.info(
+        "distributed runtime: process %d/%d, %d local / %d global devices",
+        info["process_index"], info["process_count"],
+        info["local_devices"], info["global_devices"],
+    )
+    return info

@@ -68,16 +68,21 @@ def fetch_checkpoint(
     return dest
 
 
-def _hub_populated(dest: Path) -> bool:
-    """Is this dir a COMPLETE checkpoint? config + tokenizer + (every shard
-    the index names, or at least one monolithic safetensors). A partial or
-    interrupted download fails this and gets repaired by the hub call
-    (snapshot_download is incremental — only missing files transfer)."""
-    if not (dest / "config.json").exists():
+_STAMP = ".cake_fetched"
+
+
+def _hub_populated(dest: Path, want: str) -> bool:
+    """Is this dir a COMPLETE checkout of ``want`` (``repo`` or
+    ``repo@rev``)? Completeness cannot be judged from files alone (a repo
+    may legitimately lack tokenizer.json; a download may have died between
+    shards), so a successful snapshot writes a stamp recording what it
+    fetched; stamp match + config + every index-named shard => skip the
+    network. Anything else re-consults the hub, which is incremental —
+    only missing/changed files transfer."""
+    stamp = dest / _STAMP
+    if not stamp.exists() or stamp.read_text().strip() != want:
         return False
-    if not (dest / "tokenizer.json").exists():
-        # may simply not exist upstream — the (cheap, incremental) hub call
-        # settles it rather than guessing offline
+    if not (dest / "config.json").exists():
         return False
     idx = dest / "model.safetensors.index.json"
     if idx.exists():
@@ -102,11 +107,9 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
         raise RuntimeError(
             "hf:// fetch requires the huggingface_hub package"
         ) from e
-    # Skip the network only for a COMPLETE unpinned checkout; an explicit
-    # @revision always consults the hub (snapshot_download is itself
-    # incremental — only missing/changed files transfer).
-    if not force and revision is None and _hub_populated(dest):
-        log.info("fetch: %s already populated, skipping hub", dest)
+    want = f"{repo}@{revision}" if revision else repo
+    if not force and _hub_populated(dest, want):
+        log.info("fetch: %s already populated (%s), skipping hub", dest, want)
         return dest
     snapshot_download(
         repo_id=repo,
@@ -114,6 +117,6 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
         local_dir=str(dest),
         allow_patterns=list(patterns),
     )
-    log.info("fetched %s%s from the HF Hub into %s", repo,
-             f"@{revision}" if revision else "", dest)
+    (dest / _STAMP).write_text(want)
+    log.info("fetched %s from the HF Hub into %s", want, dest)
     return dest

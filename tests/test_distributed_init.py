@@ -1,0 +1,42 @@
+"""Multi-host bootstrap plane (parallel/distributed.py).
+
+Real multi-process pods cannot run in CI; covered here: the single-process
+path is a no-op that reports correct topology, and the >1-process path
+passes the right arguments into jax.distributed.initialize (stubbed)."""
+
+import jax
+import pytest
+
+from cake_tpu.parallel import distributed
+
+
+def test_single_process_noop():
+    info = distributed.initialize()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_devices"] == len(jax.devices())
+    assert info["local_devices"] == info["global_devices"]
+
+
+def test_multi_process_args_forwarded(monkeypatch):
+    calls = {}
+
+    def fake_init(coordinator_address=None, num_processes=None,
+                  process_id=None):
+        calls.update(coordinator=coordinator_address, n=num_processes,
+                     pid=process_id)
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    distributed.initialize(coordinator="10.0.0.2:8476", num_processes=4,
+                           process_id=2)
+    assert calls == {"coordinator": "10.0.0.2:8476", "n": 4, "pid": 2}
+
+
+def test_env_process_count_triggers_init(monkeypatch):
+    hit = {}
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: hit.update(kw))
+    monkeypatch.setenv("CAKE_NUM_PROCESSES", "2")
+    distributed.initialize()
+    # the env value must actually be forwarded, not just gate the call
+    assert hit["num_processes"] == 2

@@ -74,12 +74,14 @@ def test_hub_fetch_wiring(tmp_path, monkeypatch):
     assert (dest / "config.json").exists()
 
 
-def test_hub_fetch_skips_when_populated(tmp_path, monkeypatch):
+def test_hub_fetch_skips_when_stamped_complete(tmp_path, monkeypatch):
+    """A checkout the fetcher itself completed (stamp + config + weights)
+    skips the network entirely — warm offline runs keep working."""
     dest = tmp_path / "model"
     dest.mkdir()
     (dest / "config.json").write_text("{}")
-    (dest / "tokenizer.json").write_text("{}")
     (dest / "model.safetensors").write_bytes(b"\x00")
+    (dest / ".cake_fetched").write_text("meta-llama/Meta-Llama-3-8B")
 
     def boom(**kw):  # pragma: no cover - must not be reached
         raise AssertionError("hub hit despite populated dir")
@@ -91,8 +93,9 @@ def test_hub_fetch_skips_when_populated(tmp_path, monkeypatch):
 
 
 def test_hub_fetch_repairs_partial_checkout(tmp_path, monkeypatch):
-    """config+weights without a tokenizer is NOT 'populated': the hub call
-    runs (incremental) so an interrupted download self-repairs."""
+    """An interrupted download (no completion stamp) re-consults the hub
+    (incremental) and self-repairs; success writes the stamp so the next
+    run skips."""
     dest = tmp_path / "model"
     dest.mkdir()
     (dest / "config.json").write_text("{}")
@@ -108,3 +111,26 @@ def test_hub_fetch_repairs_partial_checkout(tmp_path, monkeypatch):
     monkeypatch.setattr(huggingface_hub, "snapshot_download", fake)
     fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
     assert calls["n"] == 1 and (dest / "tokenizer.json").exists()
+    assert (dest / ".cake_fetched").read_text() == "meta-llama/Meta-Llama-3-8B"
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert calls["n"] == 1  # stamped: second run skipped the hub
+
+
+def test_hub_fetch_revision_change_reconsults(tmp_path, monkeypatch):
+    """A pinned @revision different from the stamped one must hit the hub."""
+    dest = tmp_path / "model"
+    dest.mkdir()
+    (dest / "config.json").write_text("{}")
+    (dest / "model.safetensors").write_bytes(b"\x00")
+    (dest / ".cake_fetched").write_text("meta-llama/Meta-Llama-3-8B")
+    calls = {"n": 0}
+
+    import huggingface_hub
+
+    monkeypatch.setattr(
+        huggingface_hub, "snapshot_download",
+        lambda **kw: calls.update(n=calls["n"] + 1),
+    )
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@v2", dest)
+    assert calls["n"] == 1
+    assert (dest / ".cake_fetched").read_text() == "meta-llama/Meta-Llama-3-8B@v2"
