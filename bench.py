@@ -63,6 +63,15 @@ _PEAK_TFLOPS = {
     "cpu": 1.0,
 }
 
+# chip kind -> HBM capacity GiB (public specs)
+_HBM_GIB = {
+    "v5 lite": 16.0,
+    "v5e": 16.0,
+    "v4": 32.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+
 
 def _device_spec(device, table, default):
     kind = getattr(device, "device_kind", "cpu").lower()
@@ -350,6 +359,31 @@ def main() -> int:
         # user did not ask for
         presets = ["8b", "small", "tiny"]
         ladder = [(p, quant) for p in presets[presets.index(preset):]]
+    # HBM preflight: skip rungs whose budget arithmetic provably exceeds
+    # this chip's usable HBM (capacity from the device-kind table minus the
+    # measured ~9% runtime reserve) instead of burning minutes of real OOM
+    # attempts + retry sleeps on them. The try/except ladder below remains
+    # the backstop for when the estimate is wrong.
+    if dev.platform != "cpu":
+        from cake_tpu.utils.memory import hbm_budget
+
+        usable = _device_spec(dev, _HBM_GIB, 16.0) * 0.91 * 2**30
+        bench_batch = max(1, int(os.environ.get("CAKE_BENCH_BATCH", "1")))
+        idx = ladder.index(rung)
+        while idx + 1 < len(ladder):
+            p_, q_ = ladder[idx]
+            est = hbm_budget(_config(p_), batch=bench_batch,
+                             quant=q_ or None)["total"]
+            if est <= usable:
+                break
+            sys.stderr.write(
+                f"preset={p_}{'+' + q_ if q_ else ''} needs "
+                f"~{est / 2**30:.1f} GiB > ~{usable / 2**30:.1f} GiB usable "
+                f"on {dev.device_kind}; skipping to the next rung\n"
+            )
+            idx += 1
+        rung = ladder[idx]
+        preset, quant = rung
     params = config = None
     cfg = _config(preset)
     # A freshly released chip can still hold the previous process's memory

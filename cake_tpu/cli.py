@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 import time
 from pathlib import Path
@@ -385,7 +386,8 @@ def main(argv=None) -> int:
                                             or args.num_processes):
         sys.exit("error: --process-id requires --coordinator and/or "
                  "--num-processes (it would otherwise be silently ignored)")
-    if args.coordinator or args.num_processes:
+    if (args.coordinator or args.num_processes
+            or int(os.environ.get("CAKE_NUM_PROCESSES", "1")) > 1):
         from cake_tpu.parallel.distributed import initialize
 
         initialize(coordinator=args.coordinator,

@@ -71,17 +71,9 @@ def fetch_checkpoint(
 _STAMP = ".cake_fetched"
 
 
-def _hub_populated(dest: Path, want: str) -> bool:
-    """Is this dir a COMPLETE checkout of ``want`` (``repo`` or
-    ``repo@rev``)? Completeness cannot be judged from files alone (a repo
-    may legitimately lack tokenizer.json; a download may have died between
-    shards), so a successful snapshot writes a stamp recording what it
-    fetched; stamp match + config + every index-named shard => skip the
-    network. Anything else re-consults the hub, which is incremental —
-    only missing/changed files transfer."""
-    stamp = dest / _STAMP
-    if not stamp.exists() or stamp.read_text().strip() != want:
-        return False
+def _files_complete(dest: Path) -> bool:
+    """config + every shard the safetensors index names (or at least one
+    monolithic safetensors file)."""
     if not (dest / "config.json").exists():
         return False
     idx = dest / "model.safetensors.index.json"
@@ -96,6 +88,28 @@ def _hub_populated(dest: Path, want: str) -> bool:
     return any(dest.glob("*.safetensors"))
 
 
+def _hub_populated(dest: Path, want: str) -> bool:
+    """Is this dir a COMPLETE checkout of ``want`` (``repo`` or
+    ``repo@rev``)? Completeness cannot be judged from files alone (a repo
+    may legitimately lack tokenizer.json; a download may have died between
+    shards), so a successful snapshot writes a stamp recording what it
+    fetched; stamp match + config + every index-named shard => skip the
+    network. A pre-stamp-era checkout (no stamp, but config + tokenizer +
+    weights all present, unpinned fetch) is accepted and stamped on first
+    verification so warm offline runs keep working across the upgrade."""
+    stamp = dest / _STAMP
+    if stamp.exists():
+        return stamp.read_text().strip() == want and _files_complete(dest)
+    if (
+        "@" not in want
+        and (dest / "tokenizer.json").exists()
+        and _files_complete(dest)
+    ):
+        stamp.write_text(want)
+        return True
+    return False
+
+
 def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
                force: bool) -> Path:
     revision = None
@@ -108,9 +122,20 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
             "hf:// fetch requires the huggingface_hub package"
         ) from e
     want = f"{repo}@{revision}" if revision else repo
-    if not force and _hub_populated(dest, want):
+    # Only an unpinned fetch or an immutable commit-hash pin may skip the
+    # hub on a stamp match; a branch/tag pin (movable) must always consult
+    # the hub or it would track a stale tip forever.
+    import re
+
+    immutable = revision is None or bool(
+        re.fullmatch(r"[0-9a-f]{7,40}", revision)
+    )
+    if not force and immutable and _hub_populated(dest, want):
         log.info("fetch: %s already populated (%s), skipping hub", dest, want)
         return dest
+    # About to mutate dest: a download dying halfway must not leave a
+    # valid-looking stamp certifying a mixed checkout.
+    (dest / _STAMP).unlink(missing_ok=True)
     snapshot_download(
         repo_id=repo,
         revision=revision,

@@ -116,8 +116,10 @@ def test_hub_fetch_repairs_partial_checkout(tmp_path, monkeypatch):
     assert calls["n"] == 1  # stamped: second run skipped the hub
 
 
-def test_hub_fetch_revision_change_reconsults(tmp_path, monkeypatch):
-    """A pinned @revision different from the stamped one must hit the hub."""
+def test_hub_fetch_movable_revision_always_reconsults(tmp_path, monkeypatch):
+    """A branch/tag pin (movable revision) consults the hub every time —
+    even when stamped — or it would silently track a stale tip forever.
+    Commit-hash pins and unpinned fetches may stamp-skip."""
     dest = tmp_path / "model"
     dest.mkdir()
     (dest / "config.json").write_text("{}")
@@ -134,3 +136,50 @@ def test_hub_fetch_revision_change_reconsults(tmp_path, monkeypatch):
     fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@v2", dest)
     assert calls["n"] == 1
     assert (dest / ".cake_fetched").read_text() == "meta-llama/Meta-Llama-3-8B@v2"
+    # movable pin: hits the hub again despite the matching stamp
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@v2", dest)
+    assert calls["n"] == 2
+    # immutable commit-hash pin: stamp-skips once stamped
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@abc123def4", dest)
+    assert calls["n"] == 3
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B@abc123def4", dest)
+    assert calls["n"] == 3
+
+
+def test_hub_fetch_legacy_unstamped_checkout_accepted(tmp_path, monkeypatch):
+    """A complete pre-stamp-era checkout (config + tokenizer + weights, no
+    stamp) skips the hub and gets stamped on first verification."""
+    dest = tmp_path / "model"
+    dest.mkdir()
+    (dest / "config.json").write_text("{}")
+    (dest / "tokenizer.json").write_text("{}")
+    (dest / "model.safetensors").write_bytes(b"\x00")
+
+    def boom(**kw):  # pragma: no cover - must not be reached
+        raise AssertionError("hub hit for a complete legacy checkout")
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
+    fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert (dest / ".cake_fetched").read_text() == "meta-llama/Meta-Llama-3-8B"
+
+
+def test_hub_fetch_interrupted_refetch_invalidates_stamp(tmp_path, monkeypatch):
+    """A download dying mid-refetch must not leave the old stamp certifying
+    a mixed checkout: the stamp is unlinked before the hub call."""
+    dest = tmp_path / "model"
+    dest.mkdir()
+    (dest / "config.json").write_text("{}")
+    (dest / "model.safetensors").write_bytes(b"\x00")
+    (dest / ".cake_fetched").write_text("meta-llama/Meta-Llama-3-8B")
+
+    import huggingface_hub
+
+    def dies(**kw):
+        raise ConnectionError("network died mid-download")
+
+    monkeypatch.setattr(huggingface_hub, "snapshot_download", dies)
+    with pytest.raises(ConnectionError):
+        fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest, force=True)
+    assert not (dest / ".cake_fetched").exists()
