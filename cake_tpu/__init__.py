@@ -9,4 +9,9 @@ native runtime components. See SURVEY.md for the reference blueprint.
 
 __version__ = "0.1.0"
 
-from cake_tpu.models.config import LlamaConfig, llama3_8b, llama3_70b  # noqa: F401
+from cake_tpu.models.config import (  # noqa: F401
+    LlamaConfig,
+    llama2_7b,
+    llama3_8b,
+    llama3_70b,
+)

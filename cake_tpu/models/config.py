@@ -101,6 +101,26 @@ def llama3_8b(**overrides) -> LlamaConfig:
     return LlamaConfig(**overrides)
 
 
+def llama2_7b(**overrides) -> LlamaConfig:
+    """Llama-2-7B: MHA (kv_heads == heads, GQA group 1), 11008 intermediate,
+    32000 vocab, rope_theta 10000 — the pre-GQA family the reference's
+    candle stack also serves; exercises the group=1 attention path."""
+    base = dict(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=32,
+        rope_theta=10000.0,
+        max_seq_len=4096,
+        bos_token_id=1,  # sentencepiece ids, NOT the Llama-3 defaults
+        eos_token_id=2,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
 def llama3_70b(**overrides) -> LlamaConfig:
     base = dict(
         hidden_size=8192,

@@ -1,6 +1,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from cake_tpu.models import llama
 from cake_tpu.models.config import tiny
@@ -15,11 +16,30 @@ def _full_logits(config, params, tokens):
     return logits
 
 
-def test_prefill_then_decode_matches_full_forward(tiny_config, tiny_params):
+def _mha_tiny():
+    """Llama-2-class MHA geometry (kv_heads == heads, GQA group 1) at tiny
+    dims — exercises the group=1 attention path."""
+    from cake_tpu.models.config import llama2_7b
+
+    return llama2_7b(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_seq_len=32, dtype="float32",
+    )
+
+
+@pytest.mark.parametrize("family", ["gqa", "mha"])
+def test_prefill_then_decode_matches_full_forward(tiny_config, tiny_params,
+                                                  family):
     """KV-cache correctness: incremental decode must equal full-context
-    forward. This is the core invariant the reference never tests
-    (SURVEY.md §4)."""
-    cfg, params = tiny_config, tiny_params
+    forward, for both GQA (Llama-3) and MHA/group-1 (Llama-2) attention.
+    This is the core invariant the reference never tests (SURVEY.md §4)."""
+    if family == "gqa":
+        cfg, params = tiny_config, tiny_params
+    else:
+        cfg = _mha_tiny()
+        assert cfg.num_attention_heads == cfg.num_key_value_heads
+        params = llama.init_params(cfg, jax.random.PRNGKey(2))
     rs = np.random.RandomState(0)
     ids = rs.randint(0, cfg.vocab_size, size=10).tolist()
 
@@ -116,3 +136,13 @@ def test_logits_are_f32(tiny_config, tiny_params):
     )
     assert logits.dtype == jnp.float32
     assert logits.shape == (1, cfg.vocab_size)
+
+
+def test_llama2_7b_preset_real_geometry():
+    from cake_tpu.models.config import llama2_7b
+
+    cfg = llama2_7b()
+    assert (cfg.vocab_size, cfg.hidden_size, cfg.intermediate_size) == (
+        32000, 4096, 11008)
+    assert cfg.num_attention_heads == cfg.num_key_value_heads == 32
+    assert cfg.head_dim == 128 and cfg.rope_theta == 10000.0
