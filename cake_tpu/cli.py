@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel width (ring attention prefill)")
+    p.add_argument("--prefill-chunks", type=int, default=1,
+                   dest="prefill_chunks",
+                   help="pipeline the prompt pass through the stages in M "
+                        "chunks (GPipe-style overlap; stages>1, sp=1)")
     p.add_argument("--device", type=int, default=None,
                    help="device ordinal (reference --device GPU ordinal, "
                         "lib.rs:17-19; here an index into jax.devices())")
@@ -252,6 +256,12 @@ def run_master(args) -> int:
             )
         topo_mesh = bool(with_dev)
     use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
+    if args.prefill_chunks > 1 and not use_mesh:
+        sys.exit(
+            "error: --prefill-chunks pipelines the prompt across mesh "
+            "stages; it requires --stages > 1 (or a device-indexed "
+            "topology), otherwise it would be silently ignored"
+        )
     if topo_mesh and args.stages > 1:
         sys.exit(
             "error: --stages conflicts with a device-indexed topology "
@@ -283,7 +293,8 @@ def run_master(args) -> int:
         gen = MeshGenerator(config, params, plan=plan, tokenizer=tokenizer,
                             settings=settings, max_seq=args.max_seq,
                             num_stages=args.stages, tp=args.tp, sp=args.sp,
-                            block_size=args.decode_block)
+                            block_size=args.decode_block,
+                            prefill_chunks=args.prefill_chunks)
     elif args.topology:
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 

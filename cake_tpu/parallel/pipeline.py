@@ -99,6 +99,80 @@ def _pipeline_layers(
     return jax.lax.fori_loop(0, num_stages, body, (x, ck, cv))
 
 
+def _pipelined_prefill_layers(
+    x_chunks: jax.Array,  # [M, B, C, hidden] embedded chunks (stage 0's feed)
+    layers,
+    ck: jax.Array,
+    cv: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    config: LlamaConfig,
+    num_stages: int,
+    heads_l: int,
+    kv_heads_l: int,
+):
+    """GPipe-style pipelined prefill: prompt chunks stream through the
+    stages so all stages compute concurrently.
+
+    The reference has "no micro-batching and no pipelining overlap" —
+    upstream workers idle while downstream compute (SURVEY.md §2), and the
+    plain staged prefill here inherits that wall-clock shape (S serialized
+    passes over the full prompt). Prefill is MXU-bound, so overlap is real
+    throughput: chunk ``j`` enters stage 0 at iteration ``j`` and stage
+    ``s`` processes it at iteration ``j + s``; once the pipeline fills,
+    every stage works every iteration — ~S× prefill/TTFT on S stages,
+    minus the (S-1)-iteration fill/drain bubble.
+
+    Causality holds by construction: chunks traverse each stage in order,
+    so when chunk ``j`` reaches a stage, that stage's KV rows for chunks
+    ``0..j-1`` are already written; attention over the fixed cache buffer
+    at ``pos = j*C`` masks everything beyond the frontier as usual.
+
+    Returns ``(y [M, B, C, hidden] — final activations, valid on stage 0
+    only), ck, cv``.
+    """
+    my_stage = jax.lax.axis_index(STAGE)
+    perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+    m_chunks, b, c, hidden = x_chunks.shape
+
+    y0 = jnp.zeros_like(x_chunks)
+    x0 = jnp.zeros((b, c, hidden), x_chunks.dtype)
+
+    def body(t, carry):
+        x, ck, cv, y = carry
+        # 1) collect: the permuted-in x on stage 0 is chunk t-S, finished
+        j_done = jnp.clip(t - num_stages, 0, m_chunks - 1)
+        collect = (my_stage == 0) & (t >= num_stages)
+        cur = jax.lax.dynamic_slice_in_dim(y, j_done, 1, axis=0)
+        y = jax.lax.dynamic_update_slice_in_dim(
+            y, jnp.where(collect, x[None], cur), j_done, axis=0
+        )
+        # 2) inject: stage 0 feeds chunk t into the pipeline
+        j_in = jnp.clip(t, 0, m_chunks - 1)
+        xin = jax.lax.dynamic_slice_in_dim(x_chunks, j_in, 1, axis=0)[0]
+        x = jnp.where((my_stage == 0) & (t < m_chunks), xin, x)
+        # 3) compute: this stage holds chunk j = t - my_stage (SPMD-uniform;
+        # invalid iterations compute into a discarded select, gated KV)
+        j = t - my_stage
+        valid = (j >= 0) & (j < m_chunks)
+        pos = jnp.clip(j, 0, m_chunks - 1) * c
+        h, new_cache = llama.forward_layers(
+            layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
+            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+            write_gate=valid,
+        )
+        x = jnp.where(valid, h, x)
+        x = jax.lax.ppermute(x, STAGE, perm)
+        return x, new_cache.k, new_cache.v, y
+
+    # M injections + S iterations for the last chunk to traverse and land
+    # back on stage 0 (collection happens at the top of the iteration)
+    _, ck, cv, y = jax.lax.fori_loop(
+        0, m_chunks + num_stages, body, (x0, ck, cv, y0)
+    )
+    return y, ck, cv
+
+
 def _select_stage0(x: jax.Array) -> jax.Array:
     """Broadcast stage 0's value to all stages (the activation is only valid
     where the pipeline completed)."""
@@ -247,7 +321,8 @@ def build_sharded_decode(
 
 
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
-                          params_like: dict | None = None):
+                          params_like: dict | None = None,
+                          microbatch: int = 1):
     """Compile the multi-chip prompt pass.
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
@@ -260,8 +335,16 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     (``ring.sp_chunked_cache_write``). Positions past the prompt hold zero KV
     that decode steps overwrite slot-by-slot before they ever become
     attendable.
+
+    ``microbatch = M > 1`` (requires ``sp == 1``, ``num_stages > 1``,
+    ``T % M == 0``) selects GPipe-style pipelined prefill: the prompt is
+    split into M chunks that stream through the stages concurrently
+    (:func:`_pipelined_prefill_layers`) — ~num_stages× prompt throughput
+    once the pipeline fills, identical results.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if microbatch > 1 and plan.sp != 1:
+        raise ValueError("pipelined (microbatch) prefill requires sp == 1")
 
     def step(params, tokens, cache, last_index):
         cos, sin = rope_tables(
@@ -269,14 +352,33 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             scaling=config.rope_scaling,
         )
         x = params["embed"][tokens].astype(config.jax_dtype)
-        # sp_prefill explicit: a bucketed prompt can give each shard a
-        # ONE-token chunk, which the T>1 heuristic would misroute to the
-        # decode branch (silently wrong logits — r2 code-review finding)
-        x, ck, cv = _pipeline_layers(
-            x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
-            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
-            sp_prefill=True,
-        )
+        if microbatch > 1:
+            b, t = tokens.shape
+            if t % microbatch:
+                raise ValueError(
+                    f"prompt bucket {t} not divisible into {microbatch} "
+                    "pipeline chunks"
+                )
+            chunk = t // microbatch
+            # [B, T, H] -> [M, B, C, H]
+            x_chunks = x.reshape(b, microbatch, chunk, -1).transpose(
+                1, 0, 2, 3
+            )
+            y, ck, cv = _pipelined_prefill_layers(
+                x_chunks, params["layers"], cache.k, cache.v, cos, sin,
+                config, plan.num_stages, heads_l, kv_heads_l,
+            )
+            # [M, B, C, H] -> [B, T, H] (valid on stage 0; selected below)
+            x = y.transpose(1, 0, 2, 3).reshape(b, t, -1)
+        else:
+            # sp_prefill explicit: a bucketed prompt can give each shard a
+            # ONE-token chunk, which the T>1 heuristic would misroute to the
+            # decode branch (silently wrong logits — r2 code-review finding)
+            x, ck, cv = _pipeline_layers(
+                x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
+                plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+                sp_prefill=True,
+            )
         # slice the wanted position first so the cross-stage select moves
         # [B, hidden], not the whole [B, T, hidden] activation
         x_last = _select_last_sp(x, last_index, plan.sp)

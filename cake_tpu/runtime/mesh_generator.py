@@ -48,6 +48,7 @@ class MeshGenerator(GeneratorBase):
         sp: int = 1,
         devices=None,
         block_size: int = 1,
+        prefill_chunks: int = 1,
     ):
         """``block_size > 1`` runs K pipeline+sample steps inside the one
         compiled mesh program per dispatch (build_sharded_decode steps=K) and
@@ -55,7 +56,11 @@ class MeshGenerator(GeneratorBase):
         absolute token index — the same schedule as the local and
         distributed paths — so one seed yields one stochastic stream
         regardless of sharding or block size (modulo the dp fold, identity
-        at dp=1)."""
+        at dp=1).
+
+        ``prefill_chunks = M > 1`` (stages > 1, sp == 1) pipelines the
+        prompt pass: M chunks stream through the stages concurrently
+        (GPipe-style), ~stages× prefill/TTFT throughput, identical tokens."""
         super().__init__(config, tokenizer, settings, max_seq)
         if plan is None:
             plan = MeshPlan.build(
@@ -72,12 +77,26 @@ class MeshGenerator(GeneratorBase):
             )
         self.plan = plan
         self.block_size = max(1, block_size)
+        self.prefill_chunks = max(1, prefill_chunks)
+        if self.prefill_chunks > 1 and plan.sp != 1:
+            raise ValueError("prefill_chunks (pipelined prefill) requires "
+                             "sp == 1")
+        # max_seq must divide into chunks: otherwise the chunk round-up of a
+        # max_seq-capped bucket would push t_pad past the cache window and
+        # clamp-write shifted KV rows (silently wrong logits)
+        if self.max_seq % self.prefill_chunks:
+            raise ValueError(
+                f"max_seq {self.max_seq} not divisible by prefill_chunks "
+                f"{self.prefill_chunks}"
+            )
         self.params = shard_params(params, plan.mesh)
         self.cache = shard_cache(
             init_cache(config, batch=1, max_seq=self.max_seq), plan.mesh
         )
-        self._prefill = build_sharded_prefill(config, plan,
-                                              params_like=self.params)
+        self._prefill = build_sharded_prefill(
+            config, plan, params_like=self.params,
+            microbatch=self.prefill_chunks,
+        )
         self._decode_single = build_sharded_decode(
             config, self.settings, plan, params_like=self.params
         )
@@ -100,6 +119,8 @@ class MeshGenerator(GeneratorBase):
             t_pad = _bucket(n, self.max_seq)
             if t_pad % self.plan.sp:
                 t_pad += self.plan.sp - t_pad % self.plan.sp
+            if t_pad % self.prefill_chunks:
+                t_pad += self.prefill_chunks - t_pad % self.prefill_chunks
             padded = self._prompt_tokens + [0] * (t_pad - n)
             tokens = jnp.asarray([padded], jnp.int32)
             logits, self.cache = self._prefill(

@@ -44,6 +44,25 @@ def test_greedy_parity_with_local(params, axes):
     assert got == _local_stream(params, [5, 9, 2, 11], 6, settings)
 
 
+def test_pipelined_prefill_chunks_parity(params):
+    """prefill_chunks (GPipe overlap) streams the same tokens as the plain
+    staged prefill and the all-local generator."""
+    settings = SamplerSettings(**GREEDY)
+    g = MeshGenerator(CFG, params, settings=settings, num_stages=2, tp=2,
+                      prefill_chunks=4)
+    g.set_prompt([5, 9, 2, 11, 7, 3])
+    got = [g.next_token(i).id for i in range(6)]
+    assert got == _local_stream(params, [5, 9, 2, 11, 7, 3], 6, settings)
+
+
+def test_prefill_chunks_divisibility_validated(params):
+    """max_seq must divide into prefill chunks, or a max_seq-capped bucket
+    would round past the cache window (clamped KV writes, silently wrong
+    logits — r2 code-review regression)."""
+    with pytest.raises(ValueError, match="prefill_chunks"):
+        MeshGenerator(CFG, params, num_stages=2, prefill_chunks=3)
+
+
 def test_second_prompt_resets_stream(params):
     settings = SamplerSettings(**GREEDY)
     g = MeshGenerator(CFG, params, settings=settings, num_stages=2, tp=2)

@@ -155,6 +155,81 @@ def test_from_topology_rejects_device_gaps():
 
 
 # ---------------------------------------------------------------------------
+# Pipelined (GPipe-style) chunked prefill: prompt chunks stream through the
+# stages concurrently. The reference explicitly has "no micro-batching and no
+# pipelining overlap" (SURVEY.md §2) — upstream workers idle.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "stages,tp,dp,microbatch",
+    [(2, 1, 1, 2), (2, 1, 1, 4), (4, 1, 1, 4), (2, 2, 1, 4), (2, 1, 2, 2),
+     (2, 2, 2, 4)],
+)
+def test_pipelined_prefill_matches_unsharded(params, stages, tp, dp,
+                                             microbatch):
+    plan = MeshPlan.build(CFG, num_stages=stages, tp=tp, dp=dp)
+    ids = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+    ref, _ = _reference_logits(params, ids)
+    prefill = build_sharded_prefill(CFG, plan, microbatch=microbatch)
+    sparams = shard_params(params, plan.mesh)
+    cache = shard_cache(
+        init_cache(CFG, batch=dp, max_seq=CFG.max_seq_len), plan.mesh
+    )
+    tokens = jnp.tile(jnp.asarray([ids + [0] * 4], jnp.int32), (dp, 1))
+    last = jnp.full((dp,), len(ids) - 1, jnp.int32)
+    logits, _ = prefill(sparams, tokens, cache, last)
+    for b in range(dp):
+        np.testing.assert_allclose(
+            np.asarray(logits[b]), np.asarray(ref[0]), rtol=2e-4, atol=2e-4
+        )
+
+
+def test_pipelined_prefill_cache_feeds_decode(params):
+    """The chunk-written KV must be exactly what decode attends over: the
+    greedy continuation after pipelined prefill matches the unsharded run."""
+    plan = MeshPlan.build(CFG, num_stages=2, tp=2)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    ids = [7, 3, 11, 2, 9, 1, 4, 6]
+
+    cache = init_cache(CFG, batch=1, max_seq=CFG.max_seq_len)
+    logits, cache = llama.forward(
+        params, jnp.asarray([ids], jnp.int32), cache, 0, CFG
+    )
+    expect, pos = [], len(ids)
+    for _ in range(4):
+        t = int(jnp.argmax(logits[0]))
+        expect.append(t)
+        logits, cache = llama.forward(
+            params, jnp.asarray([[t]], jnp.int32), cache, pos, CFG
+        )
+        pos += 1
+
+    prefill = build_sharded_prefill(CFG, plan, microbatch=4)
+    sparams = shard_params(params, plan.mesh)
+    cache_s = shard_cache(
+        init_cache(CFG, batch=1, max_seq=CFG.max_seq_len), plan.mesh
+    )
+    logits_s, cache_s = prefill(
+        sparams, jnp.asarray([ids], jnp.int32), cache_s,
+        jnp.asarray([len(ids) - 1], jnp.int32),
+    )
+    decode = build_sharded_decode(CFG, settings, plan)
+    history = jnp.full((1, settings.repeat_last_n), -1, jnp.int32)
+    tok = jnp.argmax(logits_s, axis=-1).astype(jnp.int32)
+    got, pos = [tok], jnp.int32(len(ids))
+    hist_slot = jnp.int32(0)
+    for _ in range(3):
+        tok, cache_s, history, hist_slot = decode(
+            sparams, tok, cache_s, pos, jax.random.PRNGKey(0), history,
+            hist_slot,
+        )
+        got.append(tok)
+        pos += 1
+    assert [int(t[0]) for t in got] == expect
+
+
+# ---------------------------------------------------------------------------
 # Sequence/context parallelism (sp axis): ring-attention prefill + distributed
 # flash decode must match the single-device oracle. The reference has no
 # long-context plane at all (SURVEY.md §5) — this is TPU-native capability.
