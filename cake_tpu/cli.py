@@ -256,12 +256,21 @@ def run_master(args) -> int:
             )
         topo_mesh = bool(with_dev)
     use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
-    if args.prefill_chunks > 1 and not use_mesh:
-        sys.exit(
-            "error: --prefill-chunks pipelines the prompt across mesh "
-            "stages; it requires --stages > 1 (or a device-indexed "
-            "topology), otherwise it would be silently ignored"
-        )
+    if args.prefill_chunks > 1:
+        # Overlap needs stages to overlap across, and the sp plane owns
+        # long-context prefill — reject combinations that would silently do
+        # nothing (stages=1) or die in a traceback (sp>1). A device-indexed
+        # topology resolves its stage count later; MeshGenerator/the
+        # builders re-validate and the error is surfaced below.
+        if args.sp > 1:
+            sys.exit("error: --prefill-chunks requires --sp 1 (ring "
+                     "attention is the sequence-parallel prefill plane)")
+        if not (args.stages > 1 or topo_mesh):
+            sys.exit(
+                "error: --prefill-chunks pipelines the prompt across mesh "
+                "stages; it requires --stages > 1 (or a device-indexed "
+                "topology), otherwise it would be silently ignored"
+            )
     if topo_mesh and args.stages > 1:
         sys.exit(
             "error: --stages conflicts with a device-indexed topology "
@@ -290,11 +299,15 @@ def run_master(args) -> int:
                      plan.num_stages, plan.tp, plan.sp)
         params = load_llama_params(args.model, config.num_hidden_layers,
                                    dtype=config.dtype, quantize=args.quantize)
-        gen = MeshGenerator(config, params, plan=plan, tokenizer=tokenizer,
-                            settings=settings, max_seq=args.max_seq,
-                            num_stages=args.stages, tp=args.tp, sp=args.sp,
-                            block_size=args.decode_block,
-                            prefill_chunks=args.prefill_chunks)
+        try:
+            gen = MeshGenerator(config, params, plan=plan,
+                                tokenizer=tokenizer, settings=settings,
+                                max_seq=args.max_seq, num_stages=args.stages,
+                                tp=args.tp, sp=args.sp,
+                                block_size=args.decode_block,
+                                prefill_chunks=args.prefill_chunks)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
     elif args.topology:
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 

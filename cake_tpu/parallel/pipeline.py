@@ -345,6 +345,11 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     if microbatch > 1 and plan.sp != 1:
         raise ValueError("pipelined (microbatch) prefill requires sp == 1")
+    if microbatch > 1 and plan.num_stages < 2:
+        raise ValueError(
+            "pipelined (microbatch) prefill requires num_stages > 1 — with "
+            "one stage there is nothing to overlap, only per-chunk overhead"
+        )
 
     def step(params, tokens, cache, last_index):
         cos, sin = rope_tables(

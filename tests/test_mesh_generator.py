@@ -61,6 +61,10 @@ def test_prefill_chunks_divisibility_validated(params):
     logits — r2 code-review regression)."""
     with pytest.raises(ValueError, match="prefill_chunks"):
         MeshGenerator(CFG, params, num_stages=2, prefill_chunks=3)
+    # one stage has nothing to overlap — reject instead of running M
+    # sequential chunk passes that are strictly slower
+    with pytest.raises(ValueError, match="num_stages"):
+        MeshGenerator(CFG, params, num_stages=1, prefill_chunks=2)
 
 
 def test_second_prompt_resets_stream(params):
