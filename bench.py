@@ -104,7 +104,7 @@ def _param_bytes(params) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
 
-def _device_init_probe(timeout_s: float = 120.0) -> bool:
+def _device_init_probe(timeout_s: float) -> bool:
     """Check device init completes in a THROWAWAY subprocess. A wedged
     remote chip hangs inside PJRT client init without returning to the
     interpreter (so in-process alarms can't fire); probing in a subprocess
@@ -126,12 +126,18 @@ def _device_init_probe(timeout_s: float = 120.0) -> bool:
 
 def _device_init_probe_retried() -> bool:
     """A wedged remote grant can clear within minutes: spread several
-    fresh-subprocess probes over a few minutes before giving up on the
-    accelerator (CAKE_BENCH_PROBES / CAKE_BENCH_PROBE_WAIT tune this)."""
-    probes = int(os.environ.get("CAKE_BENCH_PROBES", "3"))
-    wait_s = float(os.environ.get("CAKE_BENCH_PROBE_WAIT", "45"))
+    fresh-subprocess probes over 10+ minutes before giving up on the
+    accelerator. Defaults (10 probes x 60s timeout, 60s between) budget
+    ~10 min of patience when probes fail fast and ~19 min when every probe
+    hangs its full timeout — sized from two rounds of evidence that the
+    old 3x45s budget was smaller than observed wedge-clearing time
+    (CAKE_BENCH_PROBES / CAKE_BENCH_PROBE_WAIT / CAKE_BENCH_PROBE_TIMEOUT
+    tune this)."""
+    probes = int(os.environ.get("CAKE_BENCH_PROBES", "10"))
+    wait_s = float(os.environ.get("CAKE_BENCH_PROBE_WAIT", "60"))
+    timeout_s = float(os.environ.get("CAKE_BENCH_PROBE_TIMEOUT", "60"))
     for i in range(probes):
-        if _device_init_probe():
+        if _device_init_probe(timeout_s):
             return True
         if i < probes - 1:
             sys.stderr.write(
@@ -179,20 +185,30 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     _sync(logits)
     ttft_cold = time.perf_counter() - t0  # includes compile
 
+    # Each iteration's cache is allocated and synced OUTSIDE its timed
+    # window (prefill donates the cache, so a fresh one is needed per
+    # iteration). Timed per-iteration — NOT by pre-allocating all iters
+    # caches at once, which at 8B/16K-window would be ~17 GB of cache and
+    # OOM the chip before the bench starts.
     iters = 8
-    t0 = time.perf_counter()
+    dts = []
     for _ in range(iters):
         cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+        _sync(cache)
+        t0 = time.perf_counter()
         logits, cache = prefill(params, tokens, cache, last)
-    _sync(logits)
-    dt = (time.perf_counter() - t0) / iters
+        _sync(logits)
+        dts.append(time.perf_counter() - t0)
+    dt = sum(dts) / iters
 
     wtag = "int8" if quant == "int8" else "bf16"
     # vs_baseline: fraction of the chip's bf16 peak the prompt pass sustains
-    # (2 * params * T flops, attention excluded — conservative)
-    flops = 2.0 * sum(
+    # (2 * matmul-params * T flops: the embed table is a lookup, not a
+    # matmul, so it is excluded from the numerator; attention flops are
+    # also excluded — conservative)
+    flops = 2.0 * (sum(
         x.size for x in jax.tree.leaves(params)
-    ) * t
+    ) - config.vocab_size * config.hidden_size) * t
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
     print(json.dumps({
         "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",

@@ -49,10 +49,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prompt-ids", default=None, dest="prompt_ids",
                    help="comma-separated token ids (bypasses the tokenizer)")
     p.add_argument("--prompts-file", default=None, dest="prompts_file",
-                   help="serve N prompts concurrently (one per line; or "
-                        "comma-separated id lists with --prompt-ids-file "
-                        "semantics when every line is numeric) over the "
-                        "batched mesh pipeline")
+                   help="serve N prompts concurrently (one text prompt per "
+                        "line, or comma-separated token-id lists with "
+                        "--prompts-ids) over the batched mesh pipeline")
+    p.add_argument("--prompts-ids", action="store_true", dest="prompts_ids",
+                   help="treat every --prompts-file line as comma-separated "
+                        "token ids (explicit per-file mode: a text prompt "
+                        "that happens to look numeric, like '1, 2, 3', is "
+                        "never silently id-parsed)")
     p.add_argument("--dp", type=int, default=1,
                    help="data-parallel width for --prompts-file serving")
     p.add_argument("--seed", type=int, default=299792458)
@@ -68,6 +72,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="f16 maps to bf16 on TPU")
     p.add_argument("--quantize", choices=["int8"], default=None,
                    help="quantize linear weights on load (per-channel int8)")
+    p.add_argument("--kv-quant", choices=["int8"], default=None,
+                   dest="kv_quant",
+                   help="store the KV cache as int8 + per-slot scales "
+                        "(half the cache HBM — roughly doubles servable "
+                        "batch x window; local and mesh paths, sp=1)")
     p.add_argument("--decode-block", type=int, default=8, dest="decode_block",
                    help="fused decode steps per dispatch (all-local and mesh "
                         "paths; 1 = one program per token)")
@@ -180,6 +189,16 @@ def run_serve(args) -> int:
     if args.topology:
         sys.exit("error: --prompts-file serving runs the mesh pipeline; "
                  "--topology (cross-host workers) is not supported here")
+    # Reject flags this path would otherwise silently ignore (run_master
+    # gives the same treatment to its invalid combinations): serving is the
+    # sp=1 multi-stream plane, and pipelined prefill requires mesh stages.
+    if args.sp > 1:
+        sys.exit("error: --sp (sequence parallelism) is the long-context "
+                 "single-stream plane; it is not supported with "
+                 "--prompts-file serving")
+    if args.prefill_chunks > 1:
+        sys.exit("error: --prefill-chunks is not supported with "
+                 "--prompts-file serving")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
     settings = _settings(args)
@@ -190,24 +209,39 @@ def run_serve(args) -> int:
             line = line.strip()
             if not line:
                 continue
-            toks = [t.strip() for t in line.split(",")]
-            if all(t.isdigit() for t in toks):
+            if args.prompts_ids:
+                toks = [t.strip() for t in line.split(",")]
+                if not all(t.isdigit() for t in toks):
+                    sys.exit(f"error: --prompts-ids line is not a "
+                             f"comma-separated id list: {line!r}")
                 prompts.append([int(t) for t in toks])
             elif tokenizer is None:
                 sys.exit("error: text prompts require a tokenizer.json; "
-                         "use comma-separated token ids per line")
+                         "pass --prompts-ids with comma-separated token ids "
+                         "per line")
             else:
                 prompts.append(line)
     if not prompts:
         sys.exit(f"error: no prompts in {args.prompts_file}")
 
     t0 = time.perf_counter()
-    params = load_llama_params(args.model, config.num_hidden_layers,
-                               dtype=config.dtype, quantize=args.quantize)
-    gen = BatchGenerator(config, params, tokenizer=tokenizer,
+    from cake_tpu.parallel.mesh import MeshPlan
+    from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
+
+    try:
+        plan = MeshPlan.build(config, num_stages=args.stages, tp=args.tp,
+                              dp=args.dp, sp=1)
+    except ValueError as e:
+        sys.exit(f"error: {e}")
+    # direct-to-mesh load: each shard's bytes only, no full-model host copy
+    # (the reference worker loads only its own blocks, worker.rs:85-98)
+    params = load_llama_params_on_mesh(
+        args.model, config, plan.mesh, quantize=args.quantize,
+        tie_word_embeddings=config.tie_word_embeddings)
+    gen = BatchGenerator(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=args.max_seq,
-                         num_stages=args.stages, tp=args.tp, dp=args.dp,
-                         block_size=args.decode_block)
+                         block_size=args.decode_block,
+                         kv_quant=args.kv_quant)
     gen.set_prompts(prompts)
     log.info("model loaded in %.1fs (%s); serving %d streams",
              time.perf_counter() - t0, memory_report(), len(prompts))
@@ -286,31 +320,42 @@ def run_master(args) -> int:
     if use_mesh:
         from cake_tpu.runtime.mesh_generator import MeshGenerator
 
-        plan = None
-        if topo_mesh:
-            from cake_tpu.parallel.mesh import MeshPlan
+        from cake_tpu.parallel.mesh import MeshPlan
+        from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
 
-            try:
+        try:
+            if topo_mesh:
                 plan = MeshPlan.from_topology(config, topology, tp=args.tp,
                                               sp=args.sp)
-            except ValueError as e:
-                sys.exit(f"error: {e}")
-            log.info("mesh plan from topology: %d stages x tp=%d x sp=%d",
-                     plan.num_stages, plan.tp, plan.sp)
-        params = load_llama_params(args.model, config.num_hidden_layers,
-                                   dtype=config.dtype, quantize=args.quantize)
+                log.info("mesh plan from topology: %d stages x tp=%d x sp=%d",
+                         plan.num_stages, plan.tp, plan.sp)
+            else:
+                plan = MeshPlan.build(config, num_stages=args.stages,
+                                      tp=args.tp, dp=1, sp=args.sp)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        # direct-to-mesh load: each shard's bytes only, no full-model host
+        # copy (the reference worker loads only its own blocks,
+        # worker.rs:85-98); on a multi-host pod each host reads only its
+        # stages' layer ranges
+        params = load_llama_params_on_mesh(
+            args.model, config, plan.mesh, quantize=args.quantize,
+            tie_word_embeddings=config.tie_word_embeddings)
         try:
             gen = MeshGenerator(config, params, plan=plan,
                                 tokenizer=tokenizer, settings=settings,
-                                max_seq=args.max_seq, num_stages=args.stages,
-                                tp=args.tp, sp=args.sp,
+                                max_seq=args.max_seq,
                                 block_size=args.decode_block,
-                                prefill_chunks=args.prefill_chunks)
+                                prefill_chunks=args.prefill_chunks,
+                                kv_quant=args.kv_quant)
         except ValueError as e:
             sys.exit(f"error: {e}")
     elif args.topology:
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 
+        if args.kv_quant:
+            sys.exit("error: --kv-quant applies to the local and mesh "
+                     "paths; cross-host workers manage their own caches")
         head = load_llama_params(
             args.model, config.num_hidden_layers, dtype=config.dtype,
             layer_range=(0, 0), quantize=args.quantize,
@@ -333,7 +378,8 @@ def run_master(args) -> int:
                                    dtype=config.dtype, quantize=args.quantize)
         gen = LlamaGenerator(config, params, tokenizer=tokenizer,
                              settings=settings, max_seq=args.max_seq,
-                             block_size=args.decode_block)
+                             block_size=args.decode_block,
+                             kv_quant=args.kv_quant)
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
              memory_report())
 

@@ -22,8 +22,12 @@ TPU-first redesign decisions:
 On TPU, :func:`attend` dispatches to the fused Pallas flash kernels
 (:mod:`cake_tpu.ops.pallas.flash`) — blockwise online softmax, causal mask in
 registers, no HBM score materialization, KV blocks past the frontier never
-fetched. This XLA path remains the fallback and the parity oracle
-(``CAKE_PALLAS=0`` forces it everywhere).
+fetched — at the shapes where the measured sweep says they win: prefill from
+``PREFILL_FLASH_MIN_S`` context up (tools/flash_sweep.py). Below the
+crossover, and for single-token decode, XLA's fused attention is faster and
+``auto`` picks it. The XLA path also remains the parity oracle
+(``CAKE_PALLAS=0`` forces it everywhere; ``CAKE_PALLAS=1`` forces the
+kernels everywhere).
 """
 
 from __future__ import annotations
@@ -49,6 +53,22 @@ def _flash_ok(t: int, s: int, d: int) -> bool:
     return d % 128 == 0 and s % 128 == 0
 
 
+# Measured context-length crossover for ``impl="auto"`` (tools/flash_sweep.py
+# on v5 lite, 8B geometry H=32/KVH=8/D=128 — same treatment quant_matmul's
+# m>=16 gate got):
+#
+# - prefill: flash wins from S >= 2048 (1.5x at T=512/S=2048, 2.2-2.3x at
+#   S=4096, 50x at S=8192 where XLA materializes the f32 score matrix) and
+#   loses below it (0.77x at T=512/S=1024, 0.87x at T=256/S=512).
+# - decode (T=1): XLA wins at every measured shape — 0.99x at S=512 falling
+#   to 0.82x at S=8192, and 0.72-0.90x at serving batches 8/32 — the
+#   [B, H, 1, S] score row is tiny, so XLA's fused masked gemv is already
+#   bandwidth-optimal at the frontier-near-full worst case the sweep
+#   measures. (Flash decode reads only up to the frontier, so it still wins
+#   early in a long window; CAKE_PALLAS=1 forces it for such workloads.)
+PREFILL_FLASH_MIN_S = 2048
+
+
 def attend(
     q: jax.Array,  # [B, n_heads, T, D] (already roped)
     k_all: jax.Array,  # [B, kv_heads, S, D] (full cache buffer)
@@ -68,11 +88,19 @@ def attend(
     if per_row and t > 1 and impl != "xla":
         impl = "xla"  # per-row prefill: XLA only (not a served path)
     if impl == "auto":
-        if pk.kernels_enabled() and (pk.interpret_default() or _flash_ok(t, s, d)):
+        enabled = pk.kernels_enabled()
+        # flash when forced (CAKE_PALLAS=1), or at the shapes where the
+        # measured sweep says it wins: prefill at S >= PREFILL_FLASH_MIN_S.
+        # Decode and short-context prefill run XLA (see the crossover notes
+        # above).
+        want_flash = enabled and (
+            pk.force_kernels() or (t > 1 and s >= PREFILL_FLASH_MIN_S)
+        )
+        if want_flash and (pk.interpret_default() or _flash_ok(t, s, d)):
             impl = "flash"
         else:
             impl = "xla"
-            if pk.kernels_enabled():
+            if want_flash:
                 # Runs at trace time (once per compiled shape), so this is a
                 # one-line notice, not per-step spam: a misaligned config
                 # must not silently lose the kernels.
@@ -185,6 +213,11 @@ def self_attention_block(
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
+        if isinstance(k_cache, kv.QuantizedKV):
+            raise ValueError(
+                "int8 KV cache is not supported with sequence parallelism "
+                "(the ring/sp kernels stream plain KV buffers); use sp=1"
+            )
         if jnp.asarray(pos).ndim:
             raise ValueError(
                 "per-row positions are not supported with sequence "
@@ -230,7 +263,16 @@ def self_attention_block(
         k = apply_rope(k, cos, sin, pos)
         k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, pos,
                                            gate=write_gate)
-        out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
+        # int8 KV: dequantize at trace level. The convert+mul fuses into
+        # the attention dot's operand read ONLY on the XLA path — a Pallas
+        # kernel operand is a materialized buffer, which would write + read
+        # the full bf16 KV to HBM and lose the bandwidth win — so the
+        # quantized cache pins impl="xla" until a quantization-aware flash
+        # kernel exists.
+        quantized = isinstance(k_cache, kv.QuantizedKV)
+        out = attend(q, kv.dequant_kv(k_cache, q.dtype),
+                     kv.dequant_kv(v_cache, q.dtype), pos,
+                     impl="xla" if quantized else "auto")  # [B, H, T, D]
 
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
     out = quant.dense(out, wo)

@@ -26,6 +26,41 @@ import jax.numpy as jnp
 from cake_tpu.models.config import LlamaConfig
 
 
+@partial(jax.tree_util.register_dataclass, data_fields=["q", "scale"],
+         meta_fields=[])
+@dataclasses.dataclass
+class QuantizedKV:
+    """Int8 KV buffer half: ``q [..., KH, S, D] int8`` + per-token-per-head
+    f32 ``scale [..., KH, S]`` (symmetric absmax over the head_dim channel,
+    written alongside each token's KV slot). Halves cache HBM — the lever
+    that lets multi-stream serving and long windows coexist on 16 GiB chips
+    (the reference's f16 cache has no quantized tier, cache.rs:106-135)."""
+
+    q: jax.Array
+    scale: jax.Array
+
+
+def _kv_data(x) -> jax.Array:
+    return x.q if isinstance(x, QuantizedKV) else x
+
+
+def dequant_kv(x, dtype) -> jax.Array:
+    """Materialize (trace-level — XLA fuses the convert+mul into the
+    attention dot's operand read) a full-precision view of a KV buffer."""
+    if isinstance(x, QuantizedKV):
+        return (x.q.astype(jnp.float32) * x.scale[..., None]).astype(dtype)
+    return x
+
+
+def quant_kv(x: jax.Array) -> QuantizedKV:
+    """Per-token-per-head symmetric int8 over the head_dim channel."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return QuantizedKV(q=q, scale=scale)
+
+
 @partial(jax.tree_util.register_dataclass, data_fields=["k", "v"], meta_fields=[])
 @dataclasses.dataclass
 class KVCache:
@@ -34,22 +69,26 @@ class KVCache:
     Shapes: ``k, v: [num_layers, batch, num_kv_heads, max_seq, head_dim]``.
     The leading layer axis makes the cache scannable alongside stacked layer
     weights, and shardable along a pipeline-stage mesh axis.
+
+    ``k``/``v`` may each be a plain array or a :class:`QuantizedKV` (int8
+    storage + per-slot scales); every consumer goes through
+    :func:`dequant_kv` / :func:`update_layer`, which handle both.
     """
 
-    k: jax.Array
-    v: jax.Array
+    k: jax.Array | QuantizedKV
+    v: jax.Array | QuantizedKV
 
     @property
     def num_layers(self) -> int:
-        return self.k.shape[0]
+        return _kv_data(self.k).shape[0]
 
     @property
     def batch(self) -> int:
-        return self.k.shape[1]
+        return _kv_data(self.k).shape[1]
 
     @property
     def max_seq(self) -> int:
-        return self.k.shape[3]
+        return _kv_data(self.k).shape[3]
 
     def as_new(self) -> "KVCache":
         """Fresh zeroed cache with identical shapes.
@@ -57,7 +96,7 @@ class KVCache:
         Mirrors the reference's per-connection isolation clone
         (`cache.rs:138-146`): same geometry, reset contents.
         """
-        return KVCache(k=jnp.zeros_like(self.k), v=jnp.zeros_like(self.v))
+        return jax.tree.map(jnp.zeros_like, self)
 
 
 def init_cache(
@@ -66,15 +105,27 @@ def init_cache(
     max_seq: int | None = None,
     dtype=None,
     num_layers: int | None = None,
+    quant: str | None = None,
 ) -> KVCache:
     """Allocate a zeroed cache. ``num_layers`` overrides the config count so a
     pipeline stage / worker can hold buffers for only its own layers
     (the reference worker keeps a cache indexed by *global* block_idx,
-    cache.rs:17,58 — here each stage's cache is dense over its local layers)."""
+    cache.rs:17,58 — here each stage's cache is dense over its local layers).
+
+    ``quant="int8"`` allocates int8 storage + per-slot f32 scales
+    (:class:`QuantizedKV`): ~half the cache HBM, quantize-on-write."""
+    if quant not in (None, "int8"):
+        raise ValueError(f"unsupported kv quant={quant!r}")
     L = config.num_hidden_layers if num_layers is None else num_layers
     S = max_seq or config.max_seq_len
     dt = dtype or config.jax_dtype
     shape = (L, batch, config.num_key_value_heads, S, config.head_dim)
+    if quant == "int8":
+        def half():
+            return QuantizedKV(q=jnp.zeros(shape, jnp.int8),
+                               scale=jnp.zeros(shape[:-1], jnp.float32))
+
+        return KVCache(k=half(), v=half())
     return KVCache(k=jnp.zeros(shape, dt), v=jnp.zeros(shape, dt))
 
 
@@ -107,22 +158,34 @@ def update_layer(
     t = k_new.shape[2]
     pos = jnp.asarray(pos, jnp.int32)
 
-    def write(cache, new):
-        new = new.astype(cache.dtype)
+    def write_buf(cache, new, has_d):
+        """``has_d``: buffer carries a trailing head_dim axis (the int8
+        ``q``/plain arrays); scales are the same layout minus that axis."""
         if pos.ndim == 0:
             if gate is not None:
                 cur = jax.lax.dynamic_slice_in_dim(cache, pos, t, axis=2)
                 new = jnp.where(gate, new, cur)
             zero = jnp.zeros((), jnp.int32)
-            return jax.lax.dynamic_update_slice(cache, new, (zero, zero, pos, zero))
+            idx = (zero, zero, pos, zero) if has_d else (zero, zero, pos)
+            return jax.lax.dynamic_update_slice(cache, new, idx)
 
-        def one(c, n, p):  # c [KH, S, D], n [KH, T, D]
+        def one(c, n, p):  # c [KH, S(, D)], n [KH, T(, D)]
             if gate is not None:
                 cur = jax.lax.dynamic_slice_in_dim(c, p, t, axis=1)
                 n = jnp.where(gate, n, cur)
             zero = jnp.zeros((), jnp.int32)
-            return jax.lax.dynamic_update_slice(c, n, (zero, p, zero))
+            idx = (zero, p, zero) if has_d else (zero, p)
+            return jax.lax.dynamic_update_slice(c, n, idx)
 
         return jax.vmap(one)(cache, new, pos)
+
+    def write(cache, new):
+        if isinstance(cache, QuantizedKV):
+            qn = quant_kv(new)  # quantize-on-write
+            return QuantizedKV(
+                q=write_buf(cache.q, qn.q, True),
+                scale=write_buf(cache.scale, qn.scale, False),
+            )
+        return write_buf(cache, new.astype(cache.dtype), True)
 
     return write(k_cache, k_new), write(v_cache, v_new)

@@ -36,6 +36,13 @@ def kernels_enabled() -> bool:
     return on_tpu()
 
 
+def force_kernels() -> bool:
+    """CAKE_PALLAS=1: kernels unconditionally, overriding the measured
+    crossover dispatch (ops.attention, ops.quant) that would otherwise pick
+    XLA at shapes where it wins."""
+    return _mode() in ("1", "true", "force")
+
+
 def interpret_default() -> bool:
     """Pallas kernels run interpreted off-TPU (CPU tests), compiled on TPU."""
     return not on_tpu()

@@ -129,6 +129,18 @@ def param_specs(params: dict | None = None) -> dict:
 CACHE_SPEC = P(STAGE, DP, TP, SP, None)
 
 
+def cache_specs(kv_quant: str | None = None):
+    """PartitionSpec pytree matching :func:`cake_tpu.ops.kvcache.init_cache`'s
+    structure: plain buffers take CACHE_SPEC; int8 buffers take it for the
+    q bytes and the same layout minus head_dim for the per-slot scales."""
+    from cake_tpu.ops.kvcache import KVCache, QuantizedKV
+
+    if kv_quant == "int8":
+        half = QuantizedKV(q=CACHE_SPEC, scale=P(STAGE, DP, TP, SP))
+        return KVCache(k=half, v=half)
+    return KVCache(k=CACHE_SPEC, v=CACHE_SPEC)
+
+
 def shard_params(params: dict, mesh: Mesh) -> dict:
     """Place a (host or single-device) params pytree onto the mesh."""
     specs = param_specs(params)
@@ -138,11 +150,11 @@ def shard_params(params: dict, mesh: Mesh) -> dict:
 
 
 def shard_cache(cache, mesh: Mesh):
-    from cake_tpu.ops.kvcache import KVCache
+    from cake_tpu.ops.kvcache import QuantizedKV
 
-    return KVCache(
-        k=jax.device_put(cache.k, NamedSharding(mesh, CACHE_SPEC)),
-        v=jax.device_put(cache.v, NamedSharding(mesh, CACHE_SPEC)),
+    specs = cache_specs("int8" if isinstance(cache.k, QuantizedKV) else None)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs
     )
 
 

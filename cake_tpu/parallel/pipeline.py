@@ -45,7 +45,15 @@ from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops.sampling import SamplerSettings
-from cake_tpu.parallel.mesh import CACHE_SPEC, DP, SP, STAGE, TP, MeshPlan, param_specs
+from cake_tpu.parallel.mesh import (
+    DP,
+    SP,
+    STAGE,
+    TP,
+    MeshPlan,
+    cache_specs,
+    param_specs,
+)
 
 
 def _local_counts(config: LlamaConfig, tp: int) -> tuple[int, int]:
@@ -213,6 +221,7 @@ def _dp_fold(key: jax.Array, dp: int) -> jax.Array:
 def build_sharded_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
     params_like: dict | None = None, steps: int = 1, per_row: bool = False,
+    kv_quant: str | None = None,
 ):
     """Compile the fused multi-chip decode step.
 
@@ -276,7 +285,7 @@ def build_sharded_decode(
     in_specs = [
         param_specs(params_like),
         P(DP),
-        KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+        cache_specs(kv_quant),
         P(DP) if per_row else P(),
         P(DP, None) if per_row else P(None),
         P(DP, None),
@@ -310,7 +319,7 @@ def build_sharded_decode(
         in_specs=tuple(in_specs),
         out_specs=(
             P(DP) if steps == 1 else P(None, DP),
-            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            cache_specs(kv_quant),
             P(DP, None),
             P(DP) if per_row else P(),
         ),
@@ -321,7 +330,8 @@ def build_sharded_decode(
 
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
                           params_like: dict | None = None,
-                          microbatch: int = 1):
+                          microbatch: int = 1,
+                          kv_quant: str | None = None):
     """Compile the multi-chip prompt pass.
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
@@ -396,12 +406,12 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
         in_specs=(
             param_specs(params_like),
             P(DP, SP),
-            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            cache_specs(kv_quant),
             P(DP),
         ),
         out_specs=(
             P(DP, None),
-            KVCache(k=CACHE_SPEC, v=CACHE_SPEC),
+            cache_specs(kv_quant),
         ),
         check_vma=False,
     )

@@ -18,6 +18,7 @@ worker — behind one interface, so the generation loop is placement-blind
 
 from __future__ import annotations
 
+import logging
 import time
 from abc import ABC, abstractmethod
 from functools import partial
@@ -29,6 +30,8 @@ import numpy as np
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops.kvcache import KVCache, init_cache
+
+log = logging.getLogger("cake_tpu.runner")
 
 
 class BlockRunner(ABC):
@@ -126,6 +129,16 @@ class RemoteRunner(BlockRunner):
             raise RuntimeError(f"handshake failed: got message type {t}")
         self.info = self._protocol.WorkerInfo.from_bytes(payload)
         self.info.latency_ms = (time.perf_counter() - t0) * 1000
+        # Version skew between master and worker is legal on the wire (both
+        # sides ignore unknown fields) but worth a loud notice: a skewed pair
+        # previously handshook silently.
+        from cake_tpu import __version__ as local_version
+
+        if self.info.version != local_version:
+            log.warning(
+                "version skew: master %s vs worker %s (%s@%s)",
+                local_version, self.info.version, self.info.name, self.addr,
+            )
         missing = [n for n in self.layer_names() if n not in self.info.layers]
         if missing:
             raise RuntimeError(

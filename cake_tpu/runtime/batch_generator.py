@@ -86,6 +86,7 @@ class BatchGenerator:
         dp: int = 1,
         devices=None,
         block_size: int = 1,
+        kv_quant: str | None = None,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -101,16 +102,23 @@ class BatchGenerator:
         self.max_seq = max_seq or config.max_seq_len
         self.tokenizer = tokenizer
         self.block_size = max(1, block_size)
+        # int8 KV roughly doubles servable batch x window on a fixed HBM
+        # budget (quantize-on-write per slot, kvcache.QuantizedKV) — the
+        # serving-side long-context lever
+        self.kv_quant = kv_quant
         self.params = shard_params(params, plan.mesh)
         self._prefill = build_sharded_prefill(config, plan,
-                                              params_like=self.params)
+                                              params_like=self.params,
+                                              kv_quant=kv_quant)
         self._decode_single = build_sharded_decode(
-            config, self.settings, plan, params_like=self.params, per_row=True
+            config, self.settings, plan, params_like=self.params,
+            per_row=True, kv_quant=kv_quant,
         )
         self._decode_block = (
             build_sharded_decode(config, self.settings, plan,
                                  params_like=self.params,
-                                 steps=self.block_size, per_row=True)
+                                 steps=self.block_size, per_row=True,
+                                 kv_quant=kv_quant)
             if self.block_size > 1 else None
         )
         self._base_key = jax.random.PRNGKey(self.settings.seed)
@@ -210,7 +218,8 @@ class BatchGenerator:
         self._hist_slot = jnp.asarray(slots)
 
         self.cache = shard_cache(
-            init_cache(self.config, batch=b, max_seq=self.max_seq),
+            init_cache(self.config, batch=b, max_seq=self.max_seq,
+                       quant=self.kv_quant),
             self.plan.mesh,
         )
         logits, self.cache = self._prefill(
@@ -273,16 +282,16 @@ class BatchGenerator:
         tokens = np.zeros((dp, t_pad), np.int32)
         tokens[:, : len(ids)] = ids
         row_cache = shard_cache(
-            init_cache(self.config, batch=dp, max_seq=self.max_seq),
+            init_cache(self.config, batch=dp, max_seq=self.max_seq,
+                       quant=self.kv_quant),
             self.plan.mesh,
         )
         logits, row_cache = self._prefill(
             self.params, jnp.asarray(tokens), row_cache,
             jnp.full((dp,), len(ids) - 1, jnp.int32),
         )
-        self.cache = type(self.cache)(
-            k=self.cache.k.at[:, slot].set(row_cache.k[:, 0]),
-            v=self.cache.v.at[:, slot].set(row_cache.v[:, 0]),
+        self.cache = jax.tree.map(
+            lambda c, r: c.at[:, slot].set(r[:, 0]), self.cache, row_cache
         )
 
         key = jax.random.fold_in(self._base_key, stream_id)
@@ -366,10 +375,13 @@ class BatchGenerator:
         ]
         if not live:
             return [None] * len(self.streams)
-        can_block = (
-            self._decode_block is not None
-            and int(max(live)) + self.block_size <= self.max_seq
-        )
+        # Fused-block eligibility is per-row, not batch-global: a stream
+        # that fills its window inside the block only clamp-writes its OWN
+        # cache row past the frontier (per-row dynamic_update_slice), and
+        # _emit marks it done at the window-filling token so the overrun
+        # outputs are discarded — one long stream near its edge must not
+        # force every stream to single-step dispatches.
+        can_block = self._decode_block is not None
         if can_block:
             toks, self.cache, self._history, self._hist_slot = (
                 self._decode_block(

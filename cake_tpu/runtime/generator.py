@@ -287,18 +287,22 @@ class LlamaGenerator(GeneratorBase):
         max_seq: int | None = None,
         cache_dtype=None,
         block_size: int = 1,
+        kv_quant: str | None = None,
     ):
         """``block_size > 1`` fuses that many decode steps into one dispatch
         (lax.scan; sampling stays on-device) and streams the buffered tokens
         one at a time — dispatch latency amortizes ~K-fold, which dominates
         single-token decode on remote-attached chips. The sampling key
         schedule is block-size-invariant (absolute token index), so a given
-        seed yields the same stream at any block size."""
+        seed yields the same stream at any block size.
+
+        ``kv_quant="int8"`` stores the KV cache as int8 + per-slot scales
+        (half the cache HBM; quantize-on-write, kvcache.QuantizedKV)."""
         super().__init__(config, tokenizer, settings, max_seq)
         self.params = params
         self.block_size = max(1, block_size)
         self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
-                                dtype=cache_dtype)
+                                dtype=cache_dtype, quant=kv_quant)
         self._prefill = jax.jit(
             partial(prefill_fn, config=config),
             donate_argnames=("cache",),

@@ -49,6 +49,7 @@ class MeshGenerator(GeneratorBase):
         devices=None,
         block_size: int = 1,
         prefill_chunks: int = 1,
+        kv_quant: str | None = None,
     ):
         """``block_size > 1`` runs K pipeline+sample steps inside the one
         compiled mesh program per dispatch (build_sharded_decode steps=K) and
@@ -89,21 +90,28 @@ class MeshGenerator(GeneratorBase):
                 f"max_seq {self.max_seq} not divisible by prefill_chunks "
                 f"{self.prefill_chunks}"
             )
+        if kv_quant is not None and plan.sp != 1:
+            raise ValueError("int8 KV cache requires sp == 1 (the ring/sp "
+                             "kernels stream plain KV buffers)")
+        self.kv_quant = kv_quant
         self.params = shard_params(params, plan.mesh)
         self.cache = shard_cache(
-            init_cache(config, batch=1, max_seq=self.max_seq), plan.mesh
+            init_cache(config, batch=1, max_seq=self.max_seq,
+                       quant=kv_quant),
+            plan.mesh,
         )
         self._prefill = build_sharded_prefill(
             config, plan, params_like=self.params,
-            microbatch=self.prefill_chunks,
+            microbatch=self.prefill_chunks, kv_quant=kv_quant,
         )
         self._decode_single = build_sharded_decode(
-            config, self.settings, plan, params_like=self.params
+            config, self.settings, plan, params_like=self.params,
+            kv_quant=kv_quant,
         )
         self._decode_block = (
             build_sharded_decode(config, self.settings, plan,
                                  params_like=self.params,
-                                 steps=self.block_size)
+                                 steps=self.block_size, kv_quant=kv_quant)
             if self.block_size > 1 else None
         )
 

@@ -101,6 +101,9 @@ class WorkerInfo:
     os: str = dataclasses.field(default_factory=platform.system)
     arch: str = dataclasses.field(default_factory=platform.machine)
     device: str = ""
+    # ordinal of the serving device within the worker process (the reference
+    # carries the CUDA ordinal as `device_idx`, proto/message.rs:37-53)
+    device_idx: int = 0
     dtype: str = ""
     latency_ms: float = 0.0
     layers: list[str] = dataclasses.field(default_factory=list)
@@ -120,8 +123,8 @@ class WorkerInfo:
 
     def __str__(self) -> str:
         return (
-            f"{self.name}@{self.device or '?'} v{self.version} "
-            f"({self.os}/{self.arch}, {self.dtype}, "
+            f"{self.name}@{self.device or '?'}:{self.device_idx} "
+            f"v{self.version} ({self.os}/{self.arch}, {self.dtype}, "
             f"latency {self.latency_ms:.1f}ms, {len(self.layers)} layers)"
         )
 

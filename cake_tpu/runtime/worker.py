@@ -133,6 +133,7 @@ class Worker:
         return WorkerInfo(
             name=self.name,
             device=getattr(dev, "device_kind", str(dev)),
+            device_idx=getattr(dev, "id", 0),
             dtype=self.config.dtype,
             max_seq=self.max_seq,
             layers=[

@@ -1,0 +1,122 @@
+"""Flash-attention crossover sweep: pallas vs XLA across context lengths.
+
+Measures the compiled flash kernels against the reference-math XLA oracle
+(`cake-core/src/model/attention.rs:62-77` f32-scores convention) over a grid
+of (T, S) shapes at Llama-3-8B attention geometry, to pick the context-length
+crossover used by :func:`cake_tpu.ops.attention.attend`'s ``impl="auto"``
+dispatch — the same measured-crossover treatment ``quant_matmul`` got for its
+M>=16 gate (`ops/quant.py`).
+
+Usage:  python -m cake_tpu.tools.flash_sweep [--json-out PATH]
+
+Prints one JSON line per shape:
+  {"path": "prefill"|"decode", "t", "s", "pallas_ms", "xla_ms", "speedup"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.tools.kernel_check import _time_ms
+
+
+def _audit(rec: dict) -> dict:
+    """Annotate a sweep record with what ``impl='auto'`` dispatches at this
+    shape and the resulting speedup over always-XLA (>= 1.0 everywhere is
+    the dispatch-policy contract)."""
+    from cake_tpu.ops.attention import PREFILL_FLASH_MIN_S
+
+    auto = ("flash" if rec["path"] == "prefill"
+            and rec["s"] >= PREFILL_FLASH_MIN_S else "xla")
+    rec["auto_impl"] = auto
+    rec["auto_speedup"] = rec["speedup"] if auto == "flash" else 1.0
+    return rec
+
+
+def sweep(json_out: str | None = None) -> list:
+    from cake_tpu.ops.attention import _attend_xla
+    from cake_tpu.ops.pallas import flash_attention, flash_decode, interpret_default
+
+    compiled = not interpret_default()
+    dev = jax.devices()[0]
+    sys.stderr.write(f"device={dev.device_kind} compiled={compiled}\n")
+    b, h, kvh, d = 1, 32, 8, 128
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    results = []
+
+    f_pal = jax.jit(partial(flash_attention, interpret=not compiled))
+    fd_pal = jax.jit(partial(flash_decode, interpret=not compiled))
+    f_xla = jax.jit(_attend_xla)
+
+    # Decode: T=1 against a KV buffer of S, frontier near the end (worst case)
+    for s in (512, 1024, 2048, 4096, 8192):
+        kv_k = jax.random.normal(ks[0], (b, kvh, s, d), jnp.bfloat16)
+        kv_v = jax.random.normal(ks[1], (b, kvh, s, d), jnp.bfloat16)
+        q = jax.random.normal(ks[2], (b, h, 1, d), jnp.bfloat16)
+        pos = jnp.int32(s - 24)
+        p_ms = _time_ms(fd_pal, q, kv_k, kv_v, pos)
+        x_ms = _time_ms(f_xla, q, kv_k, kv_v, pos)
+        rec = _audit({"path": "decode", "t": 1, "s": s,
+                      "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+                      "speedup": round(x_ms / p_ms, 3)})
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # Batched (serving) decode: per-row frontiers, the BatchGenerator shape
+    for bb, s in ((8, 1024), (8, 4096), (32, 1024), (32, 4096)):
+        kv_k = jax.random.normal(ks[0], (bb, kvh, s, d), jnp.bfloat16)
+        kv_v = jax.random.normal(ks[1], (bb, kvh, s, d), jnp.bfloat16)
+        q = jax.random.normal(ks[2], (bb, h, 1, d), jnp.bfloat16)
+        pos = jnp.clip(
+            jnp.arange(1, bb + 1, dtype=jnp.int32) * (s // (bb + 1)),
+            16, s - 2,
+        )
+        p_ms = _time_ms(fd_pal, q, kv_k, kv_v, pos)
+        x_ms = _time_ms(f_xla, q, kv_k, kv_v, pos)
+        rec = _audit({"path": "decode", "t": 1, "s": s, "batch": bb,
+                      "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+                      "speedup": round(x_ms / p_ms, 3)})
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    # Prefill: chunk of T tokens against a window of S (T <= S); both the
+    # full-prompt case (T = S/2, frontier mid-buffer) and the chunked case
+    # (small T against a large populated window) appear in real runs.
+    for t, s in ((256, 512), (512, 1024), (512, 2048), (1024, 2048),
+                 (512, 4096), (2048, 4096), (2048, 8192), (512, 8192)):
+        kv_k = jax.random.normal(ks[0], (b, kvh, s, d), jnp.bfloat16)
+        kv_v = jax.random.normal(ks[1], (b, kvh, s, d), jnp.bfloat16)
+        q = jax.random.normal(ks[2], (b, h, t, d), jnp.bfloat16)
+        pos = jnp.int32(s - t - 8)  # frontier near the end: max valid keys
+        inner = max(2, min(32, (2048 * 4096) // (t * s) * 4))
+        p_ms = _time_ms(f_pal, q, kv_k, kv_v, pos, inner=inner)
+        x_ms = _time_ms(f_xla, q, kv_k, kv_v, pos, inner=inner)
+        rec = _audit({"path": "prefill", "t": t, "s": s,
+                      "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+                      "speedup": round(x_ms / p_ms, 3)})
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(results, f, indent=1)
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json-out", default=None)
+    args = ap.parse_args()
+    sweep(args.json_out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -94,20 +94,46 @@ def _hub_populated(dest: Path, want: str) -> bool:
     may legitimately lack tokenizer.json; a download may have died between
     shards), so a successful snapshot writes a stamp recording what it
     fetched; stamp match + config + every index-named shard => skip the
-    network. A pre-stamp-era checkout (no stamp, but config + tokenizer +
-    weights all present, unpinned fetch) is accepted and stamped on first
-    verification so warm offline runs keep working across the upgrade."""
+    network."""
     stamp = dest / _STAMP
-    if stamp.exists():
-        return stamp.read_text().strip() == want and _files_complete(dest)
-    if (
-        "@" not in want
-        and (dest / "tokenizer.json").exists()
-        and _files_complete(dest)
-    ):
-        stamp.write_text(want)
-        return True
-    return False
+    return (stamp.exists() and stamp.read_text().strip() == want
+            and _files_complete(dest))
+
+
+# config.json fields that identify a model architecture/size — the cheap
+# identity fingerprint compared between a local checkout and the hub repo
+_IDENTITY_KEYS = (
+    "architectures", "hidden_size", "num_hidden_layers",
+    "num_attention_heads", "num_key_value_heads", "vocab_size",
+    "intermediate_size",
+)
+
+
+def _legacy_identity_ok(repo: str, revision: str | None,
+                        dest: Path) -> bool | None:
+    """Best-effort identity check of an UNSTAMPED complete checkout against
+    the hub repo's config.json (one small file, not the weights). Returns
+    True (fingerprint matches), False (different model — the dir must not be
+    served/stamped as ``repo``), or None (hub unreachable: cannot judge)."""
+    import json
+    import tempfile
+
+    try:
+        from huggingface_hub import hf_hub_download
+
+        with tempfile.TemporaryDirectory() as td:
+            p = hf_hub_download(repo_id=repo, revision=revision,
+                                filename="config.json", local_dir=td)
+            hub_cfg = json.loads(Path(p).read_text())
+        local_cfg = json.loads((dest / "config.json").read_text())
+    except Exception as e:
+        log.warning(
+            "fetch: cannot verify unstamped checkout %s against %s (%s)",
+            dest, repo, e,
+        )
+        return None
+    return ({k: hub_cfg.get(k) for k in _IDENTITY_KEYS}
+            == {k: local_cfg.get(k) for k in _IDENTITY_KEYS})
 
 
 def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
@@ -133,6 +159,35 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
     if not force and immutable and _hub_populated(dest, want):
         log.info("fetch: %s already populated (%s), skipping hub", dest, want)
         return dest
+    # Pre-stamp-era checkout (no stamp, but config + tokenizer + weights all
+    # present): verify it actually IS ``repo`` before stamping — an unstamped
+    # complete checkout of a *different* model must not be silently served
+    # and permanently mislabeled as the requested repo. The check costs one
+    # small config.json download; if the hub is unreachable the checkout is
+    # used for this run but left unstamped so the next online run verifies.
+    # UNPINNED fetches only: the architecture fingerprint cannot tell
+    # revisions of the same repo apart, so a commit-hash pin always goes to
+    # the hub for the true pinned files.
+    if (
+        not force and revision is None and not (dest / _STAMP).exists()
+        and (dest / "tokenizer.json").exists() and _files_complete(dest)
+    ):
+        verdict = _legacy_identity_ok(repo, revision, dest)
+        if verdict is None:
+            log.warning(
+                "fetch: using unstamped checkout %s unverified (hub "
+                "unreachable); not stamping", dest,
+            )
+            return dest
+        if verdict:
+            (dest / _STAMP).write_text(want)
+            log.info("fetch: %s verified as %s, stamped", dest, want)
+            return dest
+        raise RuntimeError(
+            f"{dest} holds a complete checkpoint whose config.json does not "
+            f"match {repo}; refusing to serve it as {want} (use --refetch "
+            f"to overwrite it with the requested model)"
+        )
     # About to mutate dest: a download dying halfway must not leave a
     # valid-looking stamp certifying a mixed checkout.
     (dest / _STAMP).unlink(missing_ok=True)

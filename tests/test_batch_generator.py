@@ -128,6 +128,43 @@ def test_short_stream_survives_long_stream_window_exhaustion(params):
         assert solo.generate(20)[0] == outs[1]
 
 
+def test_window_edge_stream_keeps_batch_on_block_dispatch(params):
+    """Fused-block eligibility is per-row: one stream 2 tokens from its
+    window must NOT force the whole batch into single-step dispatches (r2
+    VERDICT weak #7). Dispatch count stays ~N/block_size, the edge stream
+    fills its window with exactly its solo tokens, and mid-window streams
+    are bit-identical to their solo runs."""
+    settings = SamplerSettings(**GREEDY)
+    cfg = tiny(max_seq_len=32)
+    block = 4
+    edge_prompt = list(range(2, 28))  # 26 tokens -> 6 slots left (< 2 blocks)
+    mids = [[5, 9, 2], [3, 1, 4], [7, 7, 2], [2, 8, 1]]
+    g = BG(cfg, params, settings=settings, dp=1, block_size=block)
+    g.set_prompts([edge_prompt] + mids)
+    calls = {"block": 0, "single": 0}
+    real_block, real_single = g._decode_block, g._decode_single
+
+    def count_block(*a, **k):
+        calls["block"] += 1
+        return real_block(*a, **k)
+
+    def count_single(*a, **k):
+        calls["single"] += 1
+        return real_single(*a, **k)
+
+    g._decode_block, g._decode_single = count_block, count_single
+    n = 20
+    outs = g.generate(n)
+    assert calls["single"] == 0
+    assert calls["block"] == -(-(n - 1) // block)  # first token from prefill
+    assert len(outs[0]) == 32 - len(edge_prompt)  # edge filled its window
+    solo_edge = _single_stream(params, edge_prompt, n, settings)
+    # solo run raises window exhaustion at the same boundary; compare prefix
+    assert outs[0] == solo_edge[: len(outs[0])]
+    for prompt, got in zip(mids, outs[1:]):
+        assert got == _single_stream(params, prompt, n, settings)
+
+
 @pytest.mark.parametrize("block_size", [1, 4])
 def test_admit_refills_finished_slot(params, block_size):
     """Continuous-batching-lite: when a stream finishes, admit() splices a

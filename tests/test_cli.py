@@ -178,7 +178,8 @@ def test_prompts_file_serves_batch(model_dir, tmp_path):
     ).strip()
     r = subprocess.run(
         [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
-         "--prompts-file", str(pf), "-n", "4", "--temperature", "0",
+         "--prompts-file", str(pf), "--prompts-ids", "-n", "4",
+         "--temperature", "0",
          "--max-seq", "32", "--cpu", "--dp", "2", "--stages", "2", "-v"],
         capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
     )
@@ -186,6 +187,31 @@ def test_prompts_file_serves_batch(model_dir, tmp_path):
     lines = [l for l in r.stdout.splitlines() if l.startswith("[")]
     assert len(lines) == 3 and lines[0].startswith("[0] ")
     assert "3 streams" in r.stderr and "aggregate" in r.stderr
+
+
+def test_prompts_file_numeric_text_needs_explicit_mode(model_dir, tmp_path):
+    """A numeric-looking line is NEVER silently id-parsed: without
+    --prompts-ids it is a text prompt (and errors without a tokenizer);
+    serving also rejects flags it would silently ignore (--sp,
+    --prefill-chunks)."""
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("1, 2, 3\n")
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "-n", "2", "--cpu"])
+    assert r.returncode != 0
+    assert "tokenizer" in r.stderr
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "--prompts-ids", "-n", "2", "--cpu", "--sp", "2"])
+    assert r.returncode != 0 and "--sp" in r.stderr
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "--prompts-ids", "-n", "2", "--cpu",
+                  "--prefill-chunks", "2"])
+    assert r.returncode != 0 and "--prefill-chunks" in r.stderr
+    pf.write_text("hello world\n")
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "--prompts-ids", "-n", "2", "--cpu"])
+    assert r.returncode != 0
+    assert "not a comma-separated id list" in r.stderr
 
 
 def test_profile_flag_writes_trace(model_dir, tmp_path):

@@ -144,6 +144,25 @@ def test_generator_reuse_reconnects(params):
     w.shutdown()
 
 
+def test_handshake_warns_on_version_skew(params, monkeypatch, caplog):
+    """A skewed master/worker pair must not handshake silently
+    (proto/message.rs:37-53 carries version for exactly this)."""
+    import logging
+
+    import cake_tpu
+    from cake_tpu.parallel.runner import RemoteRunner
+
+    w = _start_worker("w", Topology.from_dict(
+        {"w": {"layers": ["model.layers.0-3"]}}), params)
+    monkeypatch.setattr(cake_tpu, "__version__", "999.0.0")
+    with caplog.at_level(logging.WARNING, logger="cake_tpu.runner"):
+        r = RemoteRunner(f"127.0.0.1:{w.port}", start=0, stop=4)
+    assert any("version skew" in rec.message for rec in caplog.records)
+    assert r.info.device_idx >= 0
+    r.close()
+    w.shutdown()
+
+
 def test_worker_rejects_unserved_layer(params):
     from cake_tpu.parallel.runner import RemoteRunner
 

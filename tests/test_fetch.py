@@ -146,23 +146,73 @@ def test_hub_fetch_movable_revision_always_reconsults(tmp_path, monkeypatch):
     assert calls["n"] == 3
 
 
-def test_hub_fetch_legacy_unstamped_checkout_accepted(tmp_path, monkeypatch):
-    """A complete pre-stamp-era checkout (config + tokenizer + weights, no
-    stamp) skips the hub and gets stamped on first verification."""
+def _legacy_dest(tmp_path, cfg="{}"):
     dest = tmp_path / "model"
     dest.mkdir()
-    (dest / "config.json").write_text("{}")
+    (dest / "config.json").write_text(cfg)
     (dest / "tokenizer.json").write_text("{}")
     (dest / "model.safetensors").write_bytes(b"\x00")
+    return dest
+
+
+def test_hub_fetch_legacy_unstamped_checkout_verified_then_stamped(
+        tmp_path, monkeypatch):
+    """A complete pre-stamp-era checkout (config + tokenizer + weights, no
+    stamp) is identity-checked against the hub's config.json (one small
+    file, not the weights), then stamped so later runs skip the hub."""
+    dest = _legacy_dest(tmp_path, json.dumps({"hidden_size": 64}))
 
     def boom(**kw):  # pragma: no cover - must not be reached
-        raise AssertionError("hub hit for a complete legacy checkout")
+        raise AssertionError("full snapshot hit for a complete checkout")
+
+    def fake_cfg(repo_id, revision, filename, local_dir):
+        p = Path(local_dir, filename)
+        p.write_text(json.dumps({"hidden_size": 64}))
+        return str(p)
 
     import huggingface_hub
 
     monkeypatch.setattr(huggingface_hub, "snapshot_download", boom)
+    monkeypatch.setattr(huggingface_hub, "hf_hub_download", fake_cfg)
     fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
     assert (dest / ".cake_fetched").read_text() == "meta-llama/Meta-Llama-3-8B"
+
+
+def test_hub_fetch_legacy_unstamped_wrong_model_refused(tmp_path, monkeypatch):
+    """An unstamped complete checkout of a DIFFERENT model must not be
+    silently served and mislabeled as the requested repo (it errors and is
+    left unstamped)."""
+    dest = _legacy_dest(tmp_path, json.dumps({"hidden_size": 64}))
+
+    def fake_cfg(repo_id, revision, filename, local_dir):
+        p = Path(local_dir, filename)
+        p.write_text(json.dumps({"hidden_size": 8192}))
+        return str(p)
+
+    import huggingface_hub
+
+    monkeypatch.setattr(huggingface_hub, "hf_hub_download", fake_cfg)
+    with pytest.raises(RuntimeError, match="does not match"):
+        fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert not (dest / ".cake_fetched").exists()
+
+
+def test_hub_fetch_legacy_unstamped_offline_used_but_not_stamped(
+        tmp_path, monkeypatch):
+    """Hub unreachable: the unstamped checkout still serves this run (warm
+    offline runs keep working) but is NOT stamped — the next online run
+    verifies identity before labeling the dir."""
+    dest = _legacy_dest(tmp_path)
+
+    import huggingface_hub
+
+    monkeypatch.setattr(
+        huggingface_hub, "hf_hub_download",
+        lambda **kw: (_ for _ in ()).throw(ConnectionError("offline")),
+    )
+    out = fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert out == dest
+    assert not (dest / ".cake_fetched").exists()
 
 
 def test_hub_fetch_interrupted_refetch_invalidates_stamp(tmp_path, monkeypatch):

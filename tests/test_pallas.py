@@ -157,5 +157,47 @@ def test_dispatch_policy(monkeypatch):
     assert not pk.kernels_enabled()
     monkeypatch.setenv("CAKE_PALLAS", "1")
     assert pk.kernels_enabled()
+    assert pk.force_kernels()
     monkeypatch.setenv("CAKE_PALLAS", "auto")
+    assert not pk.force_kernels()
     assert pk.kernels_enabled() == (jax.default_backend() == "tpu")
+
+
+def test_auto_dispatch_measured_crossover(monkeypatch):
+    """impl='auto' follows the measured crossover (tools/flash_sweep.py on
+    v5e): prefill routes to flash only from S >= PREFILL_FLASH_MIN_S; decode
+    and short-context prefill run XLA, where the sweep says XLA wins.
+    CAKE_PALLAS=1 still forces the kernels everywhere."""
+    import cake_tpu.ops.attention as attn
+    from cake_tpu.ops import pallas as pk
+    from cake_tpu.ops.attention import PREFILL_FLASH_MIN_S, attend
+
+    monkeypatch.setattr(pk, "kernels_enabled", lambda: True)
+    monkeypatch.setattr(pk, "force_kernels", lambda: False)
+    monkeypatch.setattr(pk, "interpret_default", lambda: True)
+    calls = []
+    monkeypatch.setattr(
+        attn.pk, "flash_attention",
+        lambda q, k, v, pos, **kw: (calls.append("prefill"), q)[1])
+    monkeypatch.setattr(
+        attn.pk, "flash_decode",
+        lambda q, k, v, pos, **kw: (calls.append("decode"), q)[1])
+
+    b, h, kvh, d = 1, 2, 1, 8
+    key = jax.random.PRNGKey(0)
+
+    def run(t, s):
+        q = jax.random.normal(key, (b, h, t, d), jnp.bfloat16)
+        k = jax.random.normal(key, (b, kvh, s, d), jnp.bfloat16)
+        v = jax.random.normal(key, (b, kvh, s, d), jnp.bfloat16)
+        attend(q, k, v, jnp.int32(s - t - 1))
+
+    run(4, PREFILL_FLASH_MIN_S)  # long-context prefill -> flash
+    assert calls == ["prefill"]
+    calls.clear()
+    run(4, PREFILL_FLASH_MIN_S // 2)  # short prefill -> XLA
+    run(1, 4096)  # decode -> XLA at any S
+    assert calls == []
+    monkeypatch.setattr(pk, "force_kernels", lambda: True)
+    run(1, 512)  # forced -> flash decode regardless of the crossover
+    assert calls == ["decode"]

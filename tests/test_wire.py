@@ -111,12 +111,28 @@ def test_tensor_codec_rejects_truncated():
 
 
 def test_worker_info_roundtrip():
-    wi = WorkerInfo(name="w0", device="TPU v5e", dtype="bfloat16",
+    wi = WorkerInfo(name="w0", device="TPU v5e", device_idx=3,
+                    dtype="bfloat16",
                     layers=["model.layers.0", "model.layers.1"])
     got = WorkerInfo.from_bytes(wi.to_bytes())
     assert got.name == "w0"
     assert got.layers == wi.layers
+    assert got.device_idx == 3
     assert "w0" in str(got)
+
+
+def test_worker_info_carries_identity_fields():
+    """Reference parity (proto/message.rs:37-53): version/os/arch/device
+    ordinal travel in the handshake so a skewed pair is detectable."""
+    import platform
+
+    from cake_tpu import __version__
+
+    got = WorkerInfo.from_bytes(WorkerInfo(name="w0").to_bytes())
+    assert got.version == __version__
+    assert got.os == platform.system()
+    assert got.arch == platform.machine()
+    assert got.device_idx == 0
 
 
 def test_ops_codec_roundtrip():
