@@ -235,22 +235,28 @@ def _run_batched(config, params, preset, quant, settings, dev,
     roofline (``vs_baseline > 1``) — the axis the single-request reference
     has no answer to (SURVEY.md §0: no batching of concurrent requests).
     """
-    from cake_tpu.ops.kvcache import init_cache
-    from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+    from cake_tpu.parallel.mesh import (
+        MeshPlan,
+        init_cache_on_mesh,
+        shard_params,
+    )
     from cake_tpu.parallel.pipeline import (
         build_sharded_decode,
         build_sharded_prefill,
     )
 
+    # CAKE_BENCH_KV=int8: serve with the quantized KV cache (half the cache
+    # HBM -> roughly double the servable batch x window on a fixed budget)
+    kv_quant = os.environ.get("CAKE_BENCH_KV") or None
     plan = MeshPlan.build(config, devices=jax.devices()[:1])
     params = shard_params(params, plan.mesh)
-    cache = shard_cache(
-        init_cache(config, batch=batch, max_seq=config.max_seq_len),
-        plan.mesh,
-    )
-    prefill = build_sharded_prefill(config, plan, params_like=params)
+    cache = init_cache_on_mesh(config, plan.mesh, batch=batch,
+                               max_seq=config.max_seq_len, quant=kv_quant)
+    prefill = build_sharded_prefill(config, plan, params_like=params,
+                                    kv_quant=kv_quant)
     decode = build_sharded_decode(config, settings, plan, params_like=params,
-                                  steps=multistep, per_row=True)
+                                  steps=multistep, per_row=True,
+                                  kv_quant=kv_quant)
 
     prompt_len = 8
     tokens = jnp.tile(
@@ -308,6 +314,8 @@ def _run_batched(config, params, preset, quant, settings, dev,
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb  # single-stream weights-bound ideal
     wtag = "int8" if quant == "int8" else "bf16"
+    if kv_quant:
+        wtag += "_kv8"
     print(json.dumps({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_b{batch}",
         "value": round(agg_tok_s, 3),
@@ -388,8 +396,11 @@ def main() -> int:
         idx = ladder.index(rung)
         while idx + 1 < len(ladder):
             p_, q_ = ladder[idx]
-            est = hbm_budget(_config(p_), batch=bench_batch,
-                             quant=q_ or None)["total"]
+            est = hbm_budget(
+                _config(p_), batch=bench_batch, quant=q_ or None,
+                cache_bytes_per_el=1 if os.environ.get("CAKE_BENCH_KV")
+                else 2,
+            )["total"]
             if est <= usable:
                 break
             sys.stderr.write(

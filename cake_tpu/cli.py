@@ -395,6 +395,7 @@ def run_master(args) -> int:
     t_gen0 = time.perf_counter()
     n_tokens = 0
     gen_error = None
+    gen_ids: list[int] = []
     if args.profile:
         import jax.profiler
 
@@ -409,6 +410,7 @@ def run_master(args) -> int:
                 gen_error = e
                 break
             n_tokens += 1
+            gen_ids.append(tok.id)
             if tok.text:
                 print(tok.text, end="", flush=True)
             if i == 0:
@@ -422,6 +424,9 @@ def run_master(args) -> int:
     rest = gen.last()
     if rest:
         print(rest, end="")
+    if tokenizer is None and gen_ids:
+        # id-only runs (no tokenizer.json) still stream SOMETHING observable
+        print(",".join(map(str, gen_ids)), end="")
     print()
     if n_tokens > 1:
         dt = time.perf_counter() - t_warm

@@ -129,16 +129,22 @@ def param_specs(params: dict | None = None) -> dict:
 CACHE_SPEC = P(STAGE, DP, TP, SP, None)
 
 
-def cache_specs(kv_quant: str | None = None):
+def cache_specs(kv_quant: str | None = None, batch_replicated: bool = False):
     """PartitionSpec pytree matching :func:`cake_tpu.ops.kvcache.init_cache`'s
     structure: plain buffers take CACHE_SPEC; int8 buffers take it for the
-    q bytes and the same layout minus head_dim for the per-slot scales."""
+    q bytes and the same layout minus head_dim for the per-slot scales.
+
+    ``batch_replicated``: don't shard the batch axis over dp — the layout of
+    a single-row staging cache (continuous-batching admission) that must
+    exist on every dp shard."""
     from cake_tpu.ops.kvcache import KVCache, QuantizedKV
 
+    bd = None if batch_replicated else DP
+    spec = P(STAGE, bd, TP, SP, None)
     if kv_quant == "int8":
-        half = QuantizedKV(q=CACHE_SPEC, scale=P(STAGE, DP, TP, SP))
+        half = QuantizedKV(q=spec, scale=P(STAGE, bd, TP, SP))
         return KVCache(k=half, v=half)
-    return KVCache(k=CACHE_SPEC, v=CACHE_SPEC)
+    return KVCache(k=spec, v=spec)
 
 
 def shard_params(params: dict, mesh: Mesh) -> dict:
@@ -156,6 +162,46 @@ def shard_cache(cache, mesh: Mesh):
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), cache, specs
     )
+
+
+# compiled cache-zeros programs, keyed by geometry — a fresh jit closure
+# per call would re-trace and recompile on every invocation, stalling e.g.
+# each continuous-batching admission behind a compile
+_CACHE_PROGRAMS: dict = {}
+
+
+def init_cache_on_mesh(config, mesh: Mesh, batch: int = 1,
+                       max_seq: int | None = None, quant: str | None = None,
+                       batch_replicated: bool = False):
+    """Allocate a zeroed, mesh-sharded KV cache WITHOUT a host-side copy.
+
+    ``shard_cache(init_cache(...))`` device_puts host zeros — invalid for
+    shards this process cannot address on a multi-host pod (and a pointless
+    host allocation even on one). Emitting the zeros from a compiled
+    program with explicit output shardings allocates each shard directly on
+    its owner device, on every host of the pod identically. Programs are
+    memoized by (mesh, cache geometry), so repeat allocations — one per
+    serving admission — reuse the compiled executable."""
+    from functools import partial
+
+    from cake_tpu.ops.kvcache import init_cache
+
+    key = (mesh, config.num_hidden_layers, config.num_key_value_heads,
+           config.head_dim, str(config.dtype), batch,
+           max_seq or config.max_seq_len, quant, batch_replicated)
+    make = _CACHE_PROGRAMS.get(key)
+    if make is None:
+        specs = cache_specs(quant, batch_replicated=batch_replicated)
+        out_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                              is_leaf=lambda x: isinstance(x, P))
+
+        @partial(jax.jit, out_shardings=out_sh)
+        def make():
+            return init_cache(config, batch=batch, max_seq=max_seq,
+                              quant=quant)
+
+        _CACHE_PROGRAMS[key] = make
+    return make()
 
 
 @dataclasses.dataclass(frozen=True)

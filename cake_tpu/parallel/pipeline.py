@@ -328,6 +328,63 @@ def build_sharded_decode(
     return jax.jit(sharded, donate_argnums=(2,))
 
 
+def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
+                        params_like: dict | None = None,
+                        kv_quant: str | None = None):
+    """Compile the continuous-batching admission prefill: ONE prompt row
+    (replicated over dp, not dp discarded copies) processed one chunk per
+    dispatch into a standalone staging cache, so a running batch's decode
+    dispatches interleave with a new prompt's prefill instead of stalling
+    behind it.
+
+    Signature: ``(params, tokens [1, C], cache1, pos0, last_local [1]) ->
+    (logits [1, vocab] f32, cache1)`` where ``cache1`` is a batch-1 cache
+    with the batch axis replicated over dp
+    (``mesh.cache_specs(batch_replicated=True)``), ``pos0`` is the chunk's
+    global position offset, and ``last_local`` is the in-chunk index of the
+    prompt's final token (meaningful on the final chunk; ignored
+    otherwise). Chunked prefill is exact: chunk ``j`` attends the staging
+    cache's committed positions ``< pos0`` plus its own causal prefix, the
+    same math as a single full-prompt pass. Requires ``plan.sp == 1``.
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if plan.sp != 1:
+        raise ValueError("admission prefill requires sp == 1 (serving plane)")
+
+    def step(params, tokens, cache, pos0, last_local):
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq, config.rope_theta,
+            scaling=config.rope_scaling,
+        )
+        x = params["embed"][tokens].astype(config.jax_dtype)
+        x, ck, cv = _pipeline_layers(
+            x, params["layers"], cache.k, cache.v, cos, sin, pos0, config,
+            plan.num_stages, heads_l, kv_heads_l,
+        )
+        x_last = _select_last_sp(x, last_local, 1)
+        x_last = _select_stage0(x_last)
+        logits = _head_logits(params, x_last, config)
+        return logits, KVCache(k=ck, v=cv)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(params_like),
+            P(None, None),
+            cache_specs(kv_quant, batch_replicated=True),
+            P(),
+            P(None),
+        ),
+        out_specs=(
+            P(None, None),
+            cache_specs(kv_quant, batch_replicated=True),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
                           params_like: dict | None = None,
                           microbatch: int = 1,

@@ -24,6 +24,14 @@ Per-stream independence is real, not cosmetic:
   the batch keeps running (its rows keep computing into discarded outputs —
   the SPMD analogue of the pipeline's gated inactive stages).
 
+Continuous batching: arrivals ``enqueue`` into a FIFO and are admitted into
+freed slots without stalling the batch — each ``step()`` advances the head
+arrival's prefill by one chunk dispatch (one replicated row into a staging
+cache, ``parallel.pipeline.build_admit_prefill``) alongside the running
+decode dispatch, then splices the finished row into its slot. ``admit()``
+is the synchronous variant. Admission timing never changes a stream's
+output (per-row positions + per-row token indices).
+
 Caveat (int8 weights only): ``ops.quant.quant_matmul`` auto-selects its
 backend by row count (XLA gemv below ~16 rows, the Pallas kernel above —
 the measured perf crossover), so with quantized weights and temperature > 0
@@ -44,10 +52,14 @@ import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops import sampling
-from cake_tpu.ops.kvcache import init_cache
 from cake_tpu.ops.sampling import SamplerSettings
-from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+from cake_tpu.parallel.mesh import (
+    MeshPlan,
+    init_cache_on_mesh,
+    shard_params,
+)
 from cake_tpu.parallel.pipeline import (
+    build_admit_prefill,
     build_sharded_decode,
     build_sharded_prefill,
 )
@@ -87,6 +99,7 @@ class BatchGenerator:
         devices=None,
         block_size: int = 1,
         kv_quant: str | None = None,
+        admit_chunk: int | None = None,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -124,6 +137,27 @@ class BatchGenerator:
         self._base_key = jax.random.PRNGKey(self.settings.seed)
         self.streams: list[_Stream] = []
         self._eos_ids = set(config.eos_ids())
+        # Continuous-batching admission: arrivals queue here (enqueue) and
+        # prefill ONE chunk per step() interleaved with decode dispatches,
+        # as a single replicated row in a staging cache — no dp discarded
+        # copies, no multi-dispatch stall of the running batch.
+        # ``admit_chunk`` sets the per-dispatch chunk length (None: the
+        # whole bucketed prompt in one dispatch).
+        self._admit_chunk = admit_chunk
+        self._arrivals: list[tuple[list[int], int]] = []
+        self._staging: dict | None = None
+        self.__admit_prefill = None
+
+    @property
+    def _admit_prefill(self):
+        """Admission-prefill program, compiled on first use (callers that
+        never admit mid-run pay nothing)."""
+        if self.__admit_prefill is None:
+            self.__admit_prefill = build_admit_prefill(
+                self.config, self.plan, params_like=self.params,
+                kv_quant=self.kv_quant,
+            )
+        return self.__admit_prefill
 
     # -- prompt intake -------------------------------------------------------
     def _encode(self, p) -> list[int]:
@@ -217,10 +251,9 @@ class BatchGenerator:
         self._history = jnp.asarray(hist)
         self._hist_slot = jnp.asarray(slots)
 
-        self.cache = shard_cache(
-            init_cache(self.config, batch=b, max_seq=self.max_seq,
-                       quant=self.kv_quant),
-            self.plan.mesh,
+        self.cache = init_cache_on_mesh(
+            self.config, self.plan.mesh, batch=b, max_seq=self.max_seq,
+            quant=self.kv_quant,
         )
         logits, self.cache = self._prefill(
             self.params, jnp.asarray(tokens), self.cache, jnp.asarray(last)
@@ -245,53 +278,75 @@ class BatchGenerator:
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
 
-    def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
-        """Admit a new prompt into a finished slot of a RUNNING batch
-        (continuous-batching-lite: fixed batch geometry, slot reuse).
-
-        Prefills the new prompt alone (bucketed, prompt-proportional) and
-        splices its KV row, key, history, position, and token index into the
-        slot; the other streams are untouched mid-flight. Per-row token
-        indices in the compiled program mean the admitted stream's sampling
-        schedule starts at 0 regardless of when it joined — its output is
-        identical to the same (seed, stream_id, prompt) in any other batch.
-
-        Returns ``(slot, first Token)`` — the first token is sampled here
-        from the prefill logits and recorded; subsequent ``step()`` calls
-        carry the stream forward. Raises if no stream is done.
-        """
-        if not self.streams:
-            raise RuntimeError("set_prompts first")
-        # Buffered block rows belong to the pre-admission state: record them
-        # before the slot's column changes meaning, and queue the emitted
-        # rows so streaming step() consumers still receive every Token.
-        while self._block_buf:
-            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
-        slot = next(
+    def _free_slot(self) -> int | None:
+        return next(
             (i for i, s in enumerate(self.streams) if not s.active or s.done),
             None,
         )
-        if slot is None:
-            raise RuntimeError("no free slot: every stream is still live")
-        ids = self._encode(prompt)
 
-        # prefill the new prompt alone (dp rows of it when dp > 1 — the
-        # prefill program's batch axis shards over dp; extras are discarded)
-        dp = self.plan.dp
-        t_pad = _bucket(len(ids), self.max_seq)
-        tokens = np.zeros((dp, t_pad), np.int32)
-        tokens[:, : len(ids)] = ids
-        row_cache = shard_cache(
-            init_cache(self.config, batch=dp, max_seq=self.max_seq,
-                       quant=self.kv_quant),
-            self.plan.mesh,
+    def enqueue(self, prompt, stream_id: int) -> None:
+        """Queue a prompt for continuous admission. Each subsequent
+        ``step()`` advances its prefill by ONE chunk dispatch (a single
+        replicated row into a staging cache) alongside the running batch's
+        decode dispatch — arrivals never stall the batch for a full prompt
+        pass. When the prefill completes, the stream's first token is
+        emitted in that step's row and the stream joins the batch. Output
+        is bit-identical to the same (seed, stream_id, prompt) in any other
+        batch or admission timing (per-row positions + per-row token
+        indices)."""
+        self._arrivals.append((self._encode(prompt), stream_id))
+
+    def pending_admissions(self) -> int:
+        """Arrivals not yet fully admitted (queued + in-flight)."""
+        return len(self._arrivals) + (1 if self._staging is not None else 0)
+
+    def _admission_tick(self) -> None:
+        """Advance the in-flight admission by one chunk dispatch (or start
+        the next queued arrival if a slot is free)."""
+        if self._staging is None:
+            if not self._arrivals or self._free_slot() is None:
+                return
+            ids, sid = self._arrivals.pop(0)
+            chunk = self._admit_chunk or _bucket(len(ids), self.max_seq)
+            t_pad = -(-len(ids) // chunk) * chunk
+            tokens = np.zeros((1, t_pad), np.int32)
+            tokens[0, : len(ids)] = ids
+            self._staging = {
+                "ids": ids, "sid": sid, "slot": self._free_slot(),
+                "tokens": tokens, "pos": 0, "chunk": chunk,
+                "cache": init_cache_on_mesh(
+                    self.config, self.plan.mesh, batch=1,
+                    max_seq=self.max_seq, quant=self.kv_quant,
+                    batch_replicated=True,
+                ),
+            }
+        st = self._staging
+        pos, chunk = st["pos"], st["chunk"]
+        final = pos + chunk >= st["tokens"].shape[1]
+        logits, st["cache"] = self._admit_prefill(
+            self.params,
+            jnp.asarray(st["tokens"][:, pos: pos + chunk]),
+            st["cache"],
+            jnp.int32(pos),
+            jnp.asarray([len(st["ids"]) - 1 - pos if final else 0],
+                        jnp.int32),
         )
-        logits, row_cache = self._prefill(
-            self.params, jnp.asarray(tokens), row_cache,
-            jnp.full((dp,), len(ids) - 1, jnp.int32),
-        )
+        st["pos"] = pos + chunk
+        if final:
+            self._finish_admission(logits)
+
+    def _finish_admission(self, logits) -> None:
+        """Splice the staged row into its slot, sample + record the first
+        token, and queue its emission row."""
+        st, self._staging = self._staging, None
+        slot, ids, stream_id = st["slot"], st["ids"], st["sid"]
+        # Buffered block rows belong to the pre-admission state: record
+        # them before the slot's column changes meaning, so streaming
+        # step() consumers still receive every Token.
+        while self._block_buf:
+            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
         self.cache = jax.tree.map(
-            lambda c, r: c.at[:, slot].set(r[:, 0]), self.cache, row_cache
+            lambda c, r: c.at[:, slot].set(r[:, 0]), self.cache, st["cache"]
         )
 
         key = jax.random.fold_in(self._base_key, stream_id)
@@ -324,7 +379,37 @@ class BatchGenerator:
         window_full = len(ids) + 1 >= self.max_seq
         s.done = (tok_id in self._eos_ids) or window_full
         text = s.detok.next_token(tok_id) if s.detok else None
-        return slot, Token(id=tok_id, text=text, is_end_of_stream=s.done)
+        row: list[Token | None] = [None] * len(self.streams)
+        row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done)
+        self._pending_rows.append(row)
+
+    def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
+        """Admit a new prompt into a finished slot of a RUNNING batch,
+        synchronously: the chunked one-row admission prefill runs to
+        completion here and the first token is returned (recorded;
+        subsequent ``step()`` calls carry the stream forward). Use
+        ``enqueue`` to interleave the prefill with decode instead. Raises
+        if no stream is done."""
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        ids = self._encode(prompt)
+        self._arrivals.append((ids, stream_id))
+        # Drain until OUR arrival (tracked by list identity — FIFO order
+        # admits anything queued ahead of it first) is fully admitted. If
+        # the queue head cannot start because every stream is live, raise
+        # instead of busy-looping on a no-op tick.
+        while (any(a[0] is ids for a in self._arrivals)
+               or (self._staging is not None
+                   and self._staging["ids"] is ids)):
+            if self._staging is None and self._free_slot() is None:
+                self._arrivals = [a for a in self._arrivals
+                                  if a[0] is not ids]
+                raise RuntimeError("no free slot: every stream is still live")
+            self._admission_tick()
+        # the emission row just queued duplicates the returned Token: drop it
+        row = self._pending_rows.pop()
+        slot = next(i for i, t in enumerate(row) if t is not None)
+        return slot, row[slot]
 
     # -- stepping ------------------------------------------------------------
     def _emit(self, row: np.ndarray,
@@ -347,7 +432,9 @@ class BatchGenerator:
 
     def step(self) -> list[Token | None]:
         """Advance every live stream one token; returns one entry per active
-        stream slot (None for finished/dummy streams)."""
+        stream slot (None for finished/dummy streams). A queued arrival
+        (``enqueue``) advances by one admission-prefill chunk per call,
+        interleaved with the decode dispatches."""
         if not self.streams:
             raise RuntimeError("set_prompts first")
         if not self._emitted_first:
@@ -359,6 +446,7 @@ class BatchGenerator:
                 np.asarray(self._last_tokens),
                 skip=[bool(s.generated) for s in self.streams],
             )
+        self._admission_tick()
         if self._pending_rows:
             return self._pending_rows.pop(0)
         if self._block_buf:

@@ -20,9 +20,12 @@ import jax.numpy as jnp
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops import sampling
-from cake_tpu.ops.kvcache import init_cache
 from cake_tpu.ops.sampling import SamplerSettings
-from cake_tpu.parallel.mesh import MeshPlan, shard_cache, shard_params
+from cake_tpu.parallel.mesh import (
+    MeshPlan,
+    init_cache_on_mesh,
+    shard_params,
+)
 from cake_tpu.parallel.pipeline import (
     build_sharded_decode,
     build_sharded_prefill,
@@ -95,11 +98,10 @@ class MeshGenerator(GeneratorBase):
                              "kernels stream plain KV buffers)")
         self.kv_quant = kv_quant
         self.params = shard_params(params, plan.mesh)
-        self.cache = shard_cache(
-            init_cache(config, batch=1, max_seq=self.max_seq,
-                       quant=kv_quant),
-            plan.mesh,
-        )
+        # allocated per-shard on its owner device (multi-host-valid: no
+        # host zeros device_put to non-addressable shards)
+        self.cache = init_cache_on_mesh(config, plan.mesh, batch=1,
+                                        max_seq=self.max_seq, quant=kv_quant)
         self._prefill = build_sharded_prefill(
             config, plan, params_like=self.params,
             microbatch=self.prefill_chunks, kv_quant=kv_quant,

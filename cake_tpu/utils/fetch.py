@@ -100,12 +100,16 @@ def _hub_populated(dest: Path, want: str) -> bool:
             and _files_complete(dest))
 
 
-# config.json fields that identify a model architecture/size — the cheap
-# identity fingerprint compared between a local checkout and the hub repo
+# config.json fields that identify a model — architecture/size plus the
+# content-bearing fields that differ between same-architecture repos
+# (e.g. Llama-3 base vs Instruct differ in eos_token_id). A fingerprint,
+# not byte verification: same-config same-architecture finetunes are
+# indistinguishable, which the caller warns about.
 _IDENTITY_KEYS = (
     "architectures", "hidden_size", "num_hidden_layers",
     "num_attention_heads", "num_key_value_heads", "vocab_size",
-    "intermediate_size",
+    "intermediate_size", "bos_token_id", "eos_token_id", "rope_theta",
+    "rope_scaling", "torch_dtype", "max_position_embeddings",
 )
 
 
@@ -181,7 +185,12 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
             return dest
         if verdict:
             (dest / _STAMP).write_text(want)
-            log.info("fetch: %s verified as %s, stamped", dest, want)
+            log.warning(
+                "fetch: %s matches %s's config fingerprint and was stamped "
+                "— this verifies architecture + tokenizer/rope config, not "
+                "weight bytes; use --refetch if the dir might hold a "
+                "same-config finetune", dest, want,
+            )
             return dest
         raise RuntimeError(
             f"{dest} holds a complete checkpoint whose config.json does not "

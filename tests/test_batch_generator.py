@@ -250,6 +250,114 @@ def test_admit_requires_free_slot(params):
         g.admit([1, 2, 3], stream_id=9)
 
 
+def test_enqueue_interleaves_admission_with_decode(params):
+    """Real continuous batching: a queued arrival's prefill advances one
+    chunk per step ALONGSIDE the decode dispatches (the running batch never
+    stalls behind a full prompt pass), as one replicated row (no dp
+    discarded copies). The admitted stream and the untouched neighbor are
+    both bit-identical to their solo runs."""
+    settings = SamplerSettings(**GREEDY)
+    new_prompt = [2, 8, 1, 7, 6, 5, 4, 3]  # 8 tokens -> 2 chunks of 4
+    g = BG(CFG, params, settings=settings, dp=1, admit_chunk=4)
+    g.set_prompts(PROMPTS[:2])
+    rows = [g.step(), g.step()]  # first token + one decode
+    g.streams[0].done = True  # slot 0 frees up
+
+    decode_calls = {"n": 0}
+    real_single = g._decode_single
+
+    def count_single(*a, **k):
+        decode_calls["n"] += 1
+        return real_single(*a, **k)
+
+    g._decode_single = count_single
+    admit_calls = {"n": 0}
+    real_admit = g._admit_prefill  # property: compiles the program
+
+    def count_admit(*a, **k):
+        admit_calls["n"] += 1
+        return real_admit(*a, **k)
+
+    g._BatchGenerator__admit_prefill = count_admit
+
+    g.enqueue(new_prompt, stream_id=7)
+    assert g.pending_admissions() == 1
+    rows.append(g.step())  # chunk 1 of the admission + a decode dispatch
+    assert admit_calls["n"] == 1 and decode_calls["n"] == 1
+    assert rows[-1][1] is not None  # the neighbor stream kept decoding
+    rows.append(g.step())  # chunk 2 (final): emits the first token row
+    assert admit_calls["n"] == 2 and g.pending_admissions() == 0
+    assert rows[-1][0] is not None and rows[-1][1] is None
+    for _ in range(4):
+        rows.append(g.step())
+
+    admitted = [r[0].id for r in rows[3:] if r[0] is not None]
+    solo = BG(CFG, params, settings=settings, dp=1)
+    solo.set_prompts([new_prompt], stream_ids=[7])
+    assert admitted == solo.generate(len(admitted))[0][: len(admitted)]
+
+    neighbor = [r[1].id for r in rows if r[1] is not None]
+    assert neighbor == _single_stream(params, PROMPTS[1], len(neighbor),
+                                      settings)
+
+
+def test_enqueue_waits_for_free_slot_and_drains_fifo(params):
+    """Arrivals queue FIFO; admission starts only once a slot frees."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1)
+    g.set_prompts(PROMPTS[:2])
+    g.step()
+    g.enqueue([2, 8, 1], stream_id=5)
+    g.enqueue([4, 4, 4], stream_id=6)
+    g.step()
+    assert g.pending_admissions() == 2  # no free slot yet
+    g.streams[0].done = True
+    g.step()  # whole bucketed prompt in one dispatch (admit_chunk=None)
+    assert g.pending_admissions() == 1  # first arrival admitted
+    sids = sorted(s.stream_id for s in g.streams)
+    assert 5 in sids and 6 not in sids
+    g.streams[1].done = True
+    g.step()
+    assert g.pending_admissions() == 0
+    assert sorted(s.stream_id for s in g.streams) == [5, 6]
+
+
+def test_admit_with_queued_arrivals_exceeding_slots_raises(params):
+    """admit() with more arrivals than free slots must raise, not hang:
+    the drain loop detects a stuck queue head (no staging, no free slot)
+    and removes the caller's arrival (regression: infinite busy loop)."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1)
+    g.set_prompts(PROMPTS[:2])
+    g.step()
+    g.streams[0].done = True  # exactly one free slot
+    g.enqueue([2, 8, 1], stream_id=5)  # will take the only slot
+    with pytest.raises(RuntimeError, match="no free slot"):
+        g.admit([4, 4, 4], stream_id=6)
+    # the queued arrival was admitted on the way; ours was removed
+    assert g.pending_admissions() == 0
+    assert 5 in [s.stream_id for s in g.streams]
+    assert 6 not in [s.stream_id for s in g.streams]
+
+
+def test_enqueue_with_dp_sharded_batch(params):
+    """The admission row is replicated over dp (batch_replicated staging
+    cache), so continuous admission works on a dp-sharded batch too."""
+    settings = SamplerSettings(**GREEDY)
+    new_prompt = [2, 8, 1]
+    g = BG(CFG, params, settings=settings, dp=2, admit_chunk=4)
+    g.set_prompts(PROMPTS[:2])
+    g.step()
+    g.streams[0].done = True
+    g.enqueue(new_prompt, stream_id=11)
+    rows = [g.step() for _ in range(6)]
+    admitted = [r[0].id for r in rows if r[0] is not None]
+    assert admitted
+    solo = BG(CFG, params, settings=settings, dp=2)
+    solo.set_prompts([new_prompt], stream_ids=[11])
+    assert admitted == solo.generate(len(admitted))[0][: len(admitted)]
+
+
 def test_batch_padding_to_dp_multiple(params):
     """3 prompts on dp=2 pad to 4 rows with an inactive dummy; outputs still
     match, dummy never surfaces."""
