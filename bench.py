@@ -85,6 +85,18 @@ def _hbm_gbps(device) -> float:
     return _device_spec(device, _HBM_GBPS, 819.0)
 
 
+def _kv_quant() -> str | None:
+    """CAKE_BENCH_KV=int8: run with the quantized KV cache (half the cache
+    HBM -> roughly double the servable batch x window on a fixed budget).
+    Honored by EVERY bench path (single-stream, batched, prefill,
+    speculative) — the HBM preflight prices it, so the paths must actually
+    allocate it."""
+    kv = os.environ.get("CAKE_BENCH_KV", "") or None
+    if kv not in (None, "int8"):
+        sys.exit(f"error: CAKE_BENCH_KV must be 'int8', got {kv!r}")
+    return kv
+
+
 def _config(preset: str):
     from cake_tpu.models.config import LlamaConfig, llama3_8b, tiny
 
@@ -170,6 +182,7 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     from cake_tpu.ops.kvcache import init_cache
     from cake_tpu.runtime.generator import prefill_fn
 
+    kv_quant = _kv_quant()
     t = config.max_seq_len // 2
     prefill = jax.jit(partial(prefill_fn, config=config),
                       donate_argnames=("cache",))
@@ -179,7 +192,8 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     )
     last = jnp.asarray([t - 1], jnp.int32)
 
-    cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+    cache = init_cache(config, batch=1, max_seq=config.max_seq_len,
+                       quant=kv_quant)
     t0 = time.perf_counter()
     logits, cache = prefill(params, tokens, cache, last)
     _sync(logits)
@@ -193,7 +207,8 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     iters = 8
     dts = []
     for _ in range(iters):
-        cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+        cache = init_cache(config, batch=1, max_seq=config.max_seq_len,
+                           quant=kv_quant)
         _sync(cache)
         t0 = time.perf_counter()
         logits, cache = prefill(params, tokens, cache, last)
@@ -202,6 +217,8 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     dt = sum(dts) / iters
 
     wtag = "int8" if quant == "int8" else "bf16"
+    if kv_quant:
+        wtag += "_kv8"
     # vs_baseline: fraction of the chip's bf16 peak the prompt pass sustains
     # (2 * matmul-params * T flops: the embed table is a lookup, not a
     # matmul, so it is excluded from the numerator; attention flops are
@@ -245,9 +262,7 @@ def _run_batched(config, params, preset, quant, settings, dev,
         build_sharded_prefill,
     )
 
-    # CAKE_BENCH_KV=int8: serve with the quantized KV cache (half the cache
-    # HBM -> roughly double the servable batch x window on a fixed budget)
-    kv_quant = os.environ.get("CAKE_BENCH_KV") or None
+    kv_quant = _kv_quant()
     plan = MeshPlan.build(config, devices=jax.devices()[:1])
     params = shard_params(params, plan.mesh)
     cache = init_cache_on_mesh(config, plan.mesh, batch=batch,
@@ -327,6 +342,56 @@ def _run_batched(config, params, preset, quant, settings, dev,
         f"single-stream roofline={roofline:.1f}tok/s "
         f"per-stream {agg_tok_s / batch:.1f}tok/s ttft_cold={ttft_s:.2f}s "
         f"timed_tokens={dispatches * per * batch} multistep={per}\n"
+    )
+    return 0
+
+
+def _run_speculative(config, params, preset, quant, dev, steps) -> int:
+    """CAKE_BENCH_SPEC=K: greedy decode with n-gram speculation on a
+    self-repeating stream (the favorable regime — repetitive/structured
+    text; acceptance is printed so the row is honest about it). The win is
+    structural: tokens-per-dispatch > 1 amortizes the per-token HBM weight
+    sweep that bounds plain decode."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.speculative import SpeculativeGenerator
+
+    k = int(os.environ.get("CAKE_BENCH_SPEC", "8"))
+    kv_quant = _kv_quant()
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = SpeculativeGenerator(config, params, settings=settings,
+                               spec_k=k, kv_quant=kv_quant)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+    gen.set_prompt(prompt)
+    gen.next_token(0)  # prefill + compile
+    warm = 8
+    for i in range(1, warm):
+        gen.next_token(i)
+    d0, e0 = gen.dispatches, gen.emitted
+    t0 = time.perf_counter()
+    n = 0
+    while gen.emitted - e0 < steps and gen._pos < config.max_seq_len - k - 1:
+        gen.next_token(warm + n)
+        n += 1
+    _sync(gen._history)
+    dt = time.perf_counter() - t0
+    timed = gen.emitted - e0
+    tok_s = timed / dt
+    accept = timed / max(1, gen.dispatches - d0)
+    model_gb = _param_bytes(params) / 1e9
+    roofline = _hbm_gbps(dev) / model_gb
+    wtag = "int8" if quant == "int8" else "bf16"
+    if kv_quant:
+        wtag += "_kv8"
+    print(json.dumps({
+        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_spec{k}",
+        "value": round(tok_s, 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(tok_s / roofline, 4),
+    }))
+    sys.stderr.write(
+        f"device={dev.device_kind} params={model_gb:.2f}GB spec_k={k} "
+        f"tokens/dispatch={accept:.2f} timed_tokens={timed} "
+        f"(self-repeating stream: favorable-regime acceptance)\n"
     )
     return 0
 
@@ -467,10 +532,14 @@ def main() -> int:
     batch = int(os.environ.get("CAKE_BENCH_BATCH", "1"))
     if os.environ.get("CAKE_BENCH_PREFILL") == "1":
         return _run_prefill(config, params, preset, quant, dev)
+    if os.environ.get("CAKE_BENCH_SPEC"):
+        return _run_speculative(config, params, preset, quant, dev, steps)
     if batch > 1:
         return _run_batched(config, params, preset, quant, settings, dev,
                             batch, steps, multistep)
-    cache = init_cache(config, batch=1, max_seq=config.max_seq_len)
+    kv_quant = _kv_quant()
+    cache = init_cache(config, batch=1, max_seq=config.max_seq_len,
+                       quant=kv_quant)
     history, hist_slot = init_history(settings.repeat_last_n)
 
     if multistep > 1:
@@ -542,6 +611,8 @@ def main() -> int:
     roofline = _hbm_gbps(dev) / model_gb  # ideal decode tok/s (weights-bound)
 
     wtag = "int8" if quant == "int8" else "bf16"
+    if kv_quant:
+        wtag += "_kv8"
     print(json.dumps({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip",
         "value": round(toks_per_s, 3),

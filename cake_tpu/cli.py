@@ -77,9 +77,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="store the KV cache as int8 + per-slot scales "
                         "(half the cache HBM — roughly doubles servable "
                         "batch x window; local and mesh paths, sp=1)")
-    p.add_argument("--decode-block", type=int, default=8, dest="decode_block",
+    p.add_argument("--decode-block", type=int, default=None,
+                   dest="decode_block",
                    help="fused decode steps per dispatch (all-local and mesh "
-                        "paths; 1 = one program per token)")
+                        "paths; 1 = one program per token; default 8)")
+    p.add_argument("--speculate", type=int, default=0, metavar="K",
+                   help="n-gram speculative decoding: propose K tokens per "
+                        "round from the context's own n-grams and verify "
+                        "them in one dispatch (greedy only: requires "
+                        "--temperature 0; local path)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -199,6 +205,9 @@ def run_serve(args) -> int:
     if args.prefill_chunks > 1:
         sys.exit("error: --prefill-chunks is not supported with "
                  "--prompts-file serving")
+    if args.speculate:
+        sys.exit("error: --speculate is the single-stream local path; it "
+                 "is not supported with --prompts-file serving")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
     settings = _settings(args)
@@ -240,7 +249,8 @@ def run_serve(args) -> int:
         tie_word_embeddings=config.tie_word_embeddings)
     gen = BatchGenerator(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=args.max_seq,
-                         block_size=args.decode_block,
+                         block_size=(args.decode_block
+                                     if args.decode_block is not None else 8),
                          kv_quant=args.kv_quant)
     gen.set_prompts(prompts)
     log.info("model loaded in %.1fs (%s); serving %d streams",
@@ -290,6 +300,15 @@ def run_master(args) -> int:
             )
         topo_mesh = bool(with_dev)
     use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
+    if args.speculate and (use_mesh or args.topology):
+        sys.exit("error: --speculate runs the all-local path; it is not "
+                 "supported with --stages/--tp/--sp or --topology (it "
+                 "would otherwise be silently ignored)")
+    if args.speculate and args.decode_block is not None:
+        sys.exit("error: --decode-block does not compose with --speculate "
+                 "(speculative rounds replace fused-block dispatches; the "
+                 "flag would otherwise be silently ignored)")
+    decode_block = args.decode_block if args.decode_block is not None else 8
     if args.prefill_chunks > 1:
         # Overlap needs stages to overlap across, and the sp plane owns
         # long-context prefill — reject combinations that would silently do
@@ -345,7 +364,7 @@ def run_master(args) -> int:
             gen = MeshGenerator(config, params, plan=plan,
                                 tokenizer=tokenizer, settings=settings,
                                 max_seq=args.max_seq,
-                                block_size=args.decode_block,
+                                block_size=decode_block,
                                 prefill_chunks=args.prefill_chunks,
                                 kv_quant=args.kv_quant)
         except ValueError as e:
@@ -372,14 +391,25 @@ def run_master(args) -> int:
         gen = DistributedGenerator(config, head, runners, tokenizer=tokenizer,
                                    settings=settings, max_seq=args.max_seq)
     else:
-        from cake_tpu.runtime.generator import LlamaGenerator
-
         params = load_llama_params(args.model, config.num_hidden_layers,
                                    dtype=config.dtype, quantize=args.quantize)
-        gen = LlamaGenerator(config, params, tokenizer=tokenizer,
-                             settings=settings, max_seq=args.max_seq,
-                             block_size=args.decode_block,
-                             kv_quant=args.kv_quant)
+        if args.speculate:
+            from cake_tpu.runtime.speculative import SpeculativeGenerator
+
+            try:
+                gen = SpeculativeGenerator(
+                    config, params, tokenizer=tokenizer, settings=settings,
+                    max_seq=args.max_seq, kv_quant=args.kv_quant,
+                    spec_k=args.speculate)
+            except ValueError as e:
+                sys.exit(f"error: {e}")
+        else:
+            from cake_tpu.runtime.generator import LlamaGenerator
+
+            gen = LlamaGenerator(config, params, tokenizer=tokenizer,
+                                 settings=settings, max_seq=args.max_seq,
+                                 block_size=decode_block,
+                                 kv_quant=args.kv_quant)
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
              memory_report())
 

@@ -142,7 +142,16 @@ class BatchGenerator:
         # as a single replicated row in a staging cache — no dp discarded
         # copies, no multi-dispatch stall of the running batch.
         # ``admit_chunk`` sets the per-dispatch chunk length (None: the
-        # whole bucketed prompt in one dispatch).
+        # whole bucketed prompt in one dispatch). It must divide max_seq:
+        # otherwise a near-window prompt rounds up PAST the window and the
+        # final chunk's clamped dynamic_update_slice would silently
+        # overwrite committed KV slots (wrong tokens, no error).
+        if admit_chunk is not None and self.max_seq % admit_chunk:
+            raise ValueError(
+                f"admit_chunk {admit_chunk} must divide max_seq "
+                f"{self.max_seq} (a chunk round-up past the window would "
+                "clamp-overwrite committed KV)"
+            )
         self._admit_chunk = admit_chunk
         self._arrivals: list[tuple[list[int], int]] = []
         self._staging: dict | None = None

@@ -322,6 +322,31 @@ def test_enqueue_waits_for_free_slot_and_drains_fifo(params):
     assert sorted(s.stream_id for s in g.streams) == [5, 6]
 
 
+def test_admit_chunk_must_divide_max_seq(params):
+    """A chunk that doesn't divide the window is rejected at construction:
+    a near-window prompt would round up PAST max_seq and the final chunk's
+    clamped KV write would silently corrupt committed slots (repro'd:
+    admit_chunk=6/max_seq=32 with a 31-token prompt flipped the admitted
+    stream's first token)."""
+    cfg = tiny(max_seq_len=32)
+    with pytest.raises(ValueError, match="must divide max_seq"):
+        BG(cfg, params, settings=SamplerSettings(**GREEDY), dp=1,
+           admit_chunk=6)
+    # dividing chunk + near-window prompt: exact admission
+    settings = SamplerSettings(**GREEDY)
+    near = list(range(2, 2 + 29))  # 29 tokens into a 32 window
+    g = BG(cfg, params, settings=settings, dp=1, admit_chunk=8)
+    g.set_prompts([[5, 9, 2]])
+    g.step()
+    g.streams[0].done = True
+    g.enqueue(near, stream_id=3)
+    rows = [g.step() for _ in range(6)]
+    got = [r[0].id for r in rows if r[0] is not None]
+    solo = BG(cfg, params, settings=settings, dp=1)
+    solo.set_prompts([near], stream_ids=[3])
+    assert got == solo.generate(len(got))[0][: len(got)]
+
+
 def test_admit_with_queued_arrivals_exceeding_slots_raises(params):
     """admit() with more arrivals than free slots must raise, not hang:
     the drain loop detects a stuck queue head (no staging, no free slot)

@@ -214,6 +214,29 @@ def test_prompts_file_numeric_text_needs_explicit_mode(model_dir, tmp_path):
     assert "not a comma-separated id list" in r.stderr
 
 
+def test_speculate_flag_runs_and_guards(model_dir):
+    """--speculate K drives the n-gram speculative generator end-to-end;
+    it requires greedy sampling and rejects paths that would ignore it."""
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5,7,3,5,7",
+        "-n", "8", "--temperature", "0", "--max-seq", "64", "--cpu",
+        "--speculate", "4",
+    ])
+    assert r.returncode == 0, r.stderr
+    assert any(l and all(c.isdigit() or c == "," for c in l)
+               for l in r.stdout.splitlines())
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5,7", "-n", "2",
+        "--cpu", "--speculate", "4",  # default temperature 1.0
+    ])
+    assert r.returncode != 0 and "greedy" in r.stderr
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5,7", "-n", "2",
+        "--temperature", "0", "--cpu", "--speculate", "4", "--stages", "2",
+    ])
+    assert r.returncode != 0 and "--speculate" in r.stderr
+
+
 def test_profile_flag_writes_trace(model_dir, tmp_path):
     trace_dir = tmp_path / "trace"
     r = _run_cli([

@@ -1,0 +1,157 @@
+"""N-gram speculative decoding (runtime/speculative).
+
+The bar: greedy output BIT-IDENTICAL to plain decode on every stream
+(speculation may only change how many tokens land per dispatch, never which
+tokens), with tokens-per-dispatch > 1 on self-repeating streams."""
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.generator import LlamaGenerator
+from cake_tpu.runtime.speculative import SpeculativeGenerator, ngram_propose
+
+CFG = tiny(max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(2))
+
+
+# -- proposal machinery -------------------------------------------------------
+
+def test_ngram_propose_copies_after_last_match():
+    #                     0  1  2  3  4  5  6  7
+    ctx = [7, 1, 2, 3, 9, 1, 2, 3]
+    # trailing 3-gram (1,2,3) matched at position 1; continuation is [9, 1, 2]
+    assert ngram_propose(ctx, n_max=3, k=3) == [9, 1, 2]
+
+
+def test_ngram_propose_backs_off_to_shorter_ngrams():
+    ctx = [5, 8, 5, 9, 5]  # trailing (9,5) unseen; trailing (5) -> after idx 2
+    assert ngram_propose(ctx, n_max=2, k=2) == [9, 5]
+
+
+def test_ngram_propose_no_match_or_degenerate():
+    assert ngram_propose([1, 2, 3], n_max=3, k=4) == []
+    assert ngram_propose([4], n_max=3, k=4) == []
+    assert ngram_propose([], n_max=3, k=4) == []
+
+
+def test_ngram_propose_most_recent_match_wins():
+    ctx = [1, 2, 7, 1, 2, 8, 1, 2]
+    assert ngram_propose(ctx, n_max=2, k=1) == [8]  # the later occurrence
+
+
+# -- greedy exactness ---------------------------------------------------------
+
+def _plain(params, prompt, n, settings):
+    g = LlamaGenerator(CFG, params, settings=settings)
+    g.set_prompt(prompt)
+    out = []
+    for i in range(n):
+        t = g.next_token(i)
+        out.append(t.id)
+        if t.is_end_of_stream:
+            break
+    return out
+
+
+def _spec(params, prompt, n, settings, **kw):
+    g = SpeculativeGenerator(CFG, params, settings=settings, **kw)
+    g.set_prompt(prompt)
+    out = []
+    for i in range(n):
+        t = g.next_token(i)
+        out.append(t.id)
+        if t.is_end_of_stream:
+            break
+    return out, g
+
+
+@pytest.mark.parametrize("prompt", [
+    [5, 9, 2, 5, 9, 2, 5, 9],          # self-repeating: high acceptance
+    [3, 1, 4, 1, 5, 9, 2, 6],          # mixed
+    [11, 7],                           # short, nothing to match at first
+])
+def test_greedy_tokens_bit_identical_to_plain_decode(params, prompt):
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    want = _plain(params, prompt, 24, settings)
+    got, _ = _spec(params, prompt, 24, settings, spec_k=6)
+    assert got == want
+
+
+def test_no_repeat_penalty_path_also_exact(params):
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [2, 8, 2, 8, 2, 8]
+    want = _plain(params, prompt, 24, settings)
+    got, _ = _spec(params, prompt, 24, settings, spec_k=8)
+    assert got == want
+
+
+def test_speculation_reduces_dispatches_on_repeating_stream(params):
+    """A greedy stream that cycles (tiny random models loop readily; the
+    prompt seeds the loop) must land >1 token per dispatch on average."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+    got, g = _spec(params, prompt, 32, settings, spec_k=6)
+    # accepted tokens either streamed out or are still buffered
+    assert g.emitted == len(got) + len(g._block_buf)
+    assert g.dispatches < g.emitted  # strictly fewer dispatches than tokens
+    assert got == _plain(params, prompt, 32, settings)
+
+
+def test_eos_inside_speculation_stops_stream(params):
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+    ref = _plain(params, prompt, 12, settings)
+    eos_cfg = tiny(max_seq_len=128, eos_token_id=ref[5])
+    g = SpeculativeGenerator(eos_cfg, params,
+                             settings=settings, spec_k=8)
+    g.set_prompt(prompt)
+    out = []
+    for i in range(12):
+        t = g.next_token(i)
+        out.append(t.id)
+        if t.is_end_of_stream:
+            break
+    assert out == ref[:6]
+    assert out[-1] == ref[5]
+
+
+def test_window_edge_falls_back_to_single_steps(params):
+    """Near max_seq the verification round would overrun the window: the
+    generator falls back to plain single steps and still matches."""
+    cfg = tiny(max_seq_len=32)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [5, 9, 2, 5, 9, 2] * 3  # 18 tokens, 14 slots left
+    plain = LlamaGenerator(cfg, params, settings=settings)
+    plain.set_prompt(prompt)
+    want = [plain.next_token(i).id for i in range(13)]
+    g = SpeculativeGenerator(cfg, params, settings=settings, spec_k=8)
+    g.set_prompt(prompt)
+    got = [g.next_token(i).id for i in range(13)]
+    assert got == want
+
+
+def test_rejects_sampled_settings(params):
+    with pytest.raises(ValueError, match="greedy"):
+        SpeculativeGenerator(CFG, params,
+                             settings=SamplerSettings(temperature=0.8))
+
+
+def test_int8_kv_composes_with_speculation(params):
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    prompt = [5, 9, 2, 5, 9, 2]
+    want, _ = _spec(params, prompt, 16, settings, spec_k=4)
+    got, _ = _spec(params, prompt, 16, settings, spec_k=4, kv_quant="int8")
+    # int8 KV changes numerics slightly; the contract here is that the two
+    # SPECULATIVE runs each match their own plain-decode twins
+    g = LlamaGenerator(CFG, params, settings=settings, kv_quant="int8")
+    g.set_prompt(prompt)
+    plain_int8 = [g.next_token(i).id for i in range(16)]
+    assert got == plain_int8[: len(got)]
