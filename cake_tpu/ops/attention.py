@@ -67,6 +67,37 @@ def _flash_ok(t: int, s: int, d: int) -> bool:
 #   measures. (Flash decode reads only up to the frontier, so it still wins
 #   early in a long window; CAKE_PALLAS=1 forces it for such workloads.)
 PREFILL_FLASH_MIN_S = 2048
+# T floor for the flash prefill: the sweep's smallest measured chunk is
+# T=256; far below it the q-block degenerates (_pick_block of a tiny/odd T
+# -> 1-row blocks) and the grid re-fetches the whole KV buffer per q-block
+# — a speculative-verify dispatch (T ~ 9) would read S bytes T times.
+# Real prefill buckets are powers of two >= 256 whenever S is in the flash
+# regime, so the floor costs nothing on the prompt path.
+PREFILL_FLASH_MIN_T = 256
+
+
+def _flash_prefill_choice(t: int, s: int, d: int) -> str:
+    """Measured-crossover dispatch for a prefill-shaped (T>1, scalar-pos)
+    attention — shared by the plain and int8-KV paths so there is exactly
+    one policy. Returns ``"flash"`` or ``"xla"``; warns when the kernels
+    were wanted but the shape is not lane-aligned."""
+    enabled = pk.kernels_enabled()
+    want = enabled and (
+        pk.force_kernels()
+        or (t >= PREFILL_FLASH_MIN_T and s >= PREFILL_FLASH_MIN_S)
+    )
+    if not want:
+        return "xla"
+    if pk.interpret_default() or _flash_ok(t, s, d):
+        return "flash"
+    # Runs at trace time (once per compiled shape): a misaligned config
+    # must not silently lose the kernels.
+    log.warning(
+        "flash kernels enabled but shape (T=%d, S=%d, D=%d) is not "
+        "lane-aligned (need D%%128==0 and S%%128==0); falling back to the "
+        "XLA attention path", t, s, d,
+    )
+    return "xla"
 
 
 def attend(
@@ -88,27 +119,15 @@ def attend(
     if per_row and t > 1 and impl != "xla":
         impl = "xla"  # per-row prefill: XLA only (not a served path)
     if impl == "auto":
-        enabled = pk.kernels_enabled()
-        # flash when forced (CAKE_PALLAS=1), or at the shapes where the
-        # measured sweep says it wins: prefill at S >= PREFILL_FLASH_MIN_S.
-        # Decode and short-context prefill run XLA (see the crossover notes
-        # above).
-        want_flash = enabled and (
-            pk.force_kernels() or (t > 1 and s >= PREFILL_FLASH_MIN_S)
-        )
-        if want_flash and (pk.interpret_default() or _flash_ok(t, s, d)):
-            impl = "flash"
+        if t > 1:
+            impl = _flash_prefill_choice(t, s, d)
+        elif pk.kernels_enabled() and pk.force_kernels():
+            # decode: XLA wins at every measured shape (crossover notes
+            # above); CAKE_PALLAS=1 still forces the kernel
+            impl = ("flash" if pk.interpret_default() or _flash_ok(t, s, d)
+                    else "xla")
         else:
             impl = "xla"
-            if want_flash:
-                # Runs at trace time (once per compiled shape), so this is a
-                # one-line notice, not per-step spam: a misaligned config
-                # must not silently lose the kernels.
-                log.warning(
-                    "flash kernels enabled but shape (T=%d, S=%d, D=%d) is "
-                    "not lane-aligned (need D%%128==0 and S%%128==0); "
-                    "falling back to the XLA attention path", t, s, d,
-                )
     if impl == "flash":
         if t == 1:
             return pk.flash_decode(q, k_all, v_all, pos)
@@ -263,16 +282,35 @@ def self_attention_block(
         k = apply_rope(k, cos, sin, pos)
         k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, pos,
                                            gate=write_gate)
-        # int8 KV: dequantize at trace level. The convert+mul fuses into
-        # the attention dot's operand read ONLY on the XLA path — a Pallas
-        # kernel operand is a materialized buffer, which would write + read
-        # the full bf16 KV to HBM and lose the bandwidth win — so the
-        # quantized cache pins impl="xla" until a quantization-aware flash
-        # kernel exists.
         quantized = isinstance(k_cache, kv.QuantizedKV)
-        out = attend(q, kv.dequant_kv(k_cache, q.dtype),
-                     kv.dequant_kv(v_cache, q.dtype), pos,
-                     impl="xla" if quantized else "auto")  # [B, H, T, D]
+        if quantized:
+            # int8 KV. Long-context prefill (the measured flash regime,
+            # S >= PREFILL_FLASH_MIN_S) routes to the quantization-aware
+            # flash kernel, which folds the per-token scales into the
+            # score columns / probabilities and reads only int8 bytes.
+            # Everything else — decode, short prefill — dequantizes at
+            # trace level on the XLA path, where the convert+mul fuses
+            # into the attention dot's operand read. (A plain-flash-kernel
+            # operand would be a materialized bf16 KV buffer in HBM,
+            # losing the bandwidth win, so plain flash is never used with
+            # the quantized cache.)
+            s_len = k_cache.q.shape[2]
+            use_q8_flash = (
+                t > 1
+                and jnp.asarray(pos).ndim == 0
+                and _flash_prefill_choice(t, s_len, d) == "flash"
+            )
+            if use_q8_flash:
+                out = pk.flash_attention_q8(
+                    q, k_cache.q, k_cache.scale, v_cache.q, v_cache.scale,
+                    pos,
+                )
+            else:
+                out = attend(q, kv.dequant_kv(k_cache, q.dtype),
+                             kv.dequant_kv(v_cache, q.dtype), pos,
+                             impl="xla")
+        else:
+            out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
 
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
     out = quant.dense(out, wo)

@@ -50,6 +50,7 @@ def interpret_default() -> bool:
 
 from cake_tpu.ops.pallas.flash import (  # noqa: E402
     flash_attention,
+    flash_attention_q8,
     flash_decode,
 )
 from cake_tpu.ops.pallas.fused import rms_norm_pallas  # noqa: E402
@@ -60,6 +61,7 @@ __all__ = [
     "interpret_default",
     "on_tpu",
     "flash_attention",
+    "flash_attention_q8",
     "flash_decode",
     "rms_norm_pallas",
     "quant_matmul_pallas",

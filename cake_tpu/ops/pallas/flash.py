@@ -193,6 +193,169 @@ def flash_attention(
 
 
 # ---------------------------------------------------------------------------
+# Prefill kernel over an int8 KV cache (kvcache.QuantizedKV layout)
+# ---------------------------------------------------------------------------
+
+
+def _prefill_q8_kernel(
+    pos_ref,  # scalar prefetch: [1] int32
+    q_ref,  # [1, 1, BQ, D]
+    kq_ref,  # [1, 1, BK, D] int8
+    ks_ref,  # [1, 1, BK] f32 (per-token-per-head scales)
+    vq_ref,  # [1, 1, BK, D] int8
+    vs_ref,  # [1, 1, BK] f32
+    o_ref,  # [1, 1, BQ, D]
+    acc_ref,  # VMEM [BQ, D] f32
+    m_ref,  # VMEM [BQ, LANES] f32
+    l_ref,  # VMEM [BQ, LANES] f32
+    *,
+    block_q: int,
+    block_k: int,
+    scale: float,
+    num_kv_blocks: int,
+):
+    """Same online softmax as :func:`_prefill_kernel`, reading int8 KV. The
+    per-token dequant scale is constant along D, so it factors OUT of both
+    matmuls: ``q . (s_j * kq_j) = s_j * (q . kq_j)`` folds into the score
+    column, and ``p @ diag(vs) @ vq = (p * vs) @ vq`` folds into the
+    probabilities — the kernel never materializes dequantized KV, and HBM
+    reads stay at the int8 bytes + one f32 scale per token."""
+    qb = pl.program_id(2)
+    kb = pl.program_id(3)
+    pos = pos_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[:] = jnp.full(m_ref.shape, -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
+
+    @pl.when(kb <= max_kb)
+    def _compute():
+        q = q_ref[0, 0]  # [BQ, D]
+        kq = kq_ref[0, 0].astype(q.dtype)  # [BK, D] (VMEM convert)
+        s = jax.lax.dot_general(
+            q, kq, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale * ks_ref[0, 0][None, :]  # fold key scales per column
+
+        qpos = (
+            pos
+            + qb * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        )
+        kpos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_ref[:]
+        l_prev = l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])  # [BQ, BK] f32
+        l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[:] = m_new
+        vq = vq_ref[0, 0].astype(q.dtype)
+        pv = jax.lax.dot_general(
+            (p * vs_ref[0, 0][None, :]).astype(q.dtype), vq,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[:] = acc_ref[:] * alpha[:, :1] + pv
+
+    @pl.when(kb == num_kv_blocks - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+def flash_attention_q8(
+    q: jax.Array,  # [B, H, T, D] (already roped)
+    k_q: jax.Array,  # [B, KVH, S, D] int8
+    k_scale: jax.Array,  # [B, KVH, S] f32
+    v_q: jax.Array,  # [B, KVH, S, D] int8
+    v_scale: jax.Array,  # [B, KVH, S] f32
+    pos,  # scalar int
+    *,
+    block_q: int = 512,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Causal flash attention over an int8 KV buffer (quantize-on-write
+    layout of :class:`cake_tpu.ops.kvcache.QuantizedKV`). Returns
+    ``[B, H, T, D]``. Keeps the long-context flash plane available to the
+    int8 cache: the XLA fallback would materialize dequantized KV (or full
+    scores) in HBM at exactly the window sizes the int8 cache exists for."""
+    b, h, t, d = q.shape
+    kvh, s = k_q.shape[1], k_q.shape[2]
+    group = h // kvh
+    if block_k is None:
+        block_k = 1024 if s >= 4096 else 512
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(s, block_k)
+    nq, nk = t // bq, s // bk
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    scale = 1.0 / math.sqrt(d)
+
+    def q_map(bi, hi, qb, kb, pos_ref):
+        return (bi, hi, qb, 0)
+
+    def kv_map(bi, hi, qb, kb, pos_ref):
+        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
+        return (bi, hi // group, jnp.minimum(kb, max_kb), 0)
+
+    def scale_map(bi, hi, qb, kb, pos_ref):
+        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
+        return (bi, hi // group, jnp.minimum(kb, max_kb))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), q_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk), scale_map),
+            pl.BlockSpec((1, 1, bk, d), kv_map),
+            pl.BlockSpec((1, 1, bk), scale_map),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(
+        _prefill_q8_kernel, block_q=bq, block_k=bk, scale=scale,
+        num_kv_blocks=nk,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h * t * s * d,
+            bytes_accessed=(
+                2 * q.size * q.dtype.itemsize
+                + 2 * k_q.size
+                + 2 * k_scale.size * 4
+            ),
+            transcendentals=b * h * t * s,
+        ),
+        interpret=interpret,
+    )(pos_arr, q, k_q, k_scale, v_q, v_scale)
+
+
+# ---------------------------------------------------------------------------
 # Decode kernel (T == 1)
 # ---------------------------------------------------------------------------
 

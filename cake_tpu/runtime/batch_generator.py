@@ -146,11 +146,13 @@ class BatchGenerator:
         # otherwise a near-window prompt rounds up PAST the window and the
         # final chunk's clamped dynamic_update_slice would silently
         # overwrite committed KV slots (wrong tokens, no error).
-        if admit_chunk is not None and self.max_seq % admit_chunk:
+        if admit_chunk is not None and (
+            admit_chunk < 1 or self.max_seq % admit_chunk
+        ):
             raise ValueError(
-                f"admit_chunk {admit_chunk} must divide max_seq "
-                f"{self.max_seq} (a chunk round-up past the window would "
-                "clamp-overwrite committed KV)"
+                f"admit_chunk {admit_chunk} must be a positive divisor of "
+                f"max_seq {self.max_seq} (a chunk round-up past the window "
+                "would clamp-overwrite committed KV)"
             )
         self._admit_chunk = admit_chunk
         self._arrivals: list[tuple[list[int], int]] = []

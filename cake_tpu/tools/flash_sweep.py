@@ -104,6 +104,40 @@ def sweep(json_out: str | None = None) -> list:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
+    # Int8-KV prefill: the quantization-aware flash kernel vs the XLA path
+    # over trace-level-dequantized buffers (what the dispatch uses below
+    # the crossover) — the long-context plane of the quantized cache.
+    from cake_tpu.ops.kvcache import dequant_kv, quant_kv
+    from cake_tpu.ops.pallas import flash_attention_q8
+
+    fq8 = jax.jit(partial(flash_attention_q8, interpret=not compiled))
+
+    @jax.jit
+    def xla_deq(q, kq, ksc, vq, vsc, pos):
+        from cake_tpu.ops.kvcache import QuantizedKV
+
+        return _attend_xla(q,
+                           dequant_kv(QuantizedKV(q=kq, scale=ksc), q.dtype),
+                           dequant_kv(QuantizedKV(q=vq, scale=vsc), q.dtype),
+                           pos)
+
+    for t, s in ((512, 2048), (2048, 4096), (2048, 8192)):
+        kv_k = quant_kv(jax.random.normal(ks[0], (b, kvh, s, d), jnp.bfloat16))
+        kv_v = quant_kv(jax.random.normal(ks[1], (b, kvh, s, d), jnp.bfloat16))
+        q = jax.random.normal(ks[2], (b, h, t, d), jnp.bfloat16)
+        pos = jnp.int32(s - t - 8)
+        inner = max(2, min(32, (2048 * 4096) // (t * s) * 4))
+        p_ms = _time_ms(fq8, q, kv_k.q, kv_k.scale, kv_v.q, kv_v.scale, pos,
+                        inner=inner)
+        x_ms = _time_ms(xla_deq, q, kv_k.q, kv_k.scale, kv_v.q, kv_v.scale,
+                        pos, inner=inner)
+        rec = {"path": "prefill_q8kv", "t": t, "s": s,
+               "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+               "speedup": round(x_ms / p_ms, 3),
+               "auto_impl": "flash_q8", "auto_speedup": round(x_ms / p_ms, 3)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
     if json_out:
         with open(json_out, "w") as f:
             json.dump(results, f, indent=1)

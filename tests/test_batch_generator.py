@@ -329,9 +329,15 @@ def test_admit_chunk_must_divide_max_seq(params):
     admit_chunk=6/max_seq=32 with a 31-token prompt flipped the admitted
     stream's first token)."""
     cfg = tiny(max_seq_len=32)
-    with pytest.raises(ValueError, match="must divide max_seq"):
+    with pytest.raises(ValueError, match="positive divisor of"):
         BG(cfg, params, settings=SamplerSettings(**GREEDY), dp=1,
            admit_chunk=6)
+    with pytest.raises(ValueError, match="positive divisor of"):
+        BG(cfg, params, settings=SamplerSettings(**GREEDY), dp=1,
+           admit_chunk=0)
+    with pytest.raises(ValueError, match="positive divisor of"):
+        BG(cfg, params, settings=SamplerSettings(**GREEDY), dp=1,
+           admit_chunk=-4)
     # dividing chunk + near-window prompt: exact admission
     settings = SamplerSettings(**GREEDY)
     near = list(range(2, 2 + 29))  # 29 tokens into a 32 window

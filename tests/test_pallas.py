@@ -55,6 +55,86 @@ def test_flash_prefill_ignores_future_kv():
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
 
 
+@pytest.mark.parametrize("pos", [0, 3])
+@pytest.mark.parametrize("group", [1, 4])
+def test_flash_prefill_q8_matches_dequant_oracle(pos, group):
+    """Int8-KV flash kernel vs the XLA path over trace-level-dequantized
+    buffers — identical quantized inputs, so the only difference is
+    accumulation order."""
+    from cake_tpu.ops.kvcache import dequant_kv, quant_kv
+    from cake_tpu.ops.pallas import flash_attention_q8
+
+    b, kvh, t, s, d = 2, 2, 8, 32, 16
+    h = kvh * group
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(3), b, h, kvh, t, s, d)
+    kq, vq = quant_kv(k_all), quant_kv(v_all)
+    ref = attend(q, dequant_kv(kq, q.dtype), dequant_kv(vq, q.dtype), pos,
+                 impl="xla")
+    out = flash_attention_q8(q, kq.q, kq.scale, vq.q, vq.scale, pos,
+                             block_q=4, block_k=8, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_prefill_q8_ignores_future_kv():
+    from cake_tpu.ops.kvcache import quant_kv
+    from cake_tpu.ops.pallas import flash_attention_q8
+
+    b, kvh, group, t, s, d = 1, 2, 2, 4, 16, 8
+    h = kvh * group
+    pos = 2
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(4), b, h, kvh, t, s, d)
+    kq, vq = quant_kv(k_all), quant_kv(v_all)
+    out1 = flash_attention_q8(q, kq.q, kq.scale, vq.q, vq.scale, pos,
+                              block_q=2, block_k=4, interpret=True)
+    frontier = pos + t
+    kq2 = quant_kv(k_all.at[:, :, frontier:].set(1e6))
+    vq2 = quant_kv(v_all.at[:, :, frontier:].set(-1e6))
+    out2 = flash_attention_q8(q, kq2.q, kq2.scale, vq2.q, vq2.scale, pos,
+                              block_q=2, block_k=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+
+
+def test_int8_kv_long_prefill_routes_to_q8_kernel(monkeypatch):
+    """With an int8 cache and a flash-regime window, self_attention_block
+    dispatches the quantization-aware kernel (never plain flash, whose
+    operand would be a materialized bf16 KV buffer)."""
+    import cake_tpu.ops.attention as attn
+    from cake_tpu.ops import pallas as pk
+    from cake_tpu.ops.attention import PREFILL_FLASH_MIN_S, PREFILL_FLASH_MIN_T
+
+    monkeypatch.setattr(pk, "kernels_enabled", lambda: True)
+    monkeypatch.setattr(pk, "force_kernels", lambda: False)
+    monkeypatch.setattr(pk, "interpret_default", lambda: True)
+    calls = []
+    monkeypatch.setattr(
+        attn.pk, "flash_attention_q8",
+        lambda q, kq, ks, vq, vs, pos, **kw: (calls.append("q8"), q)[1])
+    from cake_tpu.ops.kvcache import init_cache
+    from cake_tpu.models.config import tiny
+
+    cfg = tiny(max_seq_len=PREFILL_FLASH_MIN_S)
+    cache = init_cache(cfg, batch=1, max_seq=PREFILL_FLASH_MIN_S,
+                       quant="int8")
+    x = jnp.zeros((1, PREFILL_FLASH_MIN_T, cfg.hidden_size), jnp.bfloat16)
+    wq = jnp.zeros((cfg.hidden_size,
+                    cfg.num_attention_heads * cfg.head_dim), jnp.bfloat16)
+    wkv = jnp.zeros((cfg.hidden_size,
+                     cfg.num_key_value_heads * cfg.head_dim), jnp.bfloat16)
+    wo = jnp.zeros((cfg.num_attention_heads * cfg.head_dim,
+                    cfg.hidden_size), jnp.bfloat16)
+    from cake_tpu.ops.rope import rope_tables
+
+    cos, sin = rope_tables(cfg.head_dim, PREFILL_FLASH_MIN_S,
+                           cfg.rope_theta)
+    attn.self_attention_block(
+        x, wq, wkv, wkv, wo, jax.tree.map(lambda a: a[0], cache.k),
+        jax.tree.map(lambda a: a[0], cache.v), cos, sin, jnp.int32(0),
+        cfg.num_attention_heads, cfg.num_key_value_heads,
+    )
+    assert calls == ["q8"]
+
+
 @pytest.mark.parametrize("pos", [0, 5, 30])
 @pytest.mark.parametrize("group", [1, 4])
 def test_flash_decode_matches_xla(pos, group):
@@ -170,7 +250,11 @@ def test_auto_dispatch_measured_crossover(monkeypatch):
     CAKE_PALLAS=1 still forces the kernels everywhere."""
     import cake_tpu.ops.attention as attn
     from cake_tpu.ops import pallas as pk
-    from cake_tpu.ops.attention import PREFILL_FLASH_MIN_S, attend
+    from cake_tpu.ops.attention import (
+        PREFILL_FLASH_MIN_S,
+        PREFILL_FLASH_MIN_T,
+        attend,
+    )
 
     monkeypatch.setattr(pk, "kernels_enabled", lambda: True)
     monkeypatch.setattr(pk, "force_kernels", lambda: False)
@@ -192,10 +276,11 @@ def test_auto_dispatch_measured_crossover(monkeypatch):
         v = jax.random.normal(key, (b, kvh, s, d), jnp.bfloat16)
         attend(q, k, v, jnp.int32(s - t - 1))
 
-    run(4, PREFILL_FLASH_MIN_S)  # long-context prefill -> flash
+    run(PREFILL_FLASH_MIN_T, PREFILL_FLASH_MIN_S)  # long prefill -> flash
     assert calls == ["prefill"]
     calls.clear()
-    run(4, PREFILL_FLASH_MIN_S // 2)  # short prefill -> XLA
+    run(PREFILL_FLASH_MIN_T, PREFILL_FLASH_MIN_S // 2)  # short -> XLA
+    run(8, PREFILL_FLASH_MIN_S)  # tiny T (speculative verify) -> XLA
     run(1, 4096)  # decode -> XLA at any S
     assert calls == []
     monkeypatch.setattr(pk, "force_kernels", lambda: True)
