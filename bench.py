@@ -346,6 +346,75 @@ def _run_batched(config, params, preset, quant, settings, dev,
     return 0
 
 
+def _run_churn(config, params, preset, quant, dev, batch, steps,
+               multistep) -> int:
+    """CAKE_BENCH_CHURN=1: serving under arrival churn. Streams that reach
+    CAKE_BENCH_STREAM_LEN tokens retire and a queued arrival takes the slot
+    via the chunked admission path (enqueue) — the continuous-batching
+    regime. The figure of merit is aggregate tok/s with churn vs the
+    fixed-batch row (CAKE_BENCH_BATCH alone): admission overhead shows up
+    directly as the gap."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+
+    kv_quant = _kv_quant()
+    stream_len = int(os.environ.get("CAKE_BENCH_STREAM_LEN", "64"))
+    admits = int(os.environ.get("CAKE_BENCH_ADMITS", str(batch)))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = BatchGenerator(config, params, settings=settings,
+                         block_size=multistep, kv_quant=kv_quant,
+                         admit_chunk=min(512, config.max_seq_len))
+    base = [5, 9, 2, 4, 8, 1, 3, 7]
+    gen.set_prompts([list(base) for _ in range(batch)])
+    for _ in range(3):  # compile + warm-up
+        gen.step()
+    # compile the admission-prefill program outside the timed window
+    gen.warm_admission(len(base))
+    next_sid = batch
+    t0 = time.perf_counter()
+    e0 = gen.stats()["tokens_emitted"]
+    admitted = 0
+    max_steps = steps * 4
+    for _ in range(max_steps):
+        gen.step()
+        for s in gen.streams:
+            if s.active and not s.done and len(s.generated) >= stream_len:
+                s.done = True
+                if admitted < admits:
+                    gen.enqueue(list(base), next_sid)
+                    next_sid += 1
+                    admitted += 1
+        live = any(s.active and not s.done for s in gen.streams)
+        if not live and gen.pending_admissions() == 0:
+            break
+        if gen.stats()["tokens_emitted"] - e0 >= steps * batch:
+            break
+    _sync(gen._last_tokens)
+    dt = time.perf_counter() - t0
+    emitted = gen.stats()["tokens_emitted"] - e0
+    agg = emitted / dt
+    model_gb = _param_bytes(params) / 1e9
+    roofline = _hbm_gbps(dev) / model_gb
+    wtag = "int8" if quant == "int8" else "bf16"
+    if kv_quant:
+        wtag += "_kv8"
+    print(json.dumps({
+        "metric": (f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_"
+                   f"b{batch}_churn"),
+        "value": round(agg, 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(agg / roofline, 4),
+    }))
+    st = gen.stats()
+    sys.stderr.write(
+        f"device={dev.device_kind} batch={batch} stream_len={stream_len} "
+        f"admitted={admitted} dispatches={st['decode_dispatches']}d+"
+        f"{st['admit_dispatches']}a tokens/dispatch="
+        f"{st['tokens_per_dispatch']}\n"
+    )
+    return 0
+
+
 def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     """CAKE_BENCH_SPEC=K: greedy decode with n-gram speculation on a
     self-repeating stream (the favorable regime — repetitive/structured
@@ -534,6 +603,9 @@ def main() -> int:
         return _run_prefill(config, params, preset, quant, dev)
     if os.environ.get("CAKE_BENCH_SPEC"):
         return _run_speculative(config, params, preset, quant, dev, steps)
+    if os.environ.get("CAKE_BENCH_CHURN") == "1":
+        return _run_churn(config, params, preset, quant, dev,
+                          max(2, batch), steps, multistep)
     if batch > 1:
         return _run_batched(config, params, preset, quant, settings, dev,
                             batch, steps, multistep)

@@ -175,7 +175,8 @@ def run_worker(args) -> int:
         )["layers"]
 
     worker = Worker(args.name, config, topology, loader,
-                    address=args.address, max_seq=args.max_seq)
+                    address=args.address, max_seq=args.max_seq,
+                    kv_quant=args.kv_quant)
     log.info("worker ready (%s)", memory_report())
     try:
         worker.serve_forever()
@@ -267,6 +268,11 @@ def run_serve(args) -> int:
             print(f"[{i}] {','.join(map(str, o))}")
     log.info("%d streams, %d tokens, %.2f tok/s aggregate — %s",
              len(outs), total, total / dt, memory_report())
+    st = gen.stats()
+    log.info("serving stats: %d decode + %d admission dispatches, "
+             "%.2f tokens/dispatch, busy %.2fs of %.2fs wall",
+             st["decode_dispatches"], st["admit_dispatches"],
+             st["tokens_per_dispatch"] or 0.0, st["busy_s"], st["wall_s"])
     return 0
 
 
@@ -373,8 +379,9 @@ def run_master(args) -> int:
         from cake_tpu.runtime.master import DistributedGenerator, build_runners
 
         if args.kv_quant:
-            sys.exit("error: --kv-quant applies to the local and mesh "
-                     "paths; cross-host workers manage their own caches")
+            sys.exit("error: --kv-quant on the master applies to the local "
+                     "and mesh paths; pass it to each worker process "
+                     "instead (workers own their layers' caches)")
         head = load_llama_params(
             args.model, config.num_hidden_layers, dtype=config.dtype,
             layer_range=(0, 0), quantize=args.quantize,

@@ -124,8 +124,16 @@ def attend(
         elif pk.kernels_enabled() and pk.force_kernels():
             # decode: XLA wins at every measured shape (crossover notes
             # above); CAKE_PALLAS=1 still forces the kernel
-            impl = ("flash" if pk.interpret_default() or _flash_ok(t, s, d)
-                    else "xla")
+            if pk.interpret_default() or _flash_ok(t, s, d):
+                impl = "flash"
+            else:
+                impl = "xla"
+                log.warning(
+                    "flash kernels forced (CAKE_PALLAS=1) but decode shape "
+                    "(T=%d, S=%d, D=%d) is not lane-aligned (need D%%128==0 "
+                    "and S%%128==0); falling back to the XLA attention path",
+                    t, s, d,
+                )
         else:
             impl = "xla"
     if impl == "flash":

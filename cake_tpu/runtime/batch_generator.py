@@ -45,6 +45,7 @@ reproducibility. bf16 weights are unaffected.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import jax
 import jax.numpy as jnp
@@ -158,6 +159,14 @@ class BatchGenerator:
         self._arrivals: list[tuple[list[int], int]] = []
         self._staging: dict | None = None
         self.__admit_prefill = None
+        # Serving observability (the worker-side ops/s + master tok/s story
+        # of the reference, on the batch plane): dispatch and token
+        # counters plus busy wall-clock, reported by stats().
+        self._n_decode_dispatches = 0
+        self._n_admit_dispatches = 0
+        self._n_emitted = 0
+        self._busy_s = 0.0
+        self._t_start: float | None = None
 
     @property
     def _admit_prefill(self):
@@ -266,6 +275,11 @@ class BatchGenerator:
             self.config, self.plan.mesh, batch=b, max_seq=self.max_seq,
             quant=self.kv_quant,
         )
+        self._n_decode_dispatches = 0
+        self._n_admit_dispatches = 0
+        self._n_emitted = 0
+        self._busy_s = 0.0
+        self._t_start = time.perf_counter()
         logits, self.cache = self._prefill(
             self.params, jnp.asarray(tokens), self.cache, jnp.asarray(last)
         )
@@ -311,6 +325,31 @@ class BatchGenerator:
         """Arrivals not yet fully admitted (queued + in-flight)."""
         return len(self._arrivals) + (1 if self._staging is not None else 0)
 
+    def _admission_chunk_for(self, prompt_len: int) -> int:
+        """The per-dispatch admission chunk for a prompt of this length:
+        the configured interleave granularity, but never padded past the
+        prompt's own bucket. Both bounds keep t_pad <= max_seq (the bucket
+        by construction, admit_chunk by the constructor's divisibility
+        check)."""
+        bucket = _bucket(prompt_len, self.max_seq)
+        return min(self._admit_chunk, bucket) if self._admit_chunk else bucket
+
+    def warm_admission(self, prompt_len: int) -> None:
+        """Compile the admission-prefill program (and staging-cache zeros
+        program) for prompts of this length, outside any serving-critical
+        window — benchmarks/servers call this once so the first real
+        ``enqueue`` does not pay XLA compilation mid-run."""
+        chunk = self._admission_chunk_for(prompt_len)
+        staging = init_cache_on_mesh(
+            self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
+            quant=self.kv_quant, batch_replicated=True,
+        )
+        logits, _ = self._admit_prefill(
+            self.params, jnp.zeros((1, chunk), jnp.int32), staging,
+            jnp.int32(0), jnp.zeros((1,), jnp.int32),
+        )
+        np.asarray(logits.ravel()[:1])  # synchronize
+
     def _admission_tick(self) -> None:
         """Advance the in-flight admission by one chunk dispatch (or start
         the next queued arrival if a slot is free)."""
@@ -318,7 +357,7 @@ class BatchGenerator:
             if not self._arrivals or self._free_slot() is None:
                 return
             ids, sid = self._arrivals.pop(0)
-            chunk = self._admit_chunk or _bucket(len(ids), self.max_seq)
+            chunk = self._admission_chunk_for(len(ids))
             t_pad = -(-len(ids) // chunk) * chunk
             tokens = np.zeros((1, t_pad), np.int32)
             tokens[0, : len(ids)] = ids
@@ -334,6 +373,7 @@ class BatchGenerator:
         st = self._staging
         pos, chunk = st["pos"], st["chunk"]
         final = pos + chunk >= st["tokens"].shape[1]
+        t0 = time.perf_counter()
         logits, st["cache"] = self._admit_prefill(
             self.params,
             jnp.asarray(st["tokens"][:, pos: pos + chunk]),
@@ -342,6 +382,8 @@ class BatchGenerator:
             jnp.asarray([len(st["ids"]) - 1 - pos if final else 0],
                         jnp.int32),
         )
+        self._n_admit_dispatches += 1
+        self._busy_s += time.perf_counter() - t0
         st["pos"] = pos + chunk
         if final:
             self._finish_admission(logits)
@@ -390,6 +432,7 @@ class BatchGenerator:
         window_full = len(ids) + 1 >= self.max_seq
         s.done = (tok_id in self._eos_ids) or window_full
         text = s.detok.next_token(tok_id) if s.detok else None
+        self._n_emitted += 1
         row: list[Token | None] = [None] * len(self.streams)
         row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done)
         self._pending_rows.append(row)
@@ -439,6 +482,7 @@ class BatchGenerator:
             s.done = (tok_id in self._eos_ids) or window_full
             text = s.detok.next_token(tok_id) if s.detok else None
             out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done))
+        self._n_emitted += sum(1 for t in out if t is not None)
         return out
 
     def step(self) -> list[Token | None]:
@@ -482,6 +526,7 @@ class BatchGenerator:
         # force every stream to single-step dispatches.
         can_block = self._decode_block is not None
         if can_block:
+            t0 = time.perf_counter()
             toks, self.cache, self._history, self._hist_slot = (
                 self._decode_block(
                     self.params, self._last_tokens, self.cache,
@@ -490,6 +535,8 @@ class BatchGenerator:
                 )
             )
             rows = np.asarray(toks)  # [steps, B]
+            self._n_decode_dispatches += 1
+            self._busy_s += time.perf_counter() - t0
             self._pos = self._pos + self.block_size
             self._index = self._index + self.block_size
             self._last_tokens = toks[-1].astype(jnp.int32)
@@ -498,15 +545,48 @@ class BatchGenerator:
 
         if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
             raise RuntimeError("KV cache exhausted")  # window-full streams done
+        t0 = time.perf_counter()
         tok, self.cache, self._history, self._hist_slot = self._decode_single(
             self.params, self._last_tokens, self.cache,
             jnp.asarray(self._pos), self._keys, self._history,
             self._hist_slot, jnp.asarray(self._index),
         )
+        self._n_decode_dispatches += 1
+        self._busy_s += time.perf_counter() - t0
         self._pos = self._pos + 1
         self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)
         return self._emit(np.asarray(tok))
+
+    def stats(self) -> dict:
+        """Serving counters (the reference's worker ops/s + master tok/s
+        observability, on the batch plane): dispatch counts, emitted
+        tokens, dispatch-busy seconds vs wall clock, aggregate tok/s, and
+        tokens-per-dispatch (the dispatch-amortization the fused block and
+        admission interleave buy)."""
+        wall = (time.perf_counter() - self._t_start
+                if self._t_start is not None else 0.0)
+        dispatches = self._n_decode_dispatches + self._n_admit_dispatches
+        return {
+            "streams_live": sum(
+                1 for s in self.streams if s.active and not s.done
+            ),
+            "streams_done": sum(
+                1 for s in self.streams if s.active and s.done
+            ),
+            "pending_admissions": self.pending_admissions(),
+            "tokens_emitted": self._n_emitted,
+            "decode_dispatches": self._n_decode_dispatches,
+            "admit_dispatches": self._n_admit_dispatches,
+            "tokens_per_dispatch": (
+                round(self._n_emitted / dispatches, 2) if dispatches else None
+            ),
+            "busy_s": round(self._busy_s, 3),
+            "wall_s": round(wall, 3),
+            "aggregate_tok_s": (
+                round(self._n_emitted / wall, 2) if wall > 0 else None
+            ),
+        }
 
     def generate(self, max_new_tokens: int) -> list[list[int]]:
         """Run all streams to EOS or ``max_new_tokens``; returns per-stream

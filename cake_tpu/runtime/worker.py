@@ -64,6 +64,7 @@ class Worker:
         params_loader,  # callable (start, stop) -> stacked layers pytree
         address: str = "0.0.0.0:10128",
         max_seq: int | None = None,
+        kv_quant: str | None = None,
     ):
         if name not in topology:
             raise ValueError(f"worker '{name}' not present in topology")
@@ -71,6 +72,9 @@ class Worker:
         self.config = config
         self.node = topology[name]
         self.max_seq = max_seq or config.max_seq_len
+        # int8 per-connection KV caches: halves this worker's cache HBM
+        # (each connection gets fresh quantized buffers, same isolation)
+        self.kv_quant = kv_quant
         indices = self.node.layer_indices()
         if not indices:
             raise ValueError(f"worker '{name}' has no layers assigned")
@@ -149,7 +153,8 @@ class Worker:
         # fresh per-connection caches: isolation over synchronization
         caches = {
             (lo, hi): init_cache(
-                self.config, batch=1, max_seq=self.max_seq, num_layers=hi - lo
+                self.config, batch=1, max_seq=self.max_seq,
+                num_layers=hi - lo, quant=self.kv_quant,
             )
             for lo, hi in self.runs
         }

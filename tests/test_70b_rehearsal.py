@@ -59,6 +59,13 @@ def test_70b_hbm_budget_configs_4_and_5():
     # embed replication is the floor)
     int8_tp2 = hbm_budget(cfg, num_stages=8, tp=2, quant="int8")
     assert int8_tp2["total"] < 0.55 * V5E_USABLE
+    # serving tier at batch 32 / 8K window: the int8 KV cache returns
+    # multi-GiB of per-chip headroom that bf16 KV burns
+    bf16_kv = hbm_budget(cfg, num_stages=16, tp=1, quant="int8", batch=32)
+    int8_kv = hbm_budget(cfg, num_stages=16, tp=1, quant="int8", batch=32,
+                         cache_bytes_per_el=1)
+    assert bf16_kv["total"] - int8_kv["total"] > 2.0 * 2**30
+    assert int8_kv["total"] < 0.75 * V5E_USABLE
 
 
 _SCRIPT = r"""
@@ -83,6 +90,17 @@ for stages, tp in ((16, 1), (8, 2)):
     got = [g.next_token(i).id for i in range(6)]
     assert got == want, (stages, tp, got, want)
     print(f"stage={stages} tp={tp} ok", flush=True)
+# config-5 serving tier: int8 weights + int8 KV on the 16-stage layout,
+# parity with the single-device int8-KV oracle
+g_local8 = LlamaGenerator(cfg, params, settings=settings, kv_quant="int8")
+g_local8.set_prompt([5, 9, 2, 11])
+want8 = [g_local8.next_token(i).id for i in range(6)]
+g8 = MeshGenerator(cfg, params, settings=settings, num_stages=16,
+                   kv_quant="int8")
+g8.set_prompt([5, 9, 2, 11])
+got8 = [g8.next_token(i).id for i in range(6)]
+assert got8 == want8, (got8, want8)
+print("stage=16 int8-kv ok", flush=True)
 print("70b-geometry rehearsal ok")
 """
 

@@ -389,6 +389,29 @@ def test_enqueue_with_dp_sharded_batch(params):
     assert admitted == solo.generate(len(admitted))[0][: len(admitted)]
 
 
+def test_serving_stats_track_dispatches_and_tokens(params):
+    """stats() reports the serving counters: emitted tokens, decode and
+    admission dispatch counts, tokens-per-dispatch, and throughput."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1, block_size=4)
+    g.set_prompts(PROMPTS[:2])
+    for _ in range(9):
+        g.step()
+    g.streams[0].done = True
+    g.enqueue([2, 8, 1], stream_id=5)
+    for _ in range(4):
+        g.step()
+    st = g.stats()
+    assert st["tokens_emitted"] > 0
+    # 2 streams x 9 steps + admission-era rows, all accounted
+    assert st["decode_dispatches"] >= 2  # ceil(8/4) blocks at minimum
+    assert st["admit_dispatches"] == 1
+    assert st["tokens_per_dispatch"] > 1  # block fusion amortizes
+    assert st["busy_s"] > 0 and st["wall_s"] >= st["busy_s"] * 0.5
+    assert st["aggregate_tok_s"] > 0
+    assert st["streams_live"] >= 1 and st["pending_admissions"] == 0
+
+
 def test_batch_padding_to_dp_multiple(params):
     """3 prompts on dp=2 pad to 4 rows with an inactive dummy; outputs still
     match, dummy never surfaces."""

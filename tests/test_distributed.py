@@ -144,6 +144,32 @@ def test_generator_reuse_reconnects(params):
     w.shutdown()
 
 
+def test_worker_int8_kv_serves_deterministically(params):
+    """A worker can hold its per-connection KV caches in int8 (half the
+    cache HBM on that host); generation is deterministic and per-connection
+    isolation still holds (reconnect -> identical stream)."""
+    w = Worker(
+        "all", CFG, Topology.from_dict({"all": {"layers": ["model.layers.0-3"]}}),
+        _loader(params), address="127.0.0.1:0", max_seq=CFG.max_seq_len,
+        kv_quant="int8",
+    )
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "all": {"host": f"127.0.0.1:{w.port}", "layers": ["model.layers.0-3"]},
+    })
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    runners = build_runners(CFG, topo, _loader(params))
+    g = DistributedGenerator(CFG, _head_params(params), runners,
+                             settings=settings)
+    g.set_prompt([5, 9, 2])
+    first = [g.next_token(i).id for i in range(6)]
+    g.set_prompt([5, 9, 2])  # reconnect -> fresh int8 caches
+    second = [g.next_token(i).id for i in range(6)]
+    assert first == second and len(first) == 6
+    g.close()
+    w.shutdown()
+
+
 def test_handshake_warns_on_version_skew(params, monkeypatch, caplog):
     """A skewed master/worker pair must not handshake silently
     (proto/message.rs:37-53 carries version for exactly this)."""
