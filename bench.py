@@ -361,9 +361,12 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     stream_len = int(os.environ.get("CAKE_BENCH_STREAM_LEN", "64"))
     admits = int(os.environ.get("CAKE_BENCH_ADMITS", str(batch)))
     settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    # largest divisor of the window <= 512 (admit_chunk must divide it)
+    admit_chunk = max(c for c in range(1, min(512, config.max_seq_len) + 1)
+                      if config.max_seq_len % c == 0)
     gen = BatchGenerator(config, params, settings=settings,
                          block_size=multistep, kv_quant=kv_quant,
-                         admit_chunk=min(512, config.max_seq_len))
+                         admit_chunk=admit_chunk)
     base = [5, 9, 2, 4, 8, 1, 3, 7]
     gen.set_prompts([list(base) for _ in range(batch)])
     for _ in range(3):  # compile + warm-up

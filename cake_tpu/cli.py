@@ -85,7 +85,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="n-gram speculative decoding: propose K tokens per "
                         "round from the context's own n-grams and verify "
                         "them in one dispatch (greedy only: requires "
-                        "--temperature 0; local path)")
+                        "--temperature 0; local and mesh --stages/--tp "
+                        "paths)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -306,10 +307,13 @@ def run_master(args) -> int:
             )
         topo_mesh = bool(with_dev)
     use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
-    if args.speculate and (use_mesh or args.topology):
-        sys.exit("error: --speculate runs the all-local path; it is not "
-                 "supported with --stages/--tp/--sp or --topology (it "
+    if args.speculate and (args.sp > 1 or args.topology):
+        sys.exit("error: --speculate runs the local or mesh (stages/tp) "
+                 "paths; it is not supported with --sp or --topology (it "
                  "would otherwise be silently ignored)")
+    if args.speculate and args.prefill_chunks > 1:
+        sys.exit("error: --prefill-chunks does not compose with "
+                 "--speculate yet")
     if args.speculate and args.decode_block is not None:
         sys.exit("error: --decode-block does not compose with --speculate "
                  "(speculative rounds replace fused-block dispatches; the "
@@ -367,12 +371,22 @@ def run_master(args) -> int:
             args.model, config, plan.mesh, quantize=args.quantize,
             tie_word_embeddings=config.tie_word_embeddings)
         try:
-            gen = MeshGenerator(config, params, plan=plan,
-                                tokenizer=tokenizer, settings=settings,
-                                max_seq=args.max_seq,
-                                block_size=decode_block,
-                                prefill_chunks=args.prefill_chunks,
-                                kv_quant=args.kv_quant)
+            if args.speculate:
+                from cake_tpu.runtime.speculative import (
+                    MeshSpeculativeGenerator,
+                )
+
+                gen = MeshSpeculativeGenerator(
+                    config, params, plan=plan, tokenizer=tokenizer,
+                    settings=settings, max_seq=args.max_seq,
+                    kv_quant=args.kv_quant, spec_k=args.speculate)
+            else:
+                gen = MeshGenerator(config, params, plan=plan,
+                                    tokenizer=tokenizer, settings=settings,
+                                    max_seq=args.max_seq,
+                                    block_size=decode_block,
+                                    prefill_chunks=args.prefill_chunks,
+                                    kv_quant=args.kv_quant)
         except ValueError as e:
             sys.exit(f"error: {e}")
     elif args.topology:

@@ -385,6 +385,55 @@ def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
     return jax.jit(sharded, donate_argnums=(2,))
 
 
+def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
+                         params_like: dict | None = None,
+                         kv_quant: str | None = None):
+    """Compile the speculation-verification pass over the mesh: forward
+    ``tokens [1, T]`` (the last emitted token + K proposals) from position
+    ``pos`` and return logits at EVERY position (``[T, vocab] f32``) — the
+    multi-chip twin of :func:`cake_tpu.runtime.speculative.verify_fn`.
+    KV for all T slots is written; slots past the accepted frontier hold
+    rejected garbage that later steps overwrite before it becomes
+    attendable. Requires ``plan.dp == 1`` and ``plan.sp == 1`` (the
+    single-stream speculation plane).
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if plan.sp != 1 or plan.dp != 1:
+        raise ValueError("speculative verification requires dp == 1 and "
+                         "sp == 1 (single-stream plane)")
+
+    def step(params, tokens, cache, pos):
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq, config.rope_theta,
+            scaling=config.rope_scaling,
+        )
+        x = params["embed"][tokens].astype(config.jax_dtype)
+        x, ck, cv = _pipeline_layers(
+            x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
+            plan.num_stages, heads_l, kv_heads_l,
+        )
+        x = _select_stage0(x[0])  # [T, hidden], valid on stage 0
+        logits = _head_logits(params, x, config)  # [T, vocab] f32
+        return logits, KVCache(k=ck, v=cv)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(params_like),
+            P(None, None),
+            cache_specs(kv_quant),
+            P(),
+        ),
+        out_specs=(
+            P(None, None),
+            cache_specs(kv_quant),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
                           params_like: dict | None = None,
                           microbatch: int = 1,

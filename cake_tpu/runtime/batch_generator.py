@@ -382,6 +382,7 @@ class BatchGenerator:
             jnp.asarray([len(st["ids"]) - 1 - pos if final else 0],
                         jnp.int32),
         )
+        np.asarray(logits.ravel()[:1])  # sync: busy_s must include compute
         self._n_admit_dispatches += 1
         self._busy_s += time.perf_counter() - t0
         st["pos"] = pos + chunk
@@ -551,12 +552,13 @@ class BatchGenerator:
             jnp.asarray(self._pos), self._keys, self._history,
             self._hist_slot, jnp.asarray(self._index),
         )
+        row = np.asarray(tok)  # sync: dispatch is async, busy_s needs compute
         self._n_decode_dispatches += 1
         self._busy_s += time.perf_counter() - t0
         self._pos = self._pos + 1
         self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)
-        return self._emit(np.asarray(tok))
+        return self._emit(row)
 
     def stats(self) -> dict:
         """Serving counters (the reference's worker ops/s + master tok/s

@@ -38,6 +38,7 @@ from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.runtime.generator import LlamaGenerator
+from cake_tpu.runtime.mesh_generator import MeshGenerator
 
 
 def ngram_propose(context: list[int], n_max: int, k: int) -> list[int]:
@@ -114,42 +115,31 @@ def accept_fn(
     return toks, count, history, hist_slot
 
 
-class SpeculativeGenerator(LlamaGenerator):
-    """Greedy single-stream generator with prompt-lookup speculation.
+class SpeculativeMixin:
+    """The speculation loop, shared by the single-chip and mesh
+    generators. Subclasses build ``self._verify`` (a compiled
+    ``(params, tokens [1, T], cache, pos) -> (logits [T, vocab], cache)``
+    program) in their constructors and inherit a ``GeneratorBase``-family
+    ``next_token`` used for the prefill step and the no-proposal
+    fallback."""
 
-    ``spec_k`` tokens are proposed per round (n-grams up to ``spec_ngram``
-    long); each round is one verification dispatch emitting 1..K+1 tokens.
-    When no proposal exists (or the window tail is near), falls back to the
-    plain single-step program. ``dispatches``/``emitted`` counters expose
-    the speedup structure (tokens-per-dispatch > 1 is the win)."""
+    def _verify_dispatch(self, fed: np.ndarray, pos: int) -> jax.Array:
+        logits, self.cache = self._verify(
+            self.params, jnp.asarray(fed), self.cache, jnp.int32(pos)
+        )
+        return logits
 
-    def __init__(
-        self,
-        config: LlamaConfig,
-        params,
-        tokenizer=None,
-        settings: SamplerSettings | None = None,
-        max_seq: int | None = None,
-        kv_quant: str | None = None,
-        spec_k: int = 8,
-        spec_ngram: int = 3,
-    ):
-        settings = settings or SamplerSettings(temperature=0.0)
-        if settings.temperature > 0:
+    def _spec_init(self, spec_k: int, spec_ngram: int) -> None:
+        if self.settings.temperature > 0:
             raise ValueError(
                 "speculative decoding is exact only for greedy streams; "
                 "use temperature 0 (sampled streams would need rejection "
                 "sampling to preserve the output distribution)"
             )
-        super().__init__(config, params, tokenizer=tokenizer,
-                         settings=settings, max_seq=max_seq,
-                         kv_quant=kv_quant, block_size=1)
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
         if self.spec_k < 1:
             raise ValueError("spec_k must be >= 1")
-        self._verify = jax.jit(partial(verify_fn, config=config),
-                               donate_argnames=("cache",))
         eos = sorted(self._eos_ids) or [-1]
         self._eos_arr = jnp.asarray(eos, jnp.int32)
         self._accept = jax.jit(partial(accept_fn, settings=self.settings))
@@ -176,9 +166,7 @@ class SpeculativeGenerator(LlamaGenerator):
         fed[0, 1: 1 + len(proposal)] = proposal
         padded = np.full((self.spec_k,), -1, np.int32)
         padded[: len(proposal)] = proposal
-        logits, self.cache = self._verify(
-            self.params, jnp.asarray(fed), self.cache, jnp.int32(self._pos)
-        )
+        logits = self._verify_dispatch(fed, self._pos)
         toks, count, self._history, self._hist_slot = self._accept(
             logits, jnp.asarray(padded), self._history, self._hist_slot,
             self._eos_arr,
@@ -193,3 +181,67 @@ class SpeculativeGenerator(LlamaGenerator):
         self._pos += n
         self._block_buf = emitted[1:]
         return self._finish_token(emitted[0])
+
+
+class SpeculativeGenerator(SpeculativeMixin, LlamaGenerator):
+    """Greedy single-stream generator with prompt-lookup speculation.
+
+    ``spec_k`` tokens are proposed per round (n-grams up to ``spec_ngram``
+    long); each round is one verification dispatch emitting 1..K+1 tokens.
+    When no proposal exists (or the window tail is near), falls back to the
+    plain single-step program. ``dispatches``/``emitted`` counters expose
+    the speedup structure (tokens-per-dispatch > 1 is the win)."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        kv_quant: str | None = None,
+        spec_k: int = 8,
+        spec_ngram: int = 3,
+    ):
+        settings = settings or SamplerSettings(temperature=0.0)
+        super().__init__(config, params, tokenizer=tokenizer,
+                         settings=settings, max_seq=max_seq,
+                         kv_quant=kv_quant, block_size=1)
+        self._spec_init(spec_k, spec_ngram)
+        self._verify = jax.jit(partial(verify_fn, config=config),
+                               donate_argnames=("cache",))
+
+
+class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
+    """Prompt-lookup speculation over the single-program mesh pipeline:
+    the verification pass runs as ONE compiled program across the
+    (stage, tp) mesh (``parallel.pipeline.build_sharded_verify``), so
+    multi-chip decode also lands 1..K+1 tokens per dispatch. Same
+    greedy-exactness contract as the single-chip variant."""
+
+    def __init__(
+        self,
+        config: LlamaConfig,
+        params,
+        plan=None,
+        tokenizer=None,
+        settings: SamplerSettings | None = None,
+        max_seq: int | None = None,
+        num_stages: int = 1,
+        tp: int = 1,
+        devices=None,
+        kv_quant: str | None = None,
+        spec_k: int = 8,
+        spec_ngram: int = 3,
+    ):
+        from cake_tpu.parallel.pipeline import build_sharded_verify
+
+        settings = settings or SamplerSettings(temperature=0.0)
+        super().__init__(config, params, plan=plan, tokenizer=tokenizer,
+                         settings=settings, max_seq=max_seq,
+                         num_stages=num_stages, tp=tp, sp=1,
+                         devices=devices, block_size=1, kv_quant=kv_quant)
+        self._spec_init(spec_k, spec_ngram)
+        self._verify = build_sharded_verify(
+            config, self.plan, params_like=self.params, kv_quant=kv_quant
+        )

@@ -232,9 +232,38 @@ def test_speculate_flag_runs_and_guards(model_dir):
     assert r.returncode != 0 and "greedy" in r.stderr
     r = _run_cli([
         "--model", str(model_dir), "--prompt-ids", "3,5,7", "-n", "2",
-        "--temperature", "0", "--cpu", "--speculate", "4", "--stages", "2",
+        "--temperature", "0", "--cpu", "--speculate", "4", "--sp", "2",
     ])
     assert r.returncode != 0 and "--speculate" in r.stderr
+
+
+def test_speculate_runs_on_mesh_pipeline(model_dir):
+    """--speculate composes with --stages/--tp: the verification pass runs
+    as one program over the mesh and the token stream matches the plain
+    mesh run."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    argv = ["--model", str(model_dir), "--prompt-ids", "3,5,7,3,5,7",
+            "-n", "8", "--temperature", "0", "--max-seq", "64", "--cpu",
+            "--stages", "2", "--tp", "2"]
+    plain = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli"] + argv,
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    spec = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.cli"] + argv + ["--speculate", "4"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert plain.returncode == 0, plain.stderr
+    assert spec.returncode == 0, spec.stderr
+
+    def toks(out):
+        return [l for l in out.splitlines()
+                if l and all(c.isdigit() or c == "," for c in l)][-1]
+
+    assert toks(spec.stdout) == toks(plain.stdout)
 
 
 def test_profile_flag_writes_trace(model_dir, tmp_path):
