@@ -437,7 +437,8 @@ def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
                           params_like: dict | None = None,
                           microbatch: int = 1,
-                          kv_quant: str | None = None):
+                          kv_quant: str | None = None,
+                          with_offset: bool = False):
     """Compile the multi-chip prompt pass.
 
     Signature: ``(params, tokens [B, T], cache, last_index [B]) ->
@@ -456,6 +457,13 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     split into M chunks that stream through the stages concurrently
     (:func:`_pipelined_prefill_layers`) — ~num_stages× prompt throughput
     once the pipeline fills, identical results.
+
+    ``with_offset = True`` (requires ``sp == 1``, ``microbatch == 1``)
+    appends a trailing scalar ``pos0`` argument: the fed tokens occupy
+    global positions ``pos0..pos0+T-1`` and attend the cache's committed
+    positions below ``pos0`` — the shared-prefix serving path, where a
+    common system prompt is prefilled once and each stream's remainder is
+    prefilled at the prefix boundary.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     if microbatch > 1 and plan.sp != 1:
@@ -465,8 +473,12 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             "pipelined (microbatch) prefill requires num_stages > 1 — with "
             "one stage there is nothing to overlap, only per-chunk overhead"
         )
+    if with_offset and (plan.sp != 1 or microbatch > 1):
+        raise ValueError("offset prefill requires sp == 1 and "
+                         "microbatch == 1")
 
-    def step(params, tokens, cache, last_index):
+    def step(params, tokens, cache, last_index, *rest):
+        pos0 = rest[0] if with_offset else 0
         cos, sin = rope_tables(
             config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
@@ -495,8 +507,8 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             # ONE-token chunk, which the T>1 heuristic would misroute to the
             # decode branch (silently wrong logits — r2 code-review finding)
             x, ck, cv = _pipeline_layers(
-                x, params["layers"], cache.k, cache.v, cos, sin, 0, config,
-                plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+                x, params["layers"], cache.k, cache.v, cos, sin, pos0,
+                config, plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
                 sp_prefill=True,
             )
         # slice the wanted position first so the cross-stage select moves
@@ -506,15 +518,18 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
         logits = _head_logits(params, x_last, config)
         return logits, KVCache(k=ck, v=cv)
 
+    in_specs = [
+        param_specs(params_like),
+        P(DP, SP),
+        cache_specs(kv_quant),
+        P(DP),
+    ]
+    if with_offset:
+        in_specs.append(P())
     sharded = jax.shard_map(
         step,
         mesh=plan.mesh,
-        in_specs=(
-            param_specs(params_like),
-            P(DP, SP),
-            cache_specs(kv_quant),
-            P(DP),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(
             P(DP, None),
             cache_specs(kv_quant),

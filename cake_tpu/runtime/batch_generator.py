@@ -101,6 +101,7 @@ class BatchGenerator:
         block_size: int = 1,
         kv_quant: str | None = None,
         admit_chunk: int | None = None,
+        prefix_share_min: int = 32,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -156,9 +157,15 @@ class BatchGenerator:
                 "would clamp-overwrite committed KV)"
             )
         self._admit_chunk = admit_chunk
+        # Shared-prefix serving: when every prompt in a batch opens with
+        # the same >= prefix_share_min tokens (the system-prompt case), the
+        # prefix is prefilled once instead of once per stream (0 disables).
+        self._prefix_share_min = max(0, prefix_share_min)
         self._arrivals: list[tuple[list[int], int]] = []
         self._staging: dict | None = None
         self.__admit_prefill = None
+        self.__prefill_offset = None
+        self.__broadcast_progs: dict = {}
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
         # counters plus busy wall-clock, reported by stats().
@@ -167,6 +174,67 @@ class BatchGenerator:
         self._n_emitted = 0
         self._busy_s = 0.0
         self._t_start: float | None = None
+
+    @property
+    def _prefill_offset(self):
+        """Offset prefill program (shared-prefix remainders), compiled on
+        first use."""
+        if self.__prefill_offset is None:
+            self.__prefill_offset = build_sharded_prefill(
+                self.config, self.plan, params_like=self.params,
+                kv_quant=self.kv_quant, with_offset=True,
+            )
+        return self.__prefill_offset
+
+    def _prefill_shared_prefix(self, prefix: list[int], b: int) -> None:
+        """Prefill the common prefix ONCE as a single replicated row (the
+        admission-prefill program, chunked) and broadcast the staged KV
+        into all ``b`` batch rows of ``self.cache``."""
+        chunk = self._admission_chunk_for(len(prefix))
+        t_pad = -(-len(prefix) // chunk) * chunk
+        toks = np.zeros((1, t_pad), np.int32)
+        toks[0, : len(prefix)] = prefix
+        staging = init_cache_on_mesh(
+            self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
+            quant=self.kv_quant, batch_replicated=True,
+        )
+        for pos in range(0, t_pad, chunk):
+            _, staging = self._admit_prefill(
+                self.params, jnp.asarray(toks[:, pos: pos + chunk]),
+                staging, jnp.int32(pos),
+                jnp.asarray([max(0, len(prefix) - 1 - pos)], jnp.int32),
+            )
+            self._n_admit_dispatches += 1
+        self.cache = self._broadcast_prog(b)(staging)
+
+    def _broadcast_prog(self, b: int):
+        """Compiled prefix-row -> batch-cache broadcast, memoized per batch
+        size (a fresh jit closure per call would retrace and recompile on
+        every shared-prefix batch admission)."""
+        prog = self.__broadcast_progs.get(b)
+        if prog is None:
+            from functools import partial
+
+            from jax.sharding import NamedSharding, PartitionSpec
+            from cake_tpu.parallel.mesh import cache_specs
+
+            out_sh = jax.tree.map(
+                lambda s: NamedSharding(self.plan.mesh, s),
+                cache_specs(self.kv_quant),
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+
+            @partial(jax.jit, out_shardings=out_sh)
+            def prog(r):
+                return jax.tree.map(
+                    lambda x: jnp.broadcast_to(
+                        x, (x.shape[0], b) + x.shape[2:]
+                    ),
+                    r,
+                )
+
+            self.__broadcast_progs[b] = prog
+        return prog
 
     @property
     def _admit_prefill(self):
@@ -245,14 +313,38 @@ class BatchGenerator:
             )
         b = len(self.streams)
 
-        # shared prompt bucket; per-stream true positions
+        # Shared-prefix detection: a common system prompt is prefilled ONCE
+        # (single replicated row) and broadcast into every stream's cache
+        # rows; only the per-stream remainders go through the batched
+        # prefill, at offset lcp. Capped one short of the shortest prompt so
+        # every row keeps >= 1 remainder token. Bit-identical output —
+        # positions and tokens are unchanged, only the redundancy goes.
+        lcp = 0
+        if b > 1 and self._prefix_share_min:
+            first = self.streams[0].prompt
+            lcp = min(len(s.prompt) for s in self.streams) - 1
+            for i in range(lcp):
+                if any(s.prompt[i] != first[i] for s in self.streams):
+                    lcp = i
+                    break
+            if lcp < self._prefix_share_min:
+                lcp = 0
+
+        # shared prompt bucket; per-stream true positions (remainder-
+        # relative when a prefix is shared). The remainder bucket is capped
+        # at the room left above the prefix: a write at offset lcp must
+        # never extend past max_seq, or the clamped dynamic_update_slice
+        # would silently overwrite committed prefix KV (the same failure
+        # the admit_chunk divisibility check prevents on the admission
+        # path). The cap still covers every remainder (n_max < max_seq).
         n_max = max(len(s.prompt) for s in self.streams)
-        t_pad = _bucket(n_max, self.max_seq)
+        t_pad = min(_bucket(n_max - lcp, self.max_seq), self.max_seq - lcp)
         tokens = np.zeros((b, t_pad), np.int32)
         last = np.zeros((b,), np.int32)
         for i, s in enumerate(self.streams):
-            tokens[i, : len(s.prompt)] = s.prompt
-            last[i] = len(s.prompt) - 1
+            rem = s.prompt[lcp:]
+            tokens[i, : len(rem)] = rem
+            last[i] = len(rem) - 1
         self._pos = np.asarray([len(s.prompt) for s in self.streams], np.int32)
 
         # per-stream keys + histories seeded with each prompt's tail
@@ -271,18 +363,27 @@ class BatchGenerator:
         self._history = jnp.asarray(hist)
         self._hist_slot = jnp.asarray(slots)
 
-        self.cache = init_cache_on_mesh(
-            self.config, self.plan.mesh, batch=b, max_seq=self.max_seq,
-            quant=self.kv_quant,
-        )
         self._n_decode_dispatches = 0
         self._n_admit_dispatches = 0
         self._n_emitted = 0
         self._busy_s = 0.0
         self._t_start = time.perf_counter()
-        logits, self.cache = self._prefill(
-            self.params, jnp.asarray(tokens), self.cache, jnp.asarray(last)
-        )
+        if lcp:
+            # broadcast of the staged prefix row IS the batch cache
+            self._prefill_shared_prefix(first[:lcp], b)
+            logits, self.cache = self._prefill_offset(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(last), jnp.int32(lcp),
+            )
+        else:
+            self.cache = init_cache_on_mesh(
+                self.config, self.plan.mesh, batch=b, max_seq=self.max_seq,
+                quant=self.kv_quant,
+            )
+            logits, self.cache = self._prefill(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(last)
+            )
 
         # first token per stream: fold_in(stream_key, 0) — the same absolute
         # token-index schedule the in-program decode steps continue

@@ -389,6 +389,81 @@ def test_enqueue_with_dp_sharded_batch(params):
     assert admitted == solo.generate(len(admitted))[0][: len(admitted)]
 
 
+def test_shared_prefix_prefilled_once_bit_identical(params):
+    """Prompts sharing a long common prefix (the system-prompt case):
+    the prefix is prefilled ONCE as a single replicated row and broadcast,
+    remainders prefill at the offset — every stream's tokens are
+    bit-identical to the unshared path, and the batched prefill sees only
+    the remainder lengths."""
+    settings = SamplerSettings(**GREEDY)
+    sys_prompt = [7, 3, 9, 1, 4, 8, 2, 6] * 2  # 16 shared tokens
+    prompts = [sys_prompt + tail
+               for tail in ([5, 9, 2], [3, 1, 4, 1], [8, 8])]
+
+    def run(share_min):
+        g = BG(CFG, params, settings=settings, dp=1, block_size=4,
+               prefix_share_min=share_min)
+        calls = {}
+        orig = g._prefill
+
+        def spy(p, toks, cache, last, *rest):
+            calls["prefill_T"] = toks.shape[1]
+            return orig(p, toks, cache, last, *rest)
+
+        g._prefill = spy
+        g.set_prompts(prompts)
+        return g.generate(8), calls, g
+
+    unshared, calls_u, _ = run(share_min=0)
+    shared, calls_s, g = run(share_min=8)
+    assert shared == unshared
+    # unshared path buckets the FULL prompts; shared path never calls the
+    # plain prefill at all (prefix row + offset remainder program)
+    assert calls_u["prefill_T"] >= 19
+    assert "prefill_T" not in calls_s
+    assert g.stats()["admit_dispatches"] >= 1  # the prefix row dispatch
+
+
+def test_shared_prefix_skips_when_prefix_short_or_absent(params):
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1, prefix_share_min=32)
+    g.set_prompts([[5, 9, 2], [5, 9, 3]])  # 2-token prefix < threshold
+    out = g.generate(6)
+    for prompt, got in zip([[5, 9, 2], [5, 9, 3]], out):
+        assert got == _single_stream(params, prompt, 6, settings)
+
+
+def test_shared_prefix_near_window_does_not_overrun(params):
+    """The remainder bucket is capped at the room above the prefix: a long
+    shared prefix with near-window prompts must not clamp-overwrite
+    committed prefix KV (regression: t_pad bucketed past max_seq - lcp)."""
+    settings = SamplerSettings(**GREEDY)
+    cfg = tiny(max_seq_len=64)
+    prefix = [(i * 7) % 100 + 2 for i in range(40)]
+    prompts = [prefix + [5, 9, 2] * 7 + [1, 2],   # 63 tokens total
+               prefix + [3, 1, 4]]
+    g = BG(cfg, params, settings=settings, dp=1, prefix_share_min=16)
+    g.set_prompts(prompts)
+    out = g.generate(4)
+    for prompt, got in zip(prompts, out):
+        solo = BG(cfg, params, settings=settings, dp=1, prefix_share_min=0)
+        solo.set_prompts([prompt], stream_ids=[prompts.index(prompt)])
+        assert got == solo.generate(4)[0][: len(got)]
+
+
+def test_shared_prefix_with_identical_prompts(params):
+    """All-identical prompts (the dummy-padding shape): lcp caps one short
+    of the prompt so every row keeps a remainder token."""
+    settings = SamplerSettings(**GREEDY)
+    p = [7, 3, 9, 1, 4, 8, 2, 6, 5, 9, 2, 4]
+    g = BG(CFG, params, settings=settings, dp=2, prefix_share_min=4)
+    g.set_prompts([list(p), list(p), list(p)])  # pads to 4 with a dummy
+    out = g.generate(6)
+    want = _single_stream(params, p, 6, settings)
+    for got in out:
+        assert got == want
+
+
 def test_serving_stats_track_dispatches_and_tokens(params):
     """stats() reports the serving counters: emitted tokens, decode and
     admission dispatch counts, tokens-per-dispatch, and throughput."""
