@@ -85,6 +85,20 @@ def _hbm_gbps(device) -> float:
     return _device_spec(device, _HBM_GBPS, 819.0)
 
 
+def _wtag(quant: str, kv_quant: str | None) -> str:
+    """Metric tag for the weight/KV dtype combination."""
+    tag = "int8" if quant == "int8" else "bf16"
+    return tag + "_kv8" if kv_quant else tag
+
+
+def _matmul_flops(params, config, t: int) -> float:
+    """Matmul FLOPs of a T-token prompt pass: 2 * matmul-params * T. The
+    embed table is a lookup, not a matmul, so it is excluded; attention
+    FLOPs are also excluded — conservative for MFU-style ratios."""
+    n = sum(x.size for x in jax.tree.leaves(params))
+    return 2.0 * (n - config.vocab_size * config.hidden_size) * t
+
+
 def _kv_quant() -> str | None:
     """CAKE_BENCH_KV=int8: run with the quantized KV cache (half the cache
     HBM -> roughly double the servable batch x window on a fixed budget).
@@ -216,16 +230,9 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
         dts.append(time.perf_counter() - t0)
     dt = sum(dts) / iters
 
-    wtag = "int8" if quant == "int8" else "bf16"
-    if kv_quant:
-        wtag += "_kv8"
+    wtag = _wtag(quant, kv_quant)
     # vs_baseline: fraction of the chip's bf16 peak the prompt pass sustains
-    # (2 * matmul-params * T flops: the embed table is a lookup, not a
-    # matmul, so it is excluded from the numerator; attention flops are
-    # also excluded — conservative)
-    flops = 2.0 * (sum(
-        x.size for x in jax.tree.leaves(params)
-    ) - config.vocab_size * config.hidden_size) * t
+    flops = _matmul_flops(params, config, t)
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
     print(json.dumps({
         "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",
@@ -328,9 +335,7 @@ def _run_batched(config, params, preset, quant, settings, dev,
     agg_tok_s = dispatches * per * batch / dt
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb  # single-stream weights-bound ideal
-    wtag = "int8" if quant == "int8" else "bf16"
-    if kv_quant:
-        wtag += "_kv8"
+    wtag = _wtag(quant, kv_quant)
     print(json.dumps({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_b{batch}",
         "value": round(agg_tok_s, 3),
@@ -342,6 +347,53 @@ def _run_batched(config, params, preset, quant, settings, dev,
         f"single-stream roofline={roofline:.1f}tok/s "
         f"per-stream {agg_tok_s / batch:.1f}tok/s ttft_cold={ttft_s:.2f}s "
         f"timed_tokens={dispatches * per * batch} multistep={per}\n"
+    )
+    return 0
+
+
+def _run_ttft(config, params, preset, quant, dev) -> int:
+    """CAKE_BENCH_TTFT=1: p50/p95 time-to-first-token at CAKE_BENCH_SEQ/2
+    prompt length — warm prefill + first-token sample per trial, the
+    latency metric BASELINE.json names alongside tok/s (the reference
+    never measures TTFT at all; its master only logs steady-state
+    tokens/sec, master.rs:57-65)."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    kv_quant = _kv_quant()
+    trials = int(os.environ.get("CAKE_BENCH_TTFT_TRIALS", "16"))
+    t = config.max_seq_len // 2
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = LlamaGenerator(config, params, settings=settings,
+                         kv_quant=kv_quant)
+    rng = np.random.default_rng(0)
+    prompt0 = rng.integers(1, config.vocab_size, t).tolist()
+    gen.set_prompt(prompt0)
+    gen.next_token(0)  # compile + warm
+    lat = []
+    for i in range(trials):
+        prompt = rng.integers(1, config.vocab_size, t).tolist()
+        gen.set_prompt(prompt)
+        t0 = time.perf_counter()
+        tok = gen.next_token(0)
+        lat.append(time.perf_counter() - t0)
+        assert tok.id >= 0
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[min(len(lat) - 1, int(len(lat) * 0.95))]
+    wtag = _wtag(quant, kv_quant)
+    # vs_baseline: how close the warm prompt pass runs to the chip's peak
+    flops = _matmul_flops(params, config, t)
+    peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
+    print(json.dumps({
+        "metric": f"ttft_p50_ms_llama_{preset}_{wtag}_1chip_t{t}",
+        "value": round(p50 * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(flops / p50 / peak, 4),
+    }))
+    sys.stderr.write(
+        f"device={dev.device_kind} T={t} trials={trials} "
+        f"p50={p50 * 1e3:.1f}ms p95={p95 * 1e3:.1f}ms\n"
     )
     return 0
 
@@ -398,9 +450,7 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     agg = emitted / dt
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb
-    wtag = "int8" if quant == "int8" else "bf16"
-    if kv_quant:
-        wtag += "_kv8"
+    wtag = _wtag(quant, kv_quant)
     print(json.dumps({
         "metric": (f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_"
                    f"b{batch}_churn"),
@@ -451,9 +501,7 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     accept = timed / max(1, gen.dispatches - d0)
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb
-    wtag = "int8" if quant == "int8" else "bf16"
-    if kv_quant:
-        wtag += "_kv8"
+    wtag = _wtag(quant, kv_quant)
     print(json.dumps({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_spec{k}",
         "value": round(tok_s, 3),
@@ -604,6 +652,8 @@ def main() -> int:
     batch = int(os.environ.get("CAKE_BENCH_BATCH", "1"))
     if os.environ.get("CAKE_BENCH_PREFILL") == "1":
         return _run_prefill(config, params, preset, quant, dev)
+    if os.environ.get("CAKE_BENCH_TTFT") == "1":
+        return _run_ttft(config, params, preset, quant, dev)
     if os.environ.get("CAKE_BENCH_SPEC"):
         return _run_speculative(config, params, preset, quant, dev, steps)
     if os.environ.get("CAKE_BENCH_CHURN") == "1":
@@ -685,9 +735,7 @@ def main() -> int:
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb  # ideal decode tok/s (weights-bound)
 
-    wtag = "int8" if quant == "int8" else "bf16"
-    if kv_quant:
-        wtag += "_kv8"
+    wtag = _wtag(quant, kv_quant)
     print(json.dumps({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip",
         "value": round(toks_per_s, 3),

@@ -39,7 +39,11 @@ a stream's low-order logit bits can differ between batch-size *buckets*
 (e.g. batch 8 vs 16), which near a top-k/top-p boundary may flip a sampled
 token. Within a fixed batch size the invariants hold exactly; set
 ``CAKE_PALLAS=0`` to pin one backend and recover strict cross-bucket
-reproducibility. bf16 weights are unaffected.
+reproducibility. bf16 weights are unaffected. The same caveat applies to
+admission-prefill geometry: a prefix-cache hit prefills only the arrival's
+remainder (fewer matmul rows than the from-scratch pass), so int8 weights
++ temperature > 0 can flip a near-boundary sampled token depending on
+whether the prefix matched. Greedy streams are exact in all cases.
 """
 
 from __future__ import annotations
@@ -166,6 +170,9 @@ class BatchGenerator:
         self.__admit_prefill = None
         self.__prefill_offset = None
         self.__broadcast_progs: dict = {}
+        # shared-prefix KV row cached for arrival reuse (set_prompts fills
+        # it when prefix sharing kicks in): {"ids": [...], "row": cache}
+        self._prefix_cache: dict | None = None
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
         # counters plus busy wall-clock, reported by stats().
@@ -205,6 +212,9 @@ class BatchGenerator:
                 jnp.asarray([max(0, len(prefix) - 1 - pos)], jnp.int32),
             )
             self._n_admit_dispatches += 1
+        # keep the staged prefix row: arrivals opening with the same
+        # prefix start from a copy of it instead of re-prefilling
+        self._prefix_cache = {"ids": list(prefix), "row": staging}
         self.cache = self._broadcast_prog(b)(staging)
 
     def _broadcast_prog(self, b: int):
@@ -319,6 +329,7 @@ class BatchGenerator:
         # prefill, at offset lcp. Capped one short of the shortest prompt so
         # every row keeps >= 1 remainder token. Bit-identical output —
         # positions and tokens are unchanged, only the redundancy goes.
+        self._prefix_cache = None
         lcp = 0
         if b > 1 and self._prefix_share_min:
             first = self.streams[0].prompt
@@ -439,7 +450,11 @@ class BatchGenerator:
         """Compile the admission-prefill program (and staging-cache zeros
         program) for prompts of this length, outside any serving-critical
         window — benchmarks/servers call this once so the first real
-        ``enqueue`` does not pay XLA compilation mid-run."""
+        ``enqueue`` does not pay XLA compilation mid-run. The compiled
+        shape depends only on the chunk for ``prompt_len``; with prefix
+        sharing active, call again with the expected REMAINDER length
+        (arrival length minus the shared prefix), since that is the shape
+        a prefix-cache hit dispatches."""
         chunk = self._admission_chunk_for(prompt_len)
         staging = init_cache_on_mesh(
             self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
@@ -458,30 +473,53 @@ class BatchGenerator:
             if not self._arrivals or self._free_slot() is None:
                 return
             ids, sid = self._arrivals.pop(0)
-            chunk = self._admission_chunk_for(len(ids))
-            t_pad = -(-len(ids) // chunk) * chunk
+            # Prefix reuse: an arrival that opens with the batch's cached
+            # shared prefix starts from a COPY of the staged prefix row
+            # (one cheap buffer copy) and prefills only its remainder —
+            # every arrival re-prefilling the system prompt is exactly the
+            # waste the prefix cache exists to kill. Falls back to a
+            # from-scratch prefill when the remainder's bucket would not
+            # fit above the prefix.
+            base = 0
+            pfx = self._prefix_cache
+            if (pfx is not None and len(ids) > len(pfx["ids"])
+                    and ids[: len(pfx["ids"])] == pfx["ids"]):
+                base = len(pfx["ids"])
+            rem = len(ids) - base
+            chunk = self._admission_chunk_for(rem)
+            t_pad = -(-rem // chunk) * chunk
+            if base and base + t_pad > self.max_seq:
+                base = 0
+                rem = len(ids)
+                chunk = self._admission_chunk_for(rem)
+                t_pad = -(-rem // chunk) * chunk
             tokens = np.zeros((1, t_pad), np.int32)
-            tokens[0, : len(ids)] = ids
-            self._staging = {
-                "ids": ids, "sid": sid, "slot": self._free_slot(),
-                "tokens": tokens, "pos": 0, "chunk": chunk,
-                "cache": init_cache_on_mesh(
+            tokens[0, :rem] = ids[base:]
+            if base:
+                cache = jax.tree.map(lambda x: x.copy(), pfx["row"])
+            else:
+                cache = init_cache_on_mesh(
                     self.config, self.plan.mesh, batch=1,
                     max_seq=self.max_seq, quant=self.kv_quant,
                     batch_replicated=True,
-                ),
+                )
+            self._staging = {
+                "ids": ids, "sid": sid, "slot": self._free_slot(),
+                "tokens": tokens, "pos": 0, "chunk": chunk, "base": base,
+                "cache": cache,
             }
         st = self._staging
-        pos, chunk = st["pos"], st["chunk"]
+        pos, chunk, base = st["pos"], st["chunk"], st["base"]
         final = pos + chunk >= st["tokens"].shape[1]
         t0 = time.perf_counter()
         logits, st["cache"] = self._admit_prefill(
             self.params,
             jnp.asarray(st["tokens"][:, pos: pos + chunk]),
             st["cache"],
-            jnp.int32(pos),
-            jnp.asarray([len(st["ids"]) - 1 - pos if final else 0],
-                        jnp.int32),
+            jnp.int32(base + pos),
+            jnp.asarray(
+                [len(st["ids"]) - 1 - base - pos if final else 0], jnp.int32
+            ),
         )
         np.asarray(logits.ravel()[:1])  # sync: busy_s must include compute
         self._n_admit_dispatches += 1

@@ -433,6 +433,55 @@ def test_shared_prefix_skips_when_prefix_short_or_absent(params):
         assert got == _single_stream(params, prompt, 6, settings)
 
 
+def test_arrival_reuses_cached_prefix_row(params):
+    """An enqueued arrival that opens with the batch's shared prefix
+    starts from a copy of the cached prefix KV row and prefills only its
+    remainder — fewer admission dispatches, tokens bit-identical to the
+    from-scratch admission."""
+    settings = SamplerSettings(**GREEDY)
+    prefix = [(i * 7) % 100 + 2 for i in range(16)]
+    prompts = [prefix + [5, 9, 2], prefix + [3, 1, 4]]
+    new_prompt = prefix + [8, 8, 4]
+
+    def run(share_min):
+        g = BG(CFG, params, settings=settings, dp=1, admit_chunk=8,
+               prefix_share_min=share_min)
+        g.set_prompts(prompts)
+        g.step()
+        g.streams[0].done = True
+        d0 = g.stats()["admit_dispatches"]
+        g.enqueue(list(new_prompt), stream_id=9)
+        rows = [g.step() for _ in range(8)]
+        toks = [r[0].id for r in rows if r[0] is not None]
+        return toks, g.stats()["admit_dispatches"] - d0
+
+    toks_scratch, n_scratch = run(share_min=0)
+    toks_reuse, n_reuse = run(share_min=8)
+    solo = BG(CFG, params, settings=settings, dp=1)
+    solo.set_prompts([list(new_prompt)], stream_ids=[9])
+    want = solo.generate(12)[0]
+    # same stream either way; the reuse run admits earlier so the same
+    # step budget yields MORE of it
+    assert toks_scratch == want[: len(toks_scratch)]
+    assert toks_reuse == want[: len(toks_reuse)]
+    assert len(toks_reuse) >= len(toks_scratch)
+    # scratch prefills ceil(19/8)=3 chunks; reuse only the 3-token
+    # remainder (1 chunk)
+    assert n_scratch == 3 and n_reuse == 1
+    # non-matching arrival falls back to from-scratch admission
+    g = BG(CFG, params, settings=settings, dp=1, admit_chunk=8,
+           prefix_share_min=8)
+    g.set_prompts(prompts)
+    g.step()
+    g.streams[0].done = True
+    g.enqueue([4, 4, 4, 4], stream_id=7)
+    rows = [g.step() for _ in range(6)]
+    toks = [r[0].id for r in rows if r[0] is not None]
+    solo = BG(CFG, params, settings=settings, dp=1)
+    solo.set_prompts([[4, 4, 4, 4]], stream_ids=[7])
+    assert toks == solo.generate(len(toks))[0][: len(toks)]
+
+
 def test_shared_prefix_near_window_does_not_overrun(params):
     """The remainder bucket is capped at the room above the prefix: a long
     shared prefix with near-window prompts must not clamp-overwrite
