@@ -38,8 +38,14 @@ bench:
 kernel-check:
 	$(PY) -m cake_tpu.tools.kernel_check --json-out KERNELS_TPU.json
 
+flash-sweep:
+	$(PY) -m cake_tpu.tools.flash_sweep --json-out flash_sweep.json
+
+ttft:
+	CAKE_BENCH_TTFT=1 $(PY) bench.py
+
 clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench clean
+.PHONY: test lint native bench kernel-check flash-sweep ttft clean

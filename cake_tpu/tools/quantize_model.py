@@ -1,0 +1,159 @@
+"""quantize-model: write a pre-quantized int8 checkpoint.
+
+Quantize-on-load (``--quantize int8``) re-runs per-channel quantization on
+every start — minutes of host work for 70B-class checkpoints, on every
+host. This tool pays that cost ONCE, offline (the same role the reference's
+`cake-split-model` plays for layer filtering, main.rs:144-223): each linear
+is quantized per-output-channel (the one convention, ops/quant.py) and
+stored as two tensors
+
+    <hf_name>.q8     int8, HF [out, in] orientation
+    <hf_name>.scale  f32 [out]
+
+alongside the untouched norms/embedding. Loaders (utils/weights.py,
+utils/sharded_load.py) detect the ``.q8`` names and read the int8 bytes
+directly — startup reads half the bytes and does zero quantize compute,
+and sharded loads slice the stored scales instead of reading full weights.
+Like the reference splitter, the written file is verified by re-loading it.
+
+Usage:
+  python -m cake_tpu.tools.quantize_model \\
+      --model-path /path/to/llama --output /path/to/llama-int8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from cake_tpu.ops.quant import LAYER_LINEARS, quantize_linear_np
+from cake_tpu.utils.weights import _LAYER_MAP, load_safetensors_index
+
+# HF names of quantizable linears (torch [out, in] orientation), DERIVED
+# from the single source of truth (weights._LAYER_MAP filtered by
+# quant.LAYER_LINEARS) so a future linear cannot drift out of sync between
+# this tool and the loaders; everything else (norms, embedding) passes
+# through unchanged
+_LINEAR_SUFFIXES = tuple(_LAYER_MAP[k][0] for k in LAYER_LINEARS)
+
+
+def _is_linear(name: str) -> bool:
+    return (name == "lm_head.weight"
+            or any(name.endswith(s) for s in _LINEAR_SUFFIXES))
+
+
+def quantize_checkpoint(model_path: str | Path, output: str | Path,
+                        shard_bytes: int = 4 << 30) -> Path:
+    """Quantize every linear of the checkpoint at ``model_path`` into
+    ``output`` (config/tokenizer copied alongside); returns ``output``.
+
+    Output is written incrementally in ~``shard_bytes`` safetensors shards
+    — host RAM is bounded by one shard, not the checkpoint (a 70B-class
+    model never materializes in memory)."""
+    from safetensors import safe_open
+    from safetensors.numpy import save_file
+
+    model_path, output = Path(model_path), Path(output)
+    output.mkdir(parents=True, exist_ok=True)
+    name_to_file = load_safetensors_index(model_path)
+
+    handles: dict[Path, object] = {}
+
+    def get(name: str) -> np.ndarray:
+        f = name_to_file[name]
+        if f not in handles:
+            handles[f] = safe_open(f, framework="np")
+        return handles[f].get_tensor(name)
+
+    n_q = 0
+    total = 0
+    weight_map: dict[str, str] = {}
+    pending: dict[str, np.ndarray] = {}
+    pending_bytes = 0
+    shard_idx = 0
+
+    def flush():
+        nonlocal pending, pending_bytes, shard_idx
+        if not pending:
+            return
+        fname = f"model-{shard_idx:05d}.safetensors"
+        save_file(pending, output / fname)
+        for k in pending:
+            weight_map[k] = fname
+        shard_idx += 1
+        pending = {}
+        pending_bytes = 0
+
+    def emit(name: str, arr: np.ndarray):
+        nonlocal pending_bytes, total
+        pending[name] = arr
+        pending_bytes += arr.nbytes
+        total += arr.nbytes
+        if pending_bytes >= shard_bytes:
+            flush()
+
+    for name in sorted(name_to_file):
+        w = get(name)
+        if _is_linear(name):
+            # stored [out, in]; scale is per out channel, computed over the
+            # in axis — quantize in the logical [in, out] layout and store
+            # back transposed so the file keeps the HF orientation
+            q, scale = quantize_linear_np(w.T)
+            emit(f"{name}.q8", np.ascontiguousarray(q.T))
+            emit(f"{name}.scale", scale)
+            n_q += 1
+        else:
+            emit(name, np.ascontiguousarray(w))
+    flush()
+    for h in handles.values():
+        if hasattr(h, "close"):
+            h.close()
+
+    index = {
+        "metadata": {"total_size": int(total), "cake_quant": "int8"},
+        "weight_map": weight_map,
+    }
+    (output / "model.safetensors.index.json").write_text(json.dumps(index))
+    for extra in ("config.json", "tokenizer.json", "tokenizer_config.json"):
+        src = model_path / extra
+        if src.exists():
+            shutil.copy2(src, output / extra)
+
+    # self-check: re-open every written shard and verify all tensors
+    # resolve (the reference splitter's reload verification,
+    # main.rs:202-208)
+    seen: set[str] = set()
+    for fname in sorted(set(weight_map.values())):
+        with safe_open(output / fname, framework="np") as sf:
+            names = set(sf.keys())
+            seen |= names
+            probe = next((n for n in names if n.endswith(".q8")), None)
+            if probe and sf.get_tensor(probe).dtype != np.int8:
+                raise RuntimeError("self-check failed: q8 tensor not int8")
+    missing = set(weight_map) - seen
+    if missing:
+        raise RuntimeError(f"self-check failed: missing {missing}")
+    print(f"quantized {n_q} linears -> {output} "
+          f"({len(set(weight_map.values()))} shard(s), {total / 1e9:.2f} GB)")
+    return output
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-path", required=True)
+    ap.add_argument("--output", required=True)
+    args = ap.parse_args()
+    try:
+        quantize_checkpoint(args.model_path, args.output)
+    except Exception as e:
+        sys.exit(f"error: {e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

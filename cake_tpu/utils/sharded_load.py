@@ -118,8 +118,15 @@ def load_llama_params_on_mesh(
     if quantize not in (None, "int8"):
         raise ValueError(f"unsupported quantize={quantize!r}")
     from cake_tpu.ops.quant import QuantizedLinear, quantize_linear_np
+    from cake_tpu.utils.weights import is_prequantized
 
     reader = CheckpointReader(model_dir)
+    prequantized = is_prequantized(reader.name_to_file)
+    if prequantized and quantize != "int8":
+        raise ValueError(
+            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
+            "load it with quantize='int8' (--quantize int8)"
+        )
     dt = _np_dtype(config.dtype)
     L = config.num_hidden_layers
     h = config.hidden_size
@@ -188,7 +195,11 @@ def load_llama_params_on_mesh(
             per = []
             for i in range(lo, hi):
                 name = f"model.layers.{i}.{suffix}"
-                if row_parallel:
+                if prequantized:
+                    # stored int8 in the HF [out, in] orientation: read
+                    # exactly this shard's slice, no quantize compute
+                    per.append(reader.read2d(f"{name}.q8", rsl, csl, True))
+                elif row_parallel:
                     # scale needs the full in-axis (memoized: one full read
                     # per layer, shared across tp shards and the scale
                     # leaf); the int8 bytes then need only this shard's rows
@@ -210,6 +221,11 @@ def load_llama_params_on_mesh(
         def cb(index):
             lsl, csl = index
             lo, hi, _ = lsl.indices(L)
+            if prequantized:
+                return np.stack([
+                    reader.read1d(f"model.layers.{i}.{suffix}.scale", csl)
+                    for i in range(lo, hi)
+                ])
             return np.stack([
                 _scale(f"model.layers.{i}.{suffix}", transpose, csl)
                 for i in range(lo, hi)
@@ -257,20 +273,31 @@ def load_llama_params_on_mesh(
         if quantize == "int8":
             # lm_head is column-parallel over vocab: shard-local quantize
             # is exact (full in-axis per shard); its scales ride the same
-            # memo so the scale leaf re-reads nothing
+            # memo so the scale leaf re-reads nothing. A tied head has no
+            # stored .q8 (the embedding stays full-precision) and falls
+            # back to on-the-fly quantize.
+            head_prequant = (prequantized
+                             and f"{head_name}.q8" in reader.name_to_file)
+
             def head_q(index):
+                if head_prequant:
+                    return reader.read2d(f"{head_name}.q8", index[0],
+                                         index[1], True)
                 q, s = quantize_linear_np(
                     reader.read2d(head_name, index[0], index[1], True))
                 scale_memo.setdefault(_key(head_name, index[1]), s)
                 return q
 
+            def head_scale(index):
+                if head_prequant:
+                    return reader.read1d(f"{head_name}.scale", index[0])
+                return _scale(head_name, True, index[0])
+
             params["lm_head"] = QuantizedLinear(
                 q=_assemble((h, config.vocab_size), mesh, P(None, TP),
                             head_q),
-                scale=_assemble(
-                    (config.vocab_size,), mesh, P(TP),
-                    lambda index: _scale(head_name, True, index[0]),
-                ),
+                scale=_assemble((config.vocab_size,), mesh, P(TP),
+                                head_scale),
             )
         else:
             params["lm_head"] = _assemble(

@@ -48,6 +48,7 @@ def params_from_hf_tensors(
     include_embed: bool = True,
     include_head: bool = True,
     quantize: str | None = None,
+    prequantized: bool = False,
 ) -> dict:
     """Build the params pytree from a tensor lookup ``get(hf_name)``.
 
@@ -57,13 +58,32 @@ def params_from_hf_tensors(
     ``quantize="int8"`` quantizes every linear *on the host as it streams in*
     (per-output-channel symmetric int8, ops.quant) — the bf16 weights never
     reach the device, so peak HBM is the int8 bytes. Norms and the embedding
-    stay in ``dtype``."""
+    stay in ``dtype``. ``prequantized=True`` (a checkpoint written by
+    tools/quantize_model: ``<name>.q8`` + ``<name>.scale`` tensors) reads
+    the stored int8 bytes directly — half the IO, zero quantize compute."""
     if quantize not in (None, "int8"):
         raise ValueError(f"unsupported quantize={quantize!r}")
+    if prequantized and quantize != "int8":
+        raise ValueError(
+            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
+            "load it with quantize='int8' (--quantize int8)"
+        )
     from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear_np
 
     lo, hi = layer_range or (0, num_layers)
     dt = jnp.dtype(dtype)
+
+    def get_q8(name: str) -> tuple[np.ndarray, np.ndarray]:
+        """(q [in, out] int8, scale [out] f32) for one linear — stored
+        pre-quantized or quantized here on the fly (a tied head reads the
+        un-quantized embedding even in a pre-quantized checkpoint)."""
+        if prequantized:
+            try:
+                return (np.asarray(get(f"{name}.q8")).T,
+                        np.asarray(get(f"{name}.scale")))
+            except KeyError:
+                pass
+        return quantize_linear_np(np.asarray(get(name)).T)
 
     params: dict = {}
     if hi > lo:
@@ -72,15 +92,14 @@ def params_from_hf_tensors(
             do_quant = quantize == "int8" and ours in LAYER_LINEARS
             per, scales = [], []
             for i in range(lo, hi):
-                w = np.asarray(get(f"model.layers.{i}.{suffix}"))
-                if transpose:
-                    w = w.T
+                name = f"model.layers.{i}.{suffix}"
                 if do_quant:
-                    q, s = quantize_linear_np(w)
+                    q, s = get_q8(name)
                     per.append(q)
                     scales.append(s)
                 else:
-                    per.append(w)
+                    w = np.asarray(get(name))
+                    per.append(w.T if transpose else w)
             if do_quant:
                 layers[ours] = QuantizedLinear(
                     q=jnp.asarray(np.stack(per)),
@@ -96,12 +115,11 @@ def params_from_hf_tensors(
         head_name = (
             "model.embed_tokens.weight" if tie_word_embeddings else "lm_head.weight"
         )
-        head = np.asarray(get(head_name)).T
         if quantize == "int8":
-            q, s = quantize_linear_np(head)
+            q, s = get_q8(head_name)
             params["lm_head"] = QuantizedLinear(q=jnp.asarray(q), scale=jnp.asarray(s))
         else:
-            params["lm_head"] = jnp.asarray(head).astype(dt)
+            params["lm_head"] = jnp.asarray(np.asarray(get(head_name)).T).astype(dt)
     return params
 
 
@@ -124,6 +142,12 @@ def load_safetensors_index(model_dir: str | Path) -> dict[str, Path]:
     raise FileNotFoundError(f"no safetensors index or file under {model_dir}")
 
 
+def is_prequantized(name_to_file: dict) -> bool:
+    """Was this checkpoint written by tools/quantize_model (int8 ``.q8`` +
+    ``.scale`` tensors)?"""
+    return any(n.endswith(".q8") for n in name_to_file)
+
+
 def load_llama_params(
     model_dir: str | Path,
     num_layers: int,
@@ -139,7 +163,8 @@ def load_llama_params(
     Shards are opened lazily with ``safetensors.safe_open`` (zero-copy mmap,
     the equivalent of VarBuilder::from_mmaped_safetensors, cake/mod.rs:100-101)
     and only requested tensors are materialized — a worker loading 4 of 32
-    layers reads only those bytes.
+    layers reads only those bytes. Pre-quantized checkpoints
+    (tools/quantize_model) are detected automatically.
     """
     from safetensors import safe_open
 
@@ -162,6 +187,7 @@ def load_llama_params(
             include_embed=include_embed,
             include_head=include_head,
             quantize=quantize,
+            prequantized=is_prequantized(name_to_file),
         )
     finally:
         for h in handles.values():

@@ -1,0 +1,155 @@
+"""Pre-quantized int8 checkpoints (tools/quantize_model).
+
+Quantize once offline, start fast forever: the stored .q8/.scale tensors
+must load (host and direct-to-mesh paths) bitwise-identically to
+quantize-on-load from the original checkpoint, at a fraction of the read
+bytes and zero quantize compute."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.parallel.mesh import MeshPlan, shard_params
+from cake_tpu.tools.quantize_model import quantize_checkpoint
+from cake_tpu.utils import sharded_load
+from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
+from cake_tpu.utils.weights import load_llama_params, save_llama_params
+
+CFG = tiny(max_seq_len=32)
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(scope="module")
+def dirs(tmp_path_factory):
+    src = tmp_path_factory.mktemp("src")
+    params = llama.init_params(CFG, jax.random.PRNGKey(13))
+    save_llama_params(params, src, CFG.num_hidden_layers)
+    (src / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    out = tmp_path_factory.mktemp("q8")
+    quantize_checkpoint(src, out)
+    return src, out
+
+
+def _leaves_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert x.dtype == y.dtype
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_prequantized_load_bitwise_matches_quantize_on_load(dirs):
+    src, out = dirs
+    want = load_llama_params(src, CFG.num_hidden_layers, dtype=CFG.dtype,
+                             quantize="int8")
+    got = load_llama_params(out, CFG.num_hidden_layers, dtype=CFG.dtype,
+                            quantize="int8")
+    _leaves_equal(got, want)
+
+
+def test_prequantized_sharded_load_matches(dirs):
+    src, out = dirs
+    plan = MeshPlan.build(CFG, num_stages=2, tp=2)
+    want = shard_params(
+        load_llama_params(src, CFG.num_hidden_layers, dtype=CFG.dtype,
+                          quantize="int8"),
+        plan.mesh,
+    )
+    got = load_llama_params_on_mesh(out, CFG, plan.mesh, quantize="int8")
+    _leaves_equal(got, want)
+
+
+def test_prequantized_sharded_load_reads_fewer_bytes(dirs):
+    """The point of the format: the int8 bytes load directly (the f32
+    source weights are never read, no quantize compute)."""
+    src, out = dirs
+    plan = MeshPlan.build(CFG, num_stages=2, tp=2)
+    reads = {}
+    orig = sharded_load.CheckpointReader.__init__
+
+    def spy(self, model_dir):
+        orig(self, model_dir)
+        reads[Path(model_dir)] = self
+
+    import unittest.mock as mock
+
+    with mock.patch.object(sharded_load.CheckpointReader, "__init__", spy):
+        load_llama_params_on_mesh(src, CFG, plan.mesh, quantize="int8")
+        load_llama_params_on_mesh(out, CFG, plan.mesh, quantize="int8")
+    # f32 source: >= 2x reads of the full linears (row-parallel scale pass);
+    # prequantized: one int8 read (1/4 the f32 bytes) + tiny scales
+    assert reads[Path(out)].bytes_read < 0.5 * reads[Path(src)].bytes_read
+
+
+def test_quantize_writes_bounded_shards(tmp_path):
+    """Output is written incrementally in ~shard_bytes shards (host RAM
+    bounded by one shard, not the checkpoint), and the loaders read the
+    multi-shard result identically."""
+    src = tmp_path / "src"
+    params = llama.init_params(CFG, jax.random.PRNGKey(13))
+    save_llama_params(params, src, CFG.num_hidden_layers)
+    (src / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    out = tmp_path / "q8"
+    quantize_checkpoint(src, out, shard_bytes=64 * 1024)
+    index = json.loads((out / "model.safetensors.index.json").read_text())
+    shards = set(index["weight_map"].values())
+    assert len(shards) > 1
+    want = load_llama_params(src, CFG.num_hidden_layers, dtype=CFG.dtype,
+                             quantize="int8")
+    got = load_llama_params(out, CFG.num_hidden_layers, dtype=CFG.dtype,
+                            quantize="int8")
+    _leaves_equal(got, want)
+
+
+def test_linear_suffixes_derived_from_layer_map():
+    """The tool's linear list is DERIVED from weights._LAYER_MAP +
+    quant.LAYER_LINEARS — the three sites cannot drift."""
+    from cake_tpu.ops.quant import LAYER_LINEARS
+    from cake_tpu.tools.quantize_model import _LINEAR_SUFFIXES
+    from cake_tpu.utils.weights import _LAYER_MAP
+
+    assert set(_LINEAR_SUFFIXES) == {
+        _LAYER_MAP[k][0] for k in LAYER_LINEARS
+    }
+
+
+def test_prequantized_requires_int8_flag(dirs):
+    _, out = dirs
+    with pytest.raises(ValueError, match="pre-quantized"):
+        load_llama_params(out, CFG.num_hidden_layers, dtype=CFG.dtype)
+    plan = MeshPlan.build(CFG, num_stages=2)
+    with pytest.raises(ValueError, match="pre-quantized"):
+        load_llama_params_on_mesh(out, CFG, plan.mesh)
+
+
+def test_cli_generation_from_prequantized_checkpoint(dirs):
+    """End-to-end: the CLI serves a pre-quantized dir with --quantize int8
+    and produces the same stream as quantize-on-load from the source."""
+    src, out = dirs
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    def run(model_dir):
+        return subprocess.run(
+            [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
+             "--quantize", "int8", "--prompt-ids", "3,5,7", "-n", "5",
+             "--temperature", "0", "--max-seq", "32", "--cpu"],
+            capture_output=True, text=True, timeout=240, env=env, cwd=REPO,
+        )
+
+    a, b = run(src), run(out)
+    assert a.returncode == 0, a.stderr
+    assert b.returncode == 0, b.stderr
+
+    def toks(r):
+        return [l for l in r.stdout.splitlines()
+                if l and all(c.isdigit() or c == "," for c in l)][-1]
+
+    assert toks(a) == toks(b)
