@@ -61,6 +61,13 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
     model_path, output = Path(model_path), Path(output)
     output.mkdir(parents=True, exist_ok=True)
     name_to_file = load_safetensors_index(model_path)
+    from cake_tpu.utils.weights import is_prequantized
+
+    if is_prequantized(name_to_file):
+        raise ValueError(
+            f"{model_path} is already pre-quantized (.q8/.scale tensors); "
+            "re-quantizing it would only copy bytes"
+        )
 
     handles: dict[Path, object] = {}
 

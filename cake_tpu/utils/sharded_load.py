@@ -118,15 +118,10 @@ def load_llama_params_on_mesh(
     if quantize not in (None, "int8"):
         raise ValueError(f"unsupported quantize={quantize!r}")
     from cake_tpu.ops.quant import QuantizedLinear, quantize_linear_np
-    from cake_tpu.utils.weights import is_prequantized
+    from cake_tpu.utils.weights import check_prequantized
 
     reader = CheckpointReader(model_dir)
-    prequantized = is_prequantized(reader.name_to_file)
-    if prequantized and quantize != "int8":
-        raise ValueError(
-            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
-            "load it with quantize='int8' (--quantize int8)"
-        )
+    prequantized = check_prequantized(reader.name_to_file, quantize)
     dt = _np_dtype(config.dtype)
     L = config.num_hidden_layers
     h = config.hidden_size

@@ -65,8 +65,7 @@ def params_from_hf_tensors(
         raise ValueError(f"unsupported quantize={quantize!r}")
     if prequantized and quantize != "int8":
         raise ValueError(
-            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
-            "load it with quantize='int8' (--quantize int8)"
+            "prequantized=True requires quantize='int8'"
         )
     from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear_np
 
@@ -148,6 +147,18 @@ def is_prequantized(name_to_file: dict) -> bool:
     return any(n.endswith(".q8") for n in name_to_file)
 
 
+def check_prequantized(name_to_file: dict, quantize: str | None) -> bool:
+    """Detect a pre-quantized checkpoint and validate the requested load
+    mode against it (shared by the host and direct-to-mesh loaders)."""
+    pre = is_prequantized(name_to_file)
+    if pre and quantize != "int8":
+        raise ValueError(
+            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
+            "load it with quantize='int8' (--quantize int8)"
+        )
+    return pre
+
+
 def load_llama_params(
     model_dir: str | Path,
     num_layers: int,
@@ -187,7 +198,7 @@ def load_llama_params(
             include_embed=include_embed,
             include_head=include_head,
             quantize=quantize,
-            prequantized=is_prequantized(name_to_file),
+            prequantized=check_prequantized(name_to_file, quantize),
         )
     finally:
         for h in handles.values():

@@ -1,0 +1,65 @@
+"""Runnable tour of the serving plane on a tiny random-weight model (CPU).
+
+Shows the capabilities the single-request reference has no answer to
+(SURVEY.md §0), end to end in a few seconds:
+
+- concurrent streams with per-row positions and per-stream keys
+- shared-prefix detection (the system prompt is prefilled once)
+- continuous batching: an arrival enqueued mid-run is admitted chunk by
+  chunk alongside decode, then its slot streams like any other
+- int8 KV cache + serving stats
+
+Run:  python examples/serve_continuous.py
+(set XLA_FLAGS=--xla_force_host_platform_device_count=8 to also shard
+over stages/tp on virtual devices)
+"""
+
+import jax
+
+from cake_tpu.models.config import tiny
+from cake_tpu.models.llama import init_params
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.batch_generator import BatchGenerator
+
+
+def main() -> None:
+    cfg = tiny(max_seq_len=128)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    system_prompt = [(i * 7) % (cfg.vocab_size - 2) + 1 for i in range(32)]
+
+    gen = BatchGenerator(
+        cfg, params,
+        settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        dp=1, block_size=4, kv_quant="int8", admit_chunk=16,
+        prefix_share_min=16,
+    )
+    gen.set_prompts([
+        system_prompt + [5, 9, 2],
+        system_prompt + [3, 1, 4, 1],
+        system_prompt + [8, 8],
+    ])
+    print("3 streams admitted; shared 32-token prefix prefilled once "
+          f"({gen.stats()['admit_dispatches']} prefix dispatch(es))")
+
+    for step in range(20):
+        gen.step()
+        if step == 4:
+            # a request arrives mid-run: it reuses the cached prefix row
+            # and prefills only its remainder, interleaved with decode
+            gen.streams[2].done = True  # pretend stream 2 finished
+            gen.enqueue(system_prompt + [2, 6, 4], stream_id=3)
+            print("step 5: stream 2 retired, arrival enqueued")
+        if gen.pending_admissions() == 0 and step == 8:
+            print("step 9: arrival fully admitted (prefix reused)")
+
+    st = gen.stats()
+    print(f"\n{st['tokens_emitted']} tokens over "
+          f"{st['decode_dispatches']} decode + {st['admit_dispatches']} "
+          f"admission dispatches ({st['tokens_per_dispatch']} tokens/dispatch)")
+    for i, s in enumerate(gen.streams):
+        if s.active:
+            print(f"stream {i} (id {s.stream_id}): {s.generated}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Seeded property tests: core primitives vs brute-force oracles.
+
+Randomized (fixed-seed, deterministic) sweeps over the primitives whose
+edge cases the example-based tests cannot enumerate: n-gram proposal,
+KV-cache writes (bf16 and int8, scalar and per-row positions), and the
+quantization round-trip bound."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.ops.kvcache import dequant_kv, init_cache, update_layer
+from cake_tpu.models.config import tiny
+from cake_tpu.runtime.speculative import ngram_propose
+
+
+def _brute_ngram(ctx, n_max, k):
+    """Oracle: literally scan for the most recent match, longest n first."""
+    L = len(ctx)
+    for n in range(min(n_max, L - 1), 0, -1):
+        pat = ctx[L - n:]
+        for j in range(L - 1 - n, -1, -1):
+            if ctx[j: j + n] == pat:
+                return ctx[j + n: j + n + k]
+    return []
+
+
+def test_ngram_propose_matches_brute_force_oracle():
+    rng = np.random.default_rng(7)
+    for trial in range(200):
+        L = int(rng.integers(0, 40))
+        vocab = int(rng.integers(2, 6))  # small vocab -> many matches
+        ctx = rng.integers(0, vocab, L).tolist()
+        n_max = int(rng.integers(1, 5))
+        k = int(rng.integers(1, 6))
+        got = ngram_propose(ctx, n_max, k)
+        want = _brute_ngram(ctx, n_max, k)
+        assert got == want, (trial, ctx, n_max, k, got, want)
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_update_layer_random_positions_match_numpy_oracle(quant):
+    """Random write positions (scalar and per-row): exactly the written
+    slots change, everything else is untouched, and written values
+    round-trip within the int8 bound."""
+    cfg = tiny(max_seq_len=16)
+    kh, d, s = cfg.num_key_value_heads, cfg.head_dim, 16
+    rng = np.random.default_rng(3)
+    key = jax.random.PRNGKey(0)
+    for trial in range(20):
+        b = int(rng.integers(1, 4))
+        t = int(rng.integers(1, 4))
+        per_row = bool(rng.integers(0, 2))
+        cache = init_cache(cfg, batch=b, max_seq=s, quant=quant)
+        kc = jax.tree.map(lambda x: x[0], cache.k)
+        vc = jax.tree.map(lambda x: x[0], cache.v)
+        # pre-populate with a first write so untouched-slot checks are
+        # non-trivial
+        base_k = jax.random.normal(key, (b, kh, s, d), jnp.bfloat16)
+        base_v = jax.random.normal(jax.random.fold_in(key, 1),
+                                   (b, kh, s, d), jnp.bfloat16)
+        kc, vc = update_layer(kc, vc, base_k, base_v, jnp.int32(0))
+        before = np.asarray(dequant_kv(kc, jnp.float32))
+
+        k_new = jax.random.normal(jax.random.fold_in(key, trial + 2),
+                                  (b, kh, t, d), jnp.bfloat16)
+        v_new = jnp.zeros((b, kh, t, d), jnp.bfloat16)
+        if per_row:
+            pos = rng.integers(0, s - t + 1, b)
+            kc2, _ = update_layer(kc, vc, k_new, v_new,
+                                  jnp.asarray(pos, jnp.int32))
+        else:
+            p = int(rng.integers(0, s - t + 1))
+            pos = np.full((b,), p)
+            kc2, _ = update_layer(kc, vc, k_new, v_new, jnp.int32(p))
+        after = np.asarray(dequant_kv(kc2, jnp.float32))
+        tol = 0.05 if quant else 0.02  # int8 quant error vs bf16 rounding
+        for bi in range(b):
+            lo = int(pos[bi])
+            np.testing.assert_allclose(
+                after[bi, :, lo: lo + t],
+                np.asarray(k_new[bi], np.float32), atol=tol,
+            )
+            mask = np.ones(s, bool)
+            mask[lo: lo + t] = False
+            np.testing.assert_array_equal(after[bi, :, mask],
+                                          before[bi, :, mask])
+
+
+def test_quant_kv_bound_random():
+    """|dequant(quant(x)) - x| <= per-(token,head) absmax/127 for random
+    magnitudes across orders of magnitude."""
+    from cake_tpu.ops.kvcache import quant_kv
+
+    rng = np.random.default_rng(11)
+    for trial in range(20):
+        scale = 10.0 ** rng.integers(-3, 3)
+        x = jnp.asarray(
+            rng.normal(0, scale, (2, 3, 5, 8)), jnp.float32
+        )
+        deq = dequant_kv(quant_kv(x), jnp.float32)
+        bound = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 127
+        assert (np.abs(np.asarray(deq - x)) <= bound + 1e-7).all()
+
+
+def test_bucket_properties():
+    """_bucket: >= n, power-of-two growth from the floor, capped at max."""
+    from cake_tpu.runtime.generator import _bucket
+
+    for max_seq in (32, 64, 100, 4096):
+        for n in range(1, max_seq + 1):
+            b = _bucket(n, max_seq)
+            assert n <= b <= max_seq or b == max_seq
+            if b < max_seq:
+                assert b & (b - 1) == 0  # power of two
+                if b > 16:  # 16 is the floor; minimality holds above it
+                    assert b // 2 < n

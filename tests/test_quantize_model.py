@@ -128,6 +128,12 @@ def test_prequantized_requires_int8_flag(dirs):
         load_llama_params_on_mesh(out, CFG, plan.mesh)
 
 
+def test_quantize_rejects_already_quantized_input(dirs, tmp_path):
+    _, out = dirs
+    with pytest.raises(ValueError, match="already pre-quantized"):
+        quantize_checkpoint(out, tmp_path / "double")
+
+
 def test_cli_generation_from_prequantized_checkpoint(dirs):
     """End-to-end: the CLI serves a pre-quantized dir with --quantize int8
     and produces the same stream as quantize-on-load from the source."""
