@@ -76,7 +76,8 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="kv_quant",
                    help="store the KV cache as int8 + per-slot scales "
                         "(half the cache HBM — roughly doubles servable "
-                        "batch x window; local and mesh paths, sp=1)")
+                        "batch x window, or doubles the --sp long-context "
+                        "window; local and mesh paths)")
     p.add_argument("--decode-block", type=int, default=None,
                    dest="decode_block",
                    help="fused decode steps per dispatch (all-local and mesh "

@@ -240,18 +240,14 @@ def self_attention_block(
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
-        if isinstance(k_cache, kv.QuantizedKV):
-            raise ValueError(
-                "int8 KV cache is not supported with sequence parallelism "
-                "(the ring/sp kernels stream plain KV buffers); use sp=1"
-            )
         if jnp.asarray(pos).ndim:
             raise ValueError(
                 "per-row positions are not supported with sequence "
                 "parallelism (sp is the long-context single-stream plane); "
                 "use sp=1 for multi-stream serving"
             )
-        s_l = k_cache.shape[2]
+        quantized = isinstance(k_cache, kv.QuantizedKV)
+        s_l = kv._kv_data(k_cache).shape[2]
         sp_idx = jax.lax.axis_index(sp_axis)
         is_prefill = sp_prefill if sp_prefill is not None else t > 1
         if is_prefill:
@@ -266,6 +262,15 @@ def self_attention_block(
             my_off = sp_idx * t  # global position of this shard's token 0
             q = apply_rope(q, cos, sin, my_off)
             k = apply_rope(k, cos, sin, my_off)
+            if quantized:
+                # attention must see exactly what the cache will hold:
+                # round-trip the chunk through the int8 quantization before
+                # the ring (the same values the sp_*_write paths store), so
+                # sp output matches the single-device int8-KV oracle
+                k_att = kv.dequant_kv(kv.quant_kv(k), q.dtype)
+                v_att = kv.dequant_kv(kv.quant_kv(v), q.dtype)
+            else:
+                k_att, v_att = k, v
             if t == s_l:
                 # chunk layout == cache layout: write in place, no gather
                 k_cache, v_cache = kv.update_layer(k_cache, v_cache, k, v, 0,
@@ -274,7 +279,8 @@ def self_attention_block(
                 k_cache, v_cache = ring.sp_chunked_cache_write(
                     k_cache, v_cache, k, v, sp_axis, sp_size, gate=write_gate
                 )
-            out = ring.ring_attention(q, k, v, sp_axis, sp_size, q_off=my_off)
+            out = ring.ring_attention(q, k_att, v_att, sp_axis, sp_size,
+                                      q_off=my_off)
         else:
             q = apply_rope(q, cos, sin, pos)
             k = apply_rope(k, cos, sin, pos)
@@ -283,7 +289,8 @@ def self_attention_block(
                 k_cache, v_cache, k, v, pos, shard_start, gate=write_gate
             )
             out = ring.sp_decode_attend(
-                q, k_cache, v_cache, pos, sp_axis, shard_start
+                q, kv.dequant_kv(k_cache, q.dtype),
+                kv.dequant_kv(v_cache, q.dtype), pos, sp_axis, shard_start
             )
     else:
         q = apply_rope(q, cos, sin, pos)

@@ -182,15 +182,31 @@ def sp_decode_attend(
     return finalize_stats(o, m, l, q.dtype)
 
 
+def _leaf_pairs(cache, new):
+    """Pair a cache half with its (pre-quantized-if-needed) new values,
+    leaf by leaf: a plain array yields one ``(cache, new)`` pair; a
+    :class:`cake_tpu.ops.kvcache.QuantizedKV` yields ``(q, q)`` and
+    ``(scale, scale)`` pairs plus a rebuild function. The sequence axis is
+    axis 2 in every leaf layout (``[B, KH, S, D]`` and ``[B, KH, S]``), so
+    one write routine serves both."""
+    from cake_tpu.ops import kvcache as kvc
+
+    if isinstance(cache, kvc.QuantizedKV):
+        qn = kvc.quant_kv(new)
+        return ([(cache.q, qn.q), (cache.scale, qn.scale)],
+                lambda leaves: kvc.QuantizedKV(q=leaves[0], scale=leaves[1]))
+    return [(cache, new)], lambda leaves: leaves[0]
+
+
 def sp_chunked_cache_write(
-    k_cache: jax.Array,  # [B, KH, S_l, D] local slice of the range-sharded cache
-    v_cache: jax.Array,
+    k_cache,  # [B, KH, S_l, D] local slice of the range-sharded cache
+    v_cache,
     k_new: jax.Array,  # [B, KH, T_l, D] this shard's prefill chunk (roped)
     v_new: jax.Array,
     axis_name: str,
     axis_size: int,
     gate: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
+):
     """Write chunk-sharded prefill KV into the range-sharded cache layout.
 
     Chunked sp prefill shards the *prompt* (shard ``i`` computes KV for
@@ -204,18 +220,25 @@ def sp_chunked_cache_write(
     before they ever become attendable (same invariant as the local bucketed
     prefill path).
 
-    ``gate``: pipeline-stage activity predicate; inactive stages keep their
-    cache unchanged.
+    ``k_cache``/``v_cache`` may be plain buffers or int8 ``QuantizedKV``
+    halves (quantize-on-write; the int8 bytes + tiny scales ride the
+    all-gather, not the bf16 chunk). ``gate``: pipeline-stage activity
+    predicate; inactive stages keep their cache unchanged.
     """
-    s_l = k_cache.shape[2]
+    from cake_tpu.ops.kvcache import _kv_data
+
+    s_l = _kv_data(k_cache).shape[2]
     shard_start = jax.lax.axis_index(axis_name) * s_l
 
-    def write(cache, new):
+    def write_leaf(cache, new):
         allkv = jax.lax.all_gather(new, axis_name, axis=2, tiled=True)
-        # Pad the gathered [B, KH, T_pad, D] so the window slice below is
-        # always in-bounds: dynamic_slice clamps start to [0, T_pad], and a
-        # shard whose range begins past the prompt reads only zeros.
-        padded = jnp.pad(allkv, ((0, 0), (0, 0), (0, s_l), (0, 0)))
+        # Pad the gathered tensor along the sequence axis so the window
+        # slice below is always in-bounds: dynamic_slice clamps start to
+        # [0, T_pad], and a shard whose range begins past the prompt reads
+        # only zeros.
+        pad = [(0, 0)] * allkv.ndim
+        pad[2] = (0, s_l)
+        padded = jnp.pad(allkv, pad)
         win = jax.lax.dynamic_slice_in_dim(
             padded, shard_start, s_l, axis=2
         ).astype(cache.dtype)
@@ -223,35 +246,46 @@ def sp_chunked_cache_write(
             win = jnp.where(gate, win, cache)
         return win
 
+    def write(cache, new):
+        pairs, rebuild = _leaf_pairs(cache, new)
+        return rebuild([write_leaf(c, n) for c, n in pairs])
+
     return write(k_cache, k_new), write(v_cache, v_new)
 
 
 def sp_cache_write(
-    k_cache: jax.Array,  # [B, KH, S_l, D] local slice
-    v_cache: jax.Array,
+    k_cache,  # [B, KH, S_l, D] local slice (plain or QuantizedKV)
+    v_cache,
     k_new: jax.Array,  # [B, KH, 1, D]
     v_new: jax.Array,
     pos,  # scalar global write position
     shard_start,  # scalar global position of this shard's slot 0
     gate: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array]:
+):
     """Owner-masked single-slot write into a sequence-sharded cache.
 
     Every shard executes the same program (SPMD); only the shard whose range
     contains ``pos`` commits the new KV — the rest rewrite their current slot
     value, which XLA lowers to an in-place dynamic-update on donated buffers.
     ``gate``: additional scalar predicate (pipeline-stage activity) ANDed in.
+    Quantized halves write their int8 bytes and per-slot scale the same way.
     """
-    s_l = k_cache.shape[2]
+    from cake_tpu.ops.kvcache import _kv_data
+
+    s_l = _kv_data(k_cache).shape[2]
     local = jnp.asarray(pos, jnp.int32) - jnp.asarray(shard_start, jnp.int32)
     owner = (local >= 0) & (local < s_l)
     if gate is not None:
         owner = owner & gate
     off = jnp.clip(local, 0, s_l - 1)
 
-    def write(cache, new):
+    def write_leaf(cache, new):
         cur = jax.lax.dynamic_slice_in_dim(cache, off, 1, axis=2)
         val = jnp.where(owner, new.astype(cache.dtype), cur)
         return jax.lax.dynamic_update_slice_in_dim(cache, val, off, axis=2)
+
+    def write(cache, new):
+        pairs, rebuild = _leaf_pairs(cache, new)
+        return rebuild([write_leaf(c, n) for c, n in pairs])
 
     return write(k_cache, k_new), write(v_cache, v_new)

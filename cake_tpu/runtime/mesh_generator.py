@@ -93,9 +93,6 @@ class MeshGenerator(GeneratorBase):
                 f"max_seq {self.max_seq} not divisible by prefill_chunks "
                 f"{self.prefill_chunks}"
             )
-        if kv_quant is not None and plan.sp != 1:
-            raise ValueError("int8 KV cache requires sp == 1 (the ring/sp "
-                             "kernels stream plain KV buffers)")
         self.kv_quant = kv_quant
         self.params = shard_params(params, plan.mesh)
         # allocated per-shard on its owner device (multi-host-valid: no
