@@ -312,9 +312,6 @@ def run_master(args) -> int:
         sys.exit("error: --speculate runs the local or mesh (stages/tp) "
                  "paths; it is not supported with --sp or --topology (it "
                  "would otherwise be silently ignored)")
-    if args.speculate and args.prefill_chunks > 1:
-        sys.exit("error: --prefill-chunks does not compose with "
-                 "--speculate yet")
     if args.speculate and args.decode_block is not None:
         sys.exit("error: --decode-block does not compose with --speculate "
                  "(speculative rounds replace fused-block dispatches; the "
@@ -380,7 +377,8 @@ def run_master(args) -> int:
                 gen = MeshSpeculativeGenerator(
                     config, params, plan=plan, tokenizer=tokenizer,
                     settings=settings, max_seq=args.max_seq,
-                    kv_quant=args.kv_quant, spec_k=args.speculate)
+                    kv_quant=args.kv_quant, spec_k=args.speculate,
+                    prefill_chunks=args.prefill_chunks)
             else:
                 gen = MeshGenerator(config, params, plan=plan,
                                     tokenizer=tokenizer, settings=settings,

@@ -233,6 +233,7 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         kv_quant: str | None = None,
         spec_k: int = 8,
         spec_ngram: int = 3,
+        prefill_chunks: int = 1,
     ):
         from cake_tpu.parallel.pipeline import build_sharded_verify
 
@@ -240,7 +241,8 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         super().__init__(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=max_seq,
                          num_stages=num_stages, tp=tp, sp=1,
-                         devices=devices, block_size=1, kv_quant=kv_quant)
+                         devices=devices, block_size=1, kv_quant=kv_quant,
+                         prefill_chunks=prefill_chunks)
         self._spec_init(spec_k, spec_ngram)
         self._verify = build_sharded_verify(
             config, self.plan, params_like=self.params, kv_quant=kv_quant

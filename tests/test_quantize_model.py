@@ -128,6 +128,18 @@ def test_prequantized_requires_int8_flag(dirs):
         load_llama_params_on_mesh(out, CFG, plan.mesh)
 
 
+def test_prequantized_layer_range_slice_matches(dirs):
+    """The worker path (layer_range, no embed/head) reads pre-quantized
+    slices identically to quantize-on-load from the source — a worker can
+    serve straight from a quantize_model bundle."""
+    src, out = dirs
+    kw = dict(dtype=CFG.dtype, layer_range=(1, 3), include_embed=False,
+              include_head=False, quantize="int8")
+    want = load_llama_params(src, CFG.num_hidden_layers, **kw)
+    got = load_llama_params(out, CFG.num_hidden_layers, **kw)
+    _leaves_equal(got, want)
+
+
 def test_quantize_rejects_already_quantized_input(dirs, tmp_path):
     _, out = dirs
     with pytest.raises(ValueError, match="already pre-quantized"):

@@ -167,6 +167,23 @@ def test_mesh_speculation_bit_identical_and_fewer_dispatches(params,
     assert g.dispatches < g.emitted
 
 
+def test_mesh_speculation_composes_with_pipelined_prefill(params):
+    """--prefill-chunks (GPipe prompt overlap for TTFT) and speculation
+    (decode) touch different phases; together they match the plain run."""
+    from cake_tpu.runtime.mesh_generator import MeshGenerator
+    from cake_tpu.runtime.speculative import MeshSpeculativeGenerator
+
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+    ref = MeshGenerator(CFG, params, settings=settings, num_stages=2)
+    ref.set_prompt(prompt)
+    want = [ref.next_token(i).id for i in range(16)]
+    g = MeshSpeculativeGenerator(CFG, params, settings=settings,
+                                 num_stages=2, spec_k=4, prefill_chunks=2)
+    g.set_prompt(prompt)
+    assert [g.next_token(i).id for i in range(16)] == want
+
+
 def test_mesh_speculation_with_int8_kv(params):
     from cake_tpu.runtime.speculative import MeshSpeculativeGenerator
 
