@@ -130,6 +130,27 @@ def _param_bytes(params) -> int:
     return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(params))
 
 
+def _emit(row: dict, dev) -> None:
+    """Print the benchmark row (the driver contract: ONE JSON line on
+    stdout per invocation, flushed the moment the row lands) and append it
+    to bench_results.jsonl with device + timestamp, so a later wedge or
+    crash in the same session cannot erase the evidence that a row was
+    measured on-chip. The jsonl is a deliberately TRACKED measurement
+    ledger (like KERNELS_TPU.json): on-chip rows are committed as round
+    evidence, which is why it is not in .gitignore."""
+    print(json.dumps(row), flush=True)
+    try:
+        rec = dict(row, device=getattr(dev, "device_kind", "cpu"),
+                   platform=dev.platform,
+                   stamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "bench_results.jsonl")
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
 def _device_init_probe(timeout_s: float) -> bool:
     """Check device init completes in a THROWAWAY subprocess. A wedged
     remote chip hangs inside PJRT client init without returning to the
@@ -151,27 +172,54 @@ def _device_init_probe(timeout_s: float) -> bool:
 
 
 def _device_init_probe_retried() -> bool:
-    """A wedged remote grant can clear within minutes: spread several
-    fresh-subprocess probes over 10+ minutes before giving up on the
-    accelerator. Defaults (10 probes x 60s timeout, 60s between) budget
-    ~10 min of patience when probes fail fast and ~19 min when every probe
-    hangs its full timeout — sized from two rounds of evidence that the
-    old 3x45s budget was smaller than observed wedge-clearing time
-    (CAKE_BENCH_PROBES / CAKE_BENCH_PROBE_WAIT / CAKE_BENCH_PROBE_TIMEOUT
-    tune this)."""
-    probes = int(os.environ.get("CAKE_BENCH_PROBES", "10"))
-    wait_s = float(os.environ.get("CAKE_BENCH_PROBE_WAIT", "60"))
+    """Probe for a usable accelerator under a hard WALL-CLOCK budget.
+
+    History: r2's 3x45s probe budget was too small for transient wedges and
+    cost the round's record; r3 raised it to 10x60s — but the r3 wedge
+    lasted 8+ hours, so all 10 probes burned ~19 minutes of driver time and
+    the record still fell back to CPU. Evidence now says wedges are bimodal:
+    either the first probe succeeds in seconds (healthy chip) or the grant
+    stays wedged for hours (no probe count helps). So the budget is a
+    deadline, not a count: keep probing until CAKE_BENCH_PROBE_BUDGET
+    seconds (default 360) elapse, then degrade to CPU fast. A healthy chip
+    still passes on the first ~15s probe; a wedged one costs 6 minutes
+    instead of 19 (CAKE_BENCH_PROBE_WAIT / CAKE_BENCH_PROBE_TIMEOUT tune
+    the per-probe cadence)."""
+    wait_s = float(os.environ.get("CAKE_BENCH_PROBE_WAIT", "45"))
     timeout_s = float(os.environ.get("CAKE_BENCH_PROBE_TIMEOUT", "60"))
-    for i in range(probes):
+    if "CAKE_BENCH_PROBE_BUDGET" not in os.environ and \
+            "CAKE_BENCH_PROBES" in os.environ:
+        # r2/r3 contract compatibility: a count-based knob maps onto the
+        # wall-clock budget it used to imply (N probes hanging their full
+        # timeout plus the waits between them).
+        n = int(os.environ["CAKE_BENCH_PROBES"])
+        budget_s = n * timeout_s + max(0, n - 1) * wait_s
+    else:
+        budget_s = float(os.environ.get("CAKE_BENCH_PROBE_BUDGET", "360"))
+    if budget_s <= 0:
+        # CAKE_BENCH_PROBES=0 / CAKE_BENCH_PROBE_BUDGET=0: bypass the
+        # accelerator without launching even one probe (a probe against a
+        # wedged grant can re-wedge it).
+        return False
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
         if _device_init_probe(timeout_s):
             return True
-        if i < probes - 1:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
             sys.stderr.write(
-                f"device init probe {i + 1}/{probes} failed; retrying in "
-                f"{wait_s:.0f}s (a wedged grant can clear)\n"
+                f"device init: {attempt} probes failed within the "
+                f"{budget_s:.0f}s budget\n"
             )
-            time.sleep(wait_s)
-    return False
+            return False
+        sys.stderr.write(
+            f"device init probe {attempt} failed; retrying in "
+            f"{min(wait_s, remaining):.0f}s ({remaining:.0f}s of probe "
+            f"budget left)\n"
+        )
+        time.sleep(min(wait_s, remaining))
 
 
 def _reexec(cpu: bool = False, **env_overrides) -> None:
@@ -234,12 +282,12 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     # vs_baseline: fraction of the chip's bf16 peak the prompt pass sustains
     flops = _matmul_flops(params, config, t)
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
-    print(json.dumps({
+    _emit({
         "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",
         "value": round(t / dt, 3),
         "unit": "tokens/s",
         "vs_baseline": round(flops / dt / peak, 4),
-    }))
+    }, dev)
     sys.stderr.write(
         f"device={dev.device_kind} T={t} window={config.max_seq_len} "
         f"warm_prefill={dt * 1e3:.1f}ms ttft_cold={ttft_cold:.2f}s "
@@ -336,12 +384,12 @@ def _run_batched(config, params, preset, quant, settings, dev,
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb  # single-stream weights-bound ideal
     wtag = _wtag(quant, kv_quant)
-    print(json.dumps({
+    _emit({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_b{batch}",
         "value": round(agg_tok_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(agg_tok_s / roofline, 4),
-    }))
+    }, dev)
     sys.stderr.write(
         f"device={dev.device_kind} params={model_gb:.2f}GB batch={batch} "
         f"single-stream roofline={roofline:.1f}tok/s "
@@ -385,12 +433,12 @@ def _run_ttft(config, params, preset, quant, dev) -> int:
     # vs_baseline: how close the warm prompt pass runs to the chip's peak
     flops = _matmul_flops(params, config, t)
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
-    print(json.dumps({
+    _emit({
         "metric": f"ttft_p50_ms_llama_{preset}_{wtag}_1chip_t{t}",
         "value": round(p50 * 1e3, 2),
         "unit": "ms",
         "vs_baseline": round(flops / p50 / peak, 4),
-    }))
+    }, dev)
     sys.stderr.write(
         f"device={dev.device_kind} T={t} trials={trials} "
         f"p50={p50 * 1e3:.1f}ms p95={p95 * 1e3:.1f}ms\n"
@@ -451,13 +499,13 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
-    print(json.dumps({
+    _emit({
         "metric": (f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_"
                    f"b{batch}_churn"),
         "value": round(agg, 3),
         "unit": "tokens/s",
         "vs_baseline": round(agg / roofline, 4),
-    }))
+    }, dev)
     st = gen.stats()
     sys.stderr.write(
         f"device={dev.device_kind} batch={batch} stream_len={stream_len} "
@@ -502,12 +550,12 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
-    print(json.dumps({
+    _emit({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_spec{k}",
         "value": round(tok_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(tok_s / roofline, 4),
-    }))
+    }, dev)
     sys.stderr.write(
         f"device={dev.device_kind} params={model_gb:.2f}GB spec_k={k} "
         f"tokens/dispatch={accept:.2f} timed_tokens={timed} "
@@ -568,20 +616,31 @@ def main() -> int:
         # user did not ask for
         presets = ["8b", "small", "tiny"]
         ladder = [(p, quant) for p in presets[presets.index(preset):]]
-    # HBM preflight: skip rungs whose budget arithmetic provably exceeds
-    # this chip's usable HBM (capacity from the device-kind table minus the
-    # measured ~9% runtime reserve) instead of burning minutes of real OOM
-    # attempts + retry sleeps on them. The try/except ladder below remains
-    # the backstop for when the estimate is wrong.
+    # HBM preflight: gate EVERY rung — including the last — behind the
+    # budget arithmetic before anything reaches the compiler. The r3 wedge
+    # followed an OOM-failed compile, and a killed/failed compile can wedge
+    # the remote grant for hours, so an OOM-able config must never compile
+    # at all. The estimate is params+KV (utils/memory.hbm_budget) times a
+    # margin for XLA temporaries (fusion scratch, f32 logits, donation
+    # double-buffering — the r3 OOM row showed the raw estimate running
+    # ~1.5 GiB light), against capacity minus the measured ~9% runtime
+    # reserve. If no rung fits, fall to CPU WITHOUT attempting a compile.
+    # The try/except ladder below remains the backstop for when the
+    # estimate is still wrong.
     if dev.platform != "cpu":
         from cake_tpu.utils.memory import hbm_budget
 
         usable = _device_spec(dev, _HBM_GIB, 16.0) * 0.91 * 2**30
+        margin = float(os.environ.get("CAKE_BENCH_HBM_MARGIN", "1.10"))
         bench_batch = max(1, int(os.environ.get("CAKE_BENCH_BATCH", "1")))
+        if os.environ.get("CAKE_BENCH_CHURN") == "1":
+            # price what _run_churn will actually allocate (it floors the
+            # batch at 2 so there is churn to measure)
+            bench_batch = max(2, bench_batch)
         idx = ladder.index(rung)
-        while idx + 1 < len(ladder):
+        while idx < len(ladder):
             p_, q_ = ladder[idx]
-            est = hbm_budget(
+            est = margin * hbm_budget(
                 _config(p_), batch=bench_batch, quant=q_ or None,
                 cache_bytes_per_el=1 if os.environ.get("CAKE_BENCH_KV")
                 else 2,
@@ -590,10 +649,20 @@ def main() -> int:
                 break
             sys.stderr.write(
                 f"preset={p_}{'+' + q_ if q_ else ''} needs "
-                f"~{est / 2**30:.1f} GiB > ~{usable / 2**30:.1f} GiB usable "
-                f"on {dev.device_kind}; skipping to the next rung\n"
+                f"~{est / 2**30:.1f} GiB (x{margin:.2f} temp margin) > "
+                f"~{usable / 2**30:.1f} GiB usable on {dev.device_kind}; "
+                f"skipping to the next rung\n"
             )
             idx += 1
+        if idx == len(ladder):
+            if os.environ.get("CAKE_BENCH_NO_FALLBACK") != "1":
+                sys.stderr.write(
+                    "no ladder rung fits this chip's HBM; re-running on "
+                    "CPU without attempting a compile\n"
+                )
+                _reexec(cpu=True, CAKE_BENCH_PRESET="tiny")
+            sys.stderr.write("no ladder rung fits this device\n")
+            return 1
         rung = ladder[idx]
         preset, quant = rung
     params = config = None
@@ -736,12 +805,12 @@ def main() -> int:
     roofline = _hbm_gbps(dev) / model_gb  # ideal decode tok/s (weights-bound)
 
     wtag = _wtag(quant, kv_quant)
-    print(json.dumps({
+    _emit({
         "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip",
         "value": round(toks_per_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_s / roofline, 4),
-    }))
+    }, dev)
     sys.stderr.write(
         f"device={dev.device_kind} params={model_gb:.2f}GB "
         f"roofline={roofline:.1f}tok/s ttft_cold={ttft_s:.2f}s "
