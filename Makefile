@@ -1,7 +1,8 @@
 # Equivalent of the reference Makefile (build/test/lint/build_release targets,
 # Makefile:1-12) for the Python/C++ tree. The reference's ios_bindings/ios
 # targets map to `embed` (C-callable worker library, native/cake_embed.cc);
-# its rsync deploy targets are deployment-specific and intentionally omitted.
+# its rsync deploy targets (Makefile:29-39) map to `deploy` below
+# (tools/deploy.py: every topology host, not two hard-coded ones).
 
 PY ?= python
 
@@ -44,8 +45,17 @@ flash-sweep:
 ttft:
 	CAKE_BENCH_TTFT=1 $(PY) bench.py
 
+# Deploy plane (reference Makefile:29-39 sync targets): push code +
+# per-worker bundles to every host in TOPOLOGY and optionally start
+# workers. Dry-run by default; DEPLOY_FLAGS="--run --start" executes.
+TOPOLOGY ?= examples/topology.yaml
+BUNDLES ?= ./bundles
+deploy:
+	$(PY) -m cake_tpu.tools.deploy --topology $(TOPOLOGY) \
+	  --bundles $(BUNDLES) $(DEPLOY_FLAGS)
+
 clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep ttft clean
+.PHONY: test lint native bench kernel-check flash-sweep ttft deploy clean

@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--address", default="127.0.0.1:10128",
                    help="worker bind address")
     p.add_argument("--topology", default=None, help="topology YAML path")
+    p.add_argument("--status-port", type=int, default=None,
+                   dest="status_port", metavar="PORT",
+                   help="worker mode: serve a live JSON status page over "
+                        "HTTP (0 = ephemeral port) — the headless "
+                        "equivalent of the reference's worker GUI")
     p.add_argument("--prompt", default="Why is the sky blue?")
     p.add_argument("--prompt-ids", default=None, dest="prompt_ids",
                    help="comma-separated token ids (bypasses the tokenizer)")
@@ -85,8 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="n-gram speculative decoding: propose K tokens per "
                         "round from the context's own n-grams and verify "
-                        "them in one dispatch (greedy only: requires "
-                        "--temperature 0; local and mesh --stages/--tp "
+                        "them in one dispatch (greedy streams bit-exact; "
+                        "sampled streams distribution-exact via rejection "
+                        "sampling; local and mesh --stages/--tp "
                         "paths)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
@@ -179,6 +185,8 @@ def run_worker(args) -> int:
     worker = Worker(args.name, config, topology, loader,
                     address=args.address, max_seq=args.max_seq,
                     kv_quant=args.kv_quant)
+    if args.status_port is not None:
+        worker.start_status_server(args.status_port)
     log.info("worker ready (%s)", memory_report())
     try:
         worker.serve_forever()

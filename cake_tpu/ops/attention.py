@@ -63,9 +63,12 @@ def _flash_ok(t: int, s: int, d: int) -> bool:
 # - decode (T=1): XLA wins at every measured shape — 0.99x at S=512 falling
 #   to 0.82x at S=8192, and 0.72-0.90x at serving batches 8/32 — the
 #   [B, H, 1, S] score row is tiny, so XLA's fused masked gemv is already
-#   bandwidth-optimal at the frontier-near-full worst case the sweep
-#   measures. (Flash decode reads only up to the frontier, so it still wins
-#   early in a long window; CAKE_PALLAS=1 forces it for such workloads.)
+#   bandwidth-optimal at the frontier-near-full worst case. The one regime
+#   with a structural case for flash decode (it reads KV blocks only up to
+#   the frontier; XLA sweeps the whole buffer) is an EARLY frontier in a
+#   long window — tools/flash_sweep.py's (s, pos) decode rows measure it;
+#   until a measured win lands in KERNELS_TPU.json, auto stays XLA and
+#   CAKE_PALLAS=1 remains the only way to force the kernel.
 PREFILL_FLASH_MIN_S = 2048
 # T floor for the flash prefill: the sweep's smallest measured chunk is
 # T=256; far below it the q-block degenerates (_pick_block of a tiny/odd T

@@ -53,7 +53,6 @@ from cake_tpu.ops.pallas.flash import (  # noqa: E402
     flash_attention_q8,
     flash_decode,
 )
-from cake_tpu.ops.pallas.fused import rms_norm_pallas  # noqa: E402
 from cake_tpu.ops.pallas.quant import quant_matmul_pallas  # noqa: E402
 
 __all__ = [
@@ -63,6 +62,5 @@ __all__ = [
     "flash_attention",
     "flash_attention_q8",
     "flash_decode",
-    "rms_norm_pallas",
     "quant_matmul_pallas",
 ]

@@ -21,6 +21,8 @@ params-pytree transform (:func:`quantize_params`), no model code changes.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 from functools import partial
 
@@ -102,6 +104,43 @@ def quant_matmul_xla(x: jax.Array, q: jax.Array, scale: jax.Array) -> jax.Array:
     return (y * scale).astype(x.dtype)
 
 
+# Trace-time backend pin (see pinned_impl). None = per-shape measured gate.
+# A ContextVar, not a module global: two serving instances with different
+# pins may dispatch (and therefore trace) from different threads
+# concurrently — a plain global could bake the WRONG pin into another
+# instance's jit cache for its whole lifetime.
+_PINNED: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "cake_quant_pinned", default=None)
+
+
+def pinned() -> str | None:
+    """The active backend pin in this context (None = measured gate)."""
+    return _PINNED.get()
+
+
+@contextlib.contextmanager
+def pinned_impl(impl: str | None):
+    """Pin ``quant_matmul``'s auto dispatch for the dynamic extent.
+
+    The measured m>=16 crossover gate picks the backend per SHAPE, so the
+    same stream's logits can differ in their low-order bits between batch
+    -size buckets or between prefix-hit and prefix-miss admission prefills
+    (different row counts -> different backend), which with temperature > 0
+    can flip a near-boundary sampled token. A serving instance closes that
+    by tracing every one of its programs under one pinned backend
+    (runtime/batch_generator.py) — the pin only needs to surround the jit
+    CALLS (tracing happens on first call), and it overrides the
+    interpret-mode default too so CPU tests exercise the same invariance.
+    ``"pallas"`` still falls back to XLA when the kernels are disabled or
+    the shape is not tileable (a pin must never crash a program the gate
+    would have run)."""
+    token = _PINNED.set(impl)
+    try:
+        yield
+    finally:
+        _PINNED.reset(token)
+
+
 def quant_matmul(
     x: jax.Array,  # [..., in]
     q: jax.Array,  # [in, out] int8
@@ -111,26 +150,41 @@ def quant_matmul(
     from cake_tpu.ops import pallas as pk
 
     if impl == "auto":
-        # The compiled kernel needs enough rows to tile the MXU; skinny
-        # inputs run XLA's gemv path, which is ~67% faster at M=1 on v5e
-        # (measured single-stream 8B int8: 84.7 vs 50.7 tok/s) and ~40%
-        # faster at M=8 (batched decode). The crossover is ~M=16, where the
-        # kernel's int8-in-VMEM streaming starts winning (522 vs 505
-        # aggregate tok/s at batch 16) — see BASELINE.md r2.
-        m = x.size // x.shape[-1]
-        impl = (
-            "pallas"
-            if pk.kernels_enabled()
-            and (
-                pk.interpret_default()
-                or (
-                    m >= 16
-                    and q.shape[0] % 256 == 0
-                    and q.shape[1] % 256 == 0
+        pin = _PINNED.get()
+        if pin is not None:
+            # instance-lifetime pin (pinned_impl): one backend for every
+            # shape this trace sees; tileability still guards the kernel
+            impl = (
+                "pallas"
+                if pin == "pallas"
+                and pk.kernels_enabled()
+                and (
+                    pk.interpret_default()
+                    or (q.shape[0] % 256 == 0 and q.shape[1] % 256 == 0)
                 )
+                else "xla"
             )
-            else "xla"
-        )
+        else:
+            # The compiled kernel needs enough rows to tile the MXU; skinny
+            # inputs run XLA's gemv path, which is ~67% faster at M=1 on
+            # v5e (measured single-stream 8B int8: 84.7 vs 50.7 tok/s) and
+            # ~40% faster at M=8 (batched decode). The crossover is ~M=16,
+            # where the kernel's int8-in-VMEM streaming starts winning (522
+            # vs 505 aggregate tok/s at batch 16) — see BASELINE.md r2.
+            m = x.size // x.shape[-1]
+            impl = (
+                "pallas"
+                if pk.kernels_enabled()
+                and (
+                    pk.interpret_default()
+                    or (
+                        m >= 16
+                        and q.shape[0] % 256 == 0
+                        and q.shape[1] % 256 == 0
+                    )
+                )
+                else "xla"
+            )
     if impl == "pallas":
         from cake_tpu.ops.pallas.quant import quant_matmul_pallas
 

@@ -73,6 +73,27 @@ def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def processed_logits(
+    logits: jax.Array,  # [vocab] f32
+    history: jax.Array,  # [repeat_last_n] int32 ring buffer, -1 empty
+    settings: SamplerSettings,
+) -> jax.Array:
+    """The exact pre-categorical transform of :func:`sample_token` —
+    repeat penalty -> temperature -> top-k -> top-p — factored out so
+    rejection-sampling speculation (runtime/speculative.py) evaluates the
+    SAME distribution the plain sampler draws from (one policy source).
+    Requires ``temperature > 0``."""
+    assert not settings.greedy, "processed_logits is the sampled-path transform"
+    if settings.repeat_penalty != 1.0:
+        logits = apply_repeat_penalty(logits, history, settings.repeat_penalty)
+    logits = logits / jnp.float32(settings.temperature)
+    if settings.top_k is not None:
+        logits = _mask_top_k(logits, settings.top_k)
+    if settings.top_p is not None:
+        logits = _mask_top_p(logits, settings.top_p)
+    return logits
+
+
 def sample_token(
     logits: jax.Array,  # [vocab] f32
     key: jax.Array,
@@ -81,18 +102,14 @@ def sample_token(
 ) -> jax.Array:
     """Pure sampling step -> scalar int32 token. Jittable; ``settings`` is
     static (mode selection mirrors llama.rs:45-58)."""
-    if settings.repeat_penalty != 1.0:
-        logits = apply_repeat_penalty(logits, history, settings.repeat_penalty)
-
     if settings.greedy:
+        if settings.repeat_penalty != 1.0:
+            logits = apply_repeat_penalty(logits, history,
+                                          settings.repeat_penalty)
         return jnp.argmax(logits).astype(jnp.int32)
-
-    logits = logits / jnp.float32(settings.temperature)
-    if settings.top_k is not None:
-        logits = _mask_top_k(logits, settings.top_k)
-    if settings.top_p is not None:
-        logits = _mask_top_p(logits, settings.top_p)
-    return jax.random.categorical(key, logits).astype(jnp.int32)
+    return jax.random.categorical(
+        key, processed_logits(logits, history, settings)
+    ).astype(jnp.int32)
 
 
 def sample_tokens(

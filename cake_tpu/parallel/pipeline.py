@@ -328,6 +328,236 @@ def build_sharded_decode(
     return jax.jit(sharded, donate_argnums=(2,))
 
 
+def build_interleaved_decode(
+    config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
+    params_like: dict | None = None, steps: int = 1,
+    kv_quant: str | None = None,
+):
+    """Compile the interleaved-microbatch serving decode: the decode twin of
+    :func:`_pipelined_prefill_layers`.
+
+    The plain staged decode (`build_sharded_decode`) serializes the S
+    pipeline stages for every token — each of the S inner steps runs the
+    layer math for the FULL batch on every stage and keeps one stage's
+    result, so (S-1)/S of the mesh's compute and KV-cache reads are
+    discarded every dispatch (the SPMD analogue of the reference's
+    "upstream workers idle while downstream compute", SURVEY.md §2). Here
+    the dp-local batch is split into S microbatches round-robined over the
+    stages: at cycle ``t`` stage ``s`` runs its layers on microbatch
+    ``(t - s) mod S``, so every stage does useful layer work on B/S rows
+    every cycle — per-cycle layer FLOPs and KV traffic drop S×, and a
+    microbatch finishing its token step re-enters stage 0 on the next
+    cycle, keeping the pipeline full across the whole ``steps`` block
+    (utilization ``steps*S / (steps*S + S)``; the one-token bubble is the
+    fill/drain).
+
+    Schedule (cycle ``t`` of ``S*(steps+1)``):
+
+    - microbatch ``m = t mod S`` arrives finished at stage 0 (valid from
+      ``t >= S``); its next token is sampled and re-injected the same cycle;
+    - the head runs on every stage with the vocab split S ways
+      (stage-0's hidden is psum-broadcast — [B/S, H], tiny — and each stage
+      computes its ``V/(S*tp)`` logit slice from a dynamic slice of the
+      replicated lm_head, reassembled by all_gather over stage then tp), so
+      per-cycle head weight reads stay at the serialized schedule's average
+      and sampling is computed bit-identically on every device — the
+      sampled-token / history / position state stays replicated-uniform
+      with no trailing cross-stage select;
+    - sampling keys are ``fold_in(row_key, index0[row] + k)`` — the same
+      per-stream token-index schedule as every other execution path, so the
+      emitted streams are bit-identical to `build_sharded_decode(per_row)`.
+
+    Same signature as ``build_sharded_decode(per_row=True)``:
+    ``(params, token [B], cache, pos [B], keys [B,2], history, hist_slot,
+    index0 [B])``; requires ``plan.sp == 1`` and ``B_local % num_stages
+    == 0`` (B_local = B/dp).
+
+    Bit-identity scope: bf16 weights are bit-identical to the serialized
+    program unconditionally. Int8 weights need a pinned quant backend
+    (``quant.pinned_impl`` — BatchGenerator always pins): without a pin
+    the m>=16 row-count gate sees B rows on the serialized head but B/S
+    here and could pick different backends.
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    S = plan.num_stages
+    if plan.sp != 1:
+        raise ValueError("interleaved decode requires sp == 1 (serving "
+                         "plane)")
+
+    def step(params, token, cache, pos, keys, history, hist_slot, index0):
+        b = token.shape[0]
+        if b % S:
+            raise ValueError(
+                f"interleaved decode needs the dp-local batch ({b}) "
+                f"divisible by num_stages ({S})"
+            )
+        bm = b // S
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq, config.rope_theta,
+            scaling=config.rope_scaling,
+        )
+        my_stage = jax.lax.axis_index(STAGE)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        hw = params["lm_head"]
+        v_local = quant.out_features(hw)
+
+        def _split_safe() -> bool:
+            """Vocab-splitting an int8 head must not change which
+            quant_matmul backend the program gets: the pallas kernel's
+            256-column tileability gate sees ``chunk`` here but
+            ``v_local`` on the serialized head, so a backend-divergent
+            split would make the two schedules' logits differ in
+            low-order bits and break the bit-identity contract
+            (`_pick_decode` swaps schedules freely). Split when the
+            backend provably cannot differ — all-XLA (kernels off or an
+            "xla" pin), all-pallas (interpret mode), or both widths on
+            the same side of the tileability gate. Evaluated at TRACE
+            time so a BatchGenerator's pin (quant.pinned_impl around the
+            dispatch) is visible. bf16 heads slice bitwise-safely at any
+            width."""
+            if v_local % S:
+                return False
+            if not isinstance(hw, quant.QuantizedLinear):
+                return True
+            from cake_tpu.ops import pallas as pk
+
+            pin = quant.pinned()
+            if not pk.kernels_enabled() or pin == "xla":
+                return True  # everything runs XLA either way
+            if pin == "pallas" and pk.interpret_default():
+                return True  # everything runs (interpreted) pallas
+            return ((v_local // S) % 256 == 0) == (v_local % 256 == 0)
+
+        split_safe = _split_safe()
+
+        def head_logits(x_n):
+            """Full [bm, V] f32 logits with the vocab additionally split
+            over the stage axis (falls back to per-stage full width when
+            the local vocab does not divide or the split would change the
+            quantized head's backend class)."""
+            if S > 1 and split_safe:
+                chunk = v_local // S
+                start = my_stage * chunk
+                if isinstance(hw, quant.QuantizedLinear):
+                    sub = quant.QuantizedLinear(
+                        q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
+                        scale=jax.lax.dynamic_slice_in_dim(
+                            hw.scale, start, chunk, 0),
+                    )
+                else:
+                    sub = jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
+                lg = quant.dense(x_n, sub).astype(jnp.float32)
+                lg = jax.lax.all_gather(lg, STAGE, axis=-1, tiled=True)
+            else:
+                lg = quant.dense(x_n, hw).astype(jnp.float32)
+            return jax.lax.all_gather(lg, TP, axis=-1, tiled=True)
+
+        def body(t, carry):
+            x, ck, cv, pos_all, history, hist_slot, toks = carry
+            m_fin = jnp.mod(t, S)           # arriving at / injected by stage 0
+            base_fin = m_fin * bm
+            k_arr = jnp.maximum(t // S - 1, 0)  # token index of the arrival
+            arriving = t >= S               # stage 0 holds a real finished mb
+            injecting = t < steps * S
+
+            # ---- head + sample (uniform on every device) ----
+            x_fin = _select_stage0(x[:, -1, :])  # [bm, H]
+            x_n = rms_norm(x_fin, params["norm_f"], config.rms_norm_eps)
+            logits = head_logits(x_n)            # [bm, V] f32
+            key_rows = jax.lax.dynamic_slice_in_dim(keys, base_fin, bm, 0)
+            idx_rows = jax.lax.dynamic_slice_in_dim(index0, base_fin, bm, 0)
+            hist_rows = jax.lax.dynamic_slice_in_dim(history, base_fin, bm, 0)
+            slot_rows = jax.lax.dynamic_slice_in_dim(hist_slot, base_fin, bm, 0)
+            step_keys = jax.vmap(jax.random.fold_in)(key_rows,
+                                                     idx_rows + k_arr)
+            sampled = sampling.sample_tokens_keyed(logits, step_keys,
+                                                   hist_rows, settings)
+
+            # commit the arrival's token + history rows (uniform predication)
+            cur = jax.lax.dynamic_slice(toks, (k_arr, base_fin), (1, bm))
+            toks = jax.lax.dynamic_update_slice(
+                toks, jnp.where(arriving, sampled[None], cur),
+                (k_arr, base_fin),
+            )
+            h_new, s_new = sampling.push_history_batched(hist_rows, slot_rows,
+                                                         sampled)
+            history = jax.lax.dynamic_update_slice(
+                history, jnp.where(arriving, h_new, hist_rows), (base_fin, 0))
+            hist_slot = jax.lax.dynamic_update_slice(
+                hist_slot, jnp.where(arriving, s_new, slot_rows), (base_fin,))
+
+            # the re-injected microbatch decodes at its next position
+            pos_rows = jax.lax.dynamic_slice_in_dim(pos_all, base_fin, bm, 0)
+            pos_rows = jnp.where(arriving & injecting, pos_rows + 1, pos_rows)
+            pos_all = jax.lax.dynamic_update_slice(pos_all, pos_rows,
+                                                   (base_fin,))
+
+            # stage 0 embeds + injects: the caller's token on first entry,
+            # the just-sampled token thereafter
+            tok_rows = jax.lax.dynamic_slice_in_dim(token, base_fin, bm, 0)
+            tok_inj = jnp.where(arriving, sampled, tok_rows)
+            x_inj = params["embed"][tok_inj[:, None]].astype(config.jax_dtype)
+            x = jnp.where((my_stage == 0) & injecting, x_inj, x)
+
+            # ---- layer pass on this stage's resident microbatch ----
+            m_res = jnp.mod(t - my_stage, S)
+            base_res = m_res * bm
+            valid = (t >= my_stage) & (t < my_stage + steps * S)
+            pos_res = jax.lax.dynamic_slice_in_dim(pos_all, base_res, bm, 0)
+            rows = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, base_res, bm, 1),
+                KVCache(k=ck, v=cv),
+            )
+            h, rows = llama.forward_layers(
+                params["layers"], x, rows, cos, sin, pos_res, config,
+                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+                write_gate=valid,
+            )
+            x = jnp.where(valid, h, x)
+            # gated-off forward_layers rewrites current contents unchanged,
+            # so the row write-back is unconditional
+            ck, cv = jax.tree.map(
+                lambda buf, r: jax.lax.dynamic_update_slice_in_dim(
+                    buf, r, base_res, 1),
+                (ck, cv), (rows.k, rows.v),
+            )
+            x = jax.lax.ppermute(x, STAGE, perm)
+            return x, ck, cv, pos_all, history, hist_slot, toks
+
+        x0 = jnp.zeros((bm, 1, config.hidden_size), config.jax_dtype)
+        toks0 = jnp.zeros((steps, b), jnp.int32)
+        _, ck, cv, _, history, hist_slot, toks = jax.lax.fori_loop(
+            0, S * (steps + 1), body,
+            (x0, cache.k, cache.v, pos, history, hist_slot, toks0),
+        )
+        if steps == 1:
+            return toks[0], KVCache(k=ck, v=cv), history, hist_slot
+        return toks, KVCache(k=ck, v=cv), history, hist_slot
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(params_like),
+            P(DP),
+            cache_specs(kv_quant),
+            P(DP),
+            P(DP, None),
+            P(DP, None),
+            P(DP),
+            P(DP),
+        ),
+        out_specs=(
+            P(DP) if steps == 1 else P(None, DP),
+            cache_specs(kv_quant),
+            P(DP, None),
+            P(DP),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
 def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
                         params_like: dict | None = None,
                         kv_quant: str | None = None):

@@ -32,18 +32,20 @@ decode dispatch, then splices the finished row into its slot. ``admit()``
 is the synchronous variant. Admission timing never changes a stream's
 output (per-row positions + per-row token indices).
 
-Caveat (int8 weights only): ``ops.quant.quant_matmul`` auto-selects its
-backend by row count (XLA gemv below ~16 rows, the Pallas kernel above —
-the measured perf crossover), so with quantized weights and temperature > 0
-a stream's low-order logit bits can differ between batch-size *buckets*
-(e.g. batch 8 vs 16), which near a top-k/top-p boundary may flip a sampled
-token. Within a fixed batch size the invariants hold exactly; set
-``CAKE_PALLAS=0`` to pin one backend and recover strict cross-bucket
-reproducibility. bf16 weights are unaffected. The same caveat applies to
-admission-prefill geometry: a prefix-cache hit prefills only the arrival's
-remainder (fewer matmul rows than the from-scratch pass), so int8 weights
-+ temperature > 0 can flip a near-boundary sampled token depending on
-whether the prefix matched. Greedy streams are exact in all cases.
+Int8-weight determinism: ``ops.quant.quant_matmul``'s measured m>=16
+crossover would pick its backend per shape, so the SAME stream could see
+different low-order logit bits between batch-size buckets or between
+prefix-hit and prefix-miss admission prefills. An instance therefore PINS
+one backend for its whole lifetime (``quant.pinned_impl``): explicitly via
+``quant_backend=``, else chosen at first ``set_prompts`` from the dp-local
+batch geometry against the measured crossover. Every program the instance
+dispatches traces under that pin, so WITHIN an instance sampled int8
+streams are invariant to batch-size buckets, admission timing, and
+prefix-cache hits. Across two *differently sized* instances that land on
+opposite sides of the crossover the pins (and low-order logit bits) can
+still differ — pass the same explicit ``quant_backend`` to both when
+cross-instance bit-reproducibility matters more than the measured
+crossover's throughput.
 """
 
 from __future__ import annotations
@@ -56,7 +58,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
-from cake_tpu.ops import sampling
+from cake_tpu.ops import quant, sampling
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.mesh import (
     MeshPlan,
@@ -65,6 +67,7 @@ from cake_tpu.parallel.mesh import (
 )
 from cake_tpu.parallel.pipeline import (
     build_admit_prefill,
+    build_interleaved_decode,
     build_sharded_decode,
     build_sharded_prefill,
 )
@@ -106,6 +109,10 @@ class BatchGenerator:
         kv_quant: str | None = None,
         admit_chunk: int | None = None,
         prefix_share_min: int = 32,
+        interleave: bool | None = None,
+        prefix_cache_entries: int = 2,
+        prefix_block: int = 64,
+        quant_backend: str | None = None,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -126,19 +133,53 @@ class BatchGenerator:
         # serving-side long-context lever
         self.kv_quant = kv_quant
         self.params = shard_params(params, plan.mesh)
-        self._prefill = build_sharded_prefill(config, plan,
-                                              params_like=self.params,
-                                              kv_quant=kv_quant)
-        self._decode_single = build_sharded_decode(
+        # Int8 backend pin: explicit (quant_backend=) or decided once at
+        # first set_prompts from the dp-local batch geometry (measured
+        # m>=16 crossover), then applied to every program dispatch for the
+        # instance's lifetime — see the module docstring's determinism
+        # contract and its cross-instance scope note.
+        if quant_backend not in (None, "xla", "pallas"):
+            raise ValueError(
+                f"quant_backend must be 'xla' or 'pallas', got "
+                f"{quant_backend!r}"
+            )
+        self._quant_pin: str | None = quant_backend
+        self._prefill = self._pinned(build_sharded_prefill(
+            config, plan, params_like=self.params, kv_quant=kv_quant))
+        self._decode_single = self._pinned(build_sharded_decode(
             config, self.settings, plan, params_like=self.params,
             per_row=True, kv_quant=kv_quant,
-        )
+        ))
         self._decode_block = (
-            build_sharded_decode(config, self.settings, plan,
-                                 params_like=self.params,
-                                 steps=self.block_size, per_row=True,
-                                 kv_quant=kv_quant)
+            self._pinned(build_sharded_decode(config, self.settings, plan,
+                                              params_like=self.params,
+                                              steps=self.block_size,
+                                              per_row=True,
+                                              kv_quant=kv_quant))
             if self.block_size > 1 else None
+        )
+        # Interleaved-microbatch schedule (pipeline.build_interleaved_decode):
+        # with num_stages > 1 every stage decodes a different microbatch each
+        # cycle instead of (S-1)/S of the mesh computing into a discarded
+        # select. Output streams are bit-identical, so it swaps in at
+        # dispatch whenever the batch divides by the stage count; serialized
+        # programs remain the fallback (programs compile lazily on first
+        # use, so the unused path costs nothing).
+        self._interleave = (
+            plan.num_stages > 1 if interleave is None
+            else interleave and plan.num_stages > 1
+        )
+        self._decode_single_il = (
+            self._pinned(build_interleaved_decode(
+                config, self.settings, plan, params_like=self.params,
+                steps=1, kv_quant=kv_quant))
+            if self._interleave else None
+        )
+        self._decode_block_il = (
+            self._pinned(build_interleaved_decode(
+                config, self.settings, plan, params_like=self.params,
+                steps=self.block_size, kv_quant=kv_quant))
+            if self._interleave and self.block_size > 1 else None
         )
         self._base_key = jax.random.PRNGKey(self.settings.seed)
         self.streams: list[_Stream] = []
@@ -170,9 +211,21 @@ class BatchGenerator:
         self.__admit_prefill = None
         self.__prefill_offset = None
         self.__broadcast_progs: dict = {}
-        # shared-prefix KV row cached for arrival reuse (set_prompts fills
-        # it when prefix sharing kicks in): {"ids": [...], "row": cache}
-        self._prefix_cache: dict | None = None
+        # Generalized prefix store: staged batch-1 KV rows keyed by their
+        # token prefix (insertion-ordered dict = LRU). Populated by the
+        # set_prompts shared prefix AND by every completed admission (its
+        # prefix truncated to a prefix_block boundary), so arrivals with
+        # DIFFERENT system prompts each hit their own cached prefix. A row
+        # may hold donor KV past the match length — positions >= the match
+        # base are beyond the reusing stream's causal frontier until its
+        # own remainder prefill/decode overwrites them, the same
+        # never-attendable invariant as bucketed-prefill padding. Entries
+        # cost one batch-1 cache each; prefix_cache_entries caps HBM
+        # (0 disables reuse).
+        self._prefix_store: dict[tuple, object] = {}
+        self._prefix_entries = max(0, prefix_cache_entries)
+        self._prefix_block = max(1, prefix_block)
+        self._prefix_hits = 0
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
         # counters plus busy wall-clock, reported by stats().
@@ -187,10 +240,10 @@ class BatchGenerator:
         """Offset prefill program (shared-prefix remainders), compiled on
         first use."""
         if self.__prefill_offset is None:
-            self.__prefill_offset = build_sharded_prefill(
+            self.__prefill_offset = self._pinned(build_sharded_prefill(
                 self.config, self.plan, params_like=self.params,
                 kv_quant=self.kv_quant, with_offset=True,
-            )
+            ))
         return self.__prefill_offset
 
     def _prefill_shared_prefix(self, prefix: list[int], b: int) -> None:
@@ -214,7 +267,7 @@ class BatchGenerator:
             self._n_admit_dispatches += 1
         # keep the staged prefix row: arrivals opening with the same
         # prefix start from a copy of it instead of re-prefilling
-        self._prefix_cache = {"ids": list(prefix), "row": staging}
+        self._store_prefix(list(prefix), staging)
         self.cache = self._broadcast_prog(b)(staging)
 
     def _broadcast_prog(self, b: int):
@@ -251,11 +304,21 @@ class BatchGenerator:
         """Admission-prefill program, compiled on first use (callers that
         never admit mid-run pay nothing)."""
         if self.__admit_prefill is None:
-            self.__admit_prefill = build_admit_prefill(
+            self.__admit_prefill = self._pinned(build_admit_prefill(
                 self.config, self.plan, params_like=self.params,
                 kv_quant=self.kv_quant,
-            )
+            ))
         return self.__admit_prefill
+
+    def _pinned(self, fn):
+        """Wrap a compiled program so every dispatch — and therefore its
+        trace, which happens on first call — runs under this instance's
+        pinned int8 matmul backend (``quant.pinned_impl``). A no-op until
+        the pin is decided and for bf16 weights."""
+        def wrapped(*args):
+            with quant.pinned_impl(self._quant_pin):
+                return fn(*args)
+        return wrapped
 
     # -- prompt intake -------------------------------------------------------
     def _encode(self, p) -> list[int]:
@@ -309,6 +372,14 @@ class BatchGenerator:
         n_active = len(ids_list)
         dp = self.plan.dp
         batch = -(-n_active // dp) * dp
+        if self._quant_pin is None:
+            # instance-lifetime int8 backend choice from the dp-local
+            # decode geometry (the measured m>=16 crossover, BASELINE.md
+            # r2); decided before any program traces so every bucket and
+            # admission path sees the same backend
+            self._quant_pin = (
+                "pallas" if batch // dp >= 16 else "xla"
+            )
         self.streams = [
             _Stream(
                 stream_id=sid, prompt=ids,
@@ -323,13 +394,14 @@ class BatchGenerator:
             )
         b = len(self.streams)
 
+        # (the prefix store survives set_prompts: rows depend only on
+        # params/config, both fixed for the instance's lifetime)
         # Shared-prefix detection: a common system prompt is prefilled ONCE
         # (single replicated row) and broadcast into every stream's cache
         # rows; only the per-stream remainders go through the batched
         # prefill, at offset lcp. Capped one short of the shortest prompt so
         # every row keeps >= 1 remainder token. Bit-identical output —
         # positions and tokens are unchanged, only the redundancy goes.
-        self._prefix_cache = None
         lcp = 0
         if b > 1 and self._prefix_share_min:
             first = self.streams[0].prompt
@@ -437,6 +509,31 @@ class BatchGenerator:
         """Arrivals not yet fully admitted (queued + in-flight)."""
         return len(self._arrivals) + (1 if self._staging is not None else 0)
 
+    def _store_prefix(self, ids: list[int], row) -> None:
+        """Insert a staged batch-1 KV row under its token prefix,
+        LRU-capped at ``prefix_cache_entries`` rows."""
+        if self._prefix_entries <= 0 or len(ids) < self._prefix_share_min:
+            return
+        key = tuple(ids)
+        self._prefix_store.pop(key, None)
+        self._prefix_store[key] = row
+        while len(self._prefix_store) > self._prefix_entries:
+            self._prefix_store.pop(next(iter(self._prefix_store)))
+
+    def _match_prefix(self, ids: list[int]):
+        """Longest stored prefix STRICTLY shorter than the prompt (at
+        least one remainder token must produce the first-token logits).
+        Returns ``(base, row)``; a hit is bumped to LRU-most-recent."""
+        best, row = 0, None
+        for key in self._prefix_store:
+            m = len(key)
+            if m > best and m < len(ids) and tuple(ids[:m]) == key:
+                best, row = m, self._prefix_store[key]
+        if row is not None:
+            key = tuple(ids[:best])
+            self._prefix_store[key] = self._prefix_store.pop(key)
+        return best, row
+
     def _admission_chunk_for(self, prompt_len: int) -> int:
         """The per-dispatch admission chunk for a prompt of this length:
         the configured interleave granularity, but never padded past the
@@ -473,30 +570,29 @@ class BatchGenerator:
             if not self._arrivals or self._free_slot() is None:
                 return
             ids, sid = self._arrivals.pop(0)
-            # Prefix reuse: an arrival that opens with the batch's cached
-            # shared prefix starts from a COPY of the staged prefix row
-            # (one cheap buffer copy) and prefills only its remainder —
-            # every arrival re-prefilling the system prompt is exactly the
-            # waste the prefix cache exists to kill. Falls back to a
-            # from-scratch prefill when the remainder's bucket would not
-            # fit above the prefix.
-            base = 0
-            pfx = self._prefix_cache
-            if (pfx is not None and len(ids) > len(pfx["ids"])
-                    and ids[: len(pfx["ids"])] == pfx["ids"]):
-                base = len(pfx["ids"])
+            # Prefix reuse: an arrival whose opening tokens match a stored
+            # prefix row (its batch's system prompt, or ANY earlier
+            # admission's block-aligned prefix) starts from a COPY of that
+            # row and prefills only its remainder — re-prefilling a known
+            # prefix is exactly the waste the store exists to kill. Falls
+            # back to a from-scratch prefill when the remainder's bucket
+            # would not fit above the prefix.
+            base, row = self._match_prefix(ids)
             rem = len(ids) - base
             chunk = self._admission_chunk_for(rem)
             t_pad = -(-rem // chunk) * chunk
             if base and base + t_pad > self.max_seq:
-                base = 0
+                base, row = 0, None
                 rem = len(ids)
                 chunk = self._admission_chunk_for(rem)
                 t_pad = -(-rem // chunk) * chunk
             tokens = np.zeros((1, t_pad), np.int32)
             tokens[0, :rem] = ids[base:]
             if base:
-                cache = jax.tree.map(lambda x: x.copy(), pfx["row"])
+                self._prefix_hits += 1
+                # copy: the admission program donates its cache argument,
+                # and the stored row must survive for future hits
+                cache = jax.tree.map(lambda x: x.copy(), row)
             else:
                 cache = init_cache_on_mesh(
                     self.config, self.plan.mesh, batch=1,
@@ -577,6 +673,16 @@ class BatchGenerator:
         row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done)
         self._pending_rows.append(row)
 
+        # Feed the store: this arrival's prefix, truncated to a
+        # prefix_block boundary, becomes reusable by future arrivals with
+        # the same opening (a hit-extended row — base old-prefix + this
+        # remainder — works the same way: st["cache"] holds KV for the
+        # whole prompt). The splice above copied values out, so retaining
+        # the staging row costs no extra dispatch.
+        base_new = ((len(ids) - 1) // self._prefix_block) * self._prefix_block
+        if base_new >= max(1, self._prefix_share_min):
+            self._store_prefix(ids[:base_new], st["cache"])
+
     def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
         """Admit a new prompt into a finished slot of a RUNNING batch,
         synchronously: the chunked one-row admission prefill runs to
@@ -644,6 +750,20 @@ class BatchGenerator:
         self._admission_tick()
         if self._pending_rows:
             return self._pending_rows.pop(0)
+        return self._step_decode()
+
+    def _pick_decode(self, block: bool):
+        """Serialized vs interleaved schedule for this dispatch: the
+        interleaved program needs the dp-local batch divisible by the stage
+        count; outputs are bit-identical either way."""
+        serial = self._decode_block if block else self._decode_single
+        il = self._decode_block_il if block else self._decode_single_il
+        if il is None:
+            return serial
+        local = len(self.streams) // self.plan.dp
+        return il if local % self.plan.num_stages == 0 else serial
+
+    def _step_decode(self):
         if self._block_buf:
             return self._emit(self._block_buf.pop(0))
 
@@ -668,7 +788,7 @@ class BatchGenerator:
         if can_block:
             t0 = time.perf_counter()
             toks, self.cache, self._history, self._hist_slot = (
-                self._decode_block(
+                self._pick_decode(block=True)(
                     self.params, self._last_tokens, self.cache,
                     jnp.asarray(self._pos), self._keys, self._history,
                     self._hist_slot, jnp.asarray(self._index),
@@ -686,7 +806,9 @@ class BatchGenerator:
         if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
             raise RuntimeError("KV cache exhausted")  # window-full streams done
         t0 = time.perf_counter()
-        tok, self.cache, self._history, self._hist_slot = self._decode_single(
+        tok, self.cache, self._history, self._hist_slot = self._pick_decode(
+            block=False
+        )(
             self.params, self._last_tokens, self.cache,
             jnp.asarray(self._pos), self._keys, self._history,
             self._hist_slot, jnp.asarray(self._index),
@@ -719,6 +841,8 @@ class BatchGenerator:
             "tokens_emitted": self._n_emitted,
             "decode_dispatches": self._n_decode_dispatches,
             "admit_dispatches": self._n_admit_dispatches,
+            "prefix_hits": self._prefix_hits,
+            "prefix_entries": len(self._prefix_store),
             "tokens_per_dispatch": (
                 round(self._n_emitted / dispatches, 2) if dispatches else None
             ),

@@ -17,9 +17,12 @@ acceptance rate converts directly into tok/s. On repetitive stretches
 (code, quotes, structured text) prompt-lookup acceptance is high; worst
 case costs one dispatch per token, like plain decode.
 
-Exactness is greedy-only (``temperature == 0``): sampled streams would need
-rejection sampling to keep the output distribution; the constructor rejects
-``temperature > 0`` rather than silently changing a stream.
+Greedy streams (``temperature == 0``) are bit-identical to plain decode.
+Sampled streams (``temperature > 0``, the serving default) use REJECTION
+SAMPLING (:func:`accept_sampled_fn`): each emitted token's conditional
+distribution given the prefix is exactly the plain sampler's categorical —
+distribution-preserving, not sample-path-preserving (a fixed seed yields a
+different but identically-distributed stream than plain decode).
 """
 
 from __future__ import annotations
@@ -115,6 +118,71 @@ def accept_fn(
     return toks, count, history, hist_slot
 
 
+def accept_sampled_fn(
+    logits,  # [T, vocab] f32 (T = K + 1)
+    proposals,  # [K] int32, -1-padded
+    history,
+    hist_slot,
+    eos_ids,  # [E] int32 (-1-padded when fewer)
+    round_key,  # PRNG key for this verification round
+    settings: SamplerSettings,
+):
+    """Rejection-sampling accept scan for ``temperature > 0``.
+
+    The prompt-lookup draft is DETERMINISTIC (q is a point mass on the
+    proposal), so the standard speculative-sampling rule (Leviathan et al.;
+    Chen et al.) reduces cleanly: accept proposal ``x`` with probability
+    ``p(x)`` (p = the plain sampler's penalized/temperature-scaled/top-k/
+    top-p categorical, via ``sampling.processed_logits``); on rejection,
+    sample the replacement from the residual ``norm(max(p - q, 0))`` — p
+    with the proposal's mass zeroed. If all K proposals are accepted, the
+    bonus row samples from its p directly. Per emitted token the
+    conditional distribution given the prefix is exactly p: acceptance
+    contributes ``p(x)·1[y=x]`` and rejection ``(1-p(x))·p(y)/(1-p(x))``
+    for ``y != x``.
+
+    Returns ``(tokens [T], count, history, hist_slot)`` like
+    :func:`accept_fn`; the stream stops at the first rejection, EOS, or the
+    bonus token. A -1 pad row never accepts (it behaves as "no proposal":
+    sample from full p and stop)."""
+    k = proposals.shape[0]
+    keys = jax.random.split(round_key, logits.shape[0])
+
+    def body(carry, i):
+        alive, count, history, hist_slot = carry
+        lg = sampling.processed_logits(logits[i], history, settings)
+        ku, kr = jax.random.split(keys[i])
+        is_bonus = i >= k
+        prop = proposals[jnp.minimum(i, k - 1)]
+        p_prop = jax.nn.softmax(lg)[jnp.maximum(prop, 0)]
+        accept = (~is_bonus) & (prop >= 0) & (
+            jax.random.uniform(ku) < p_prop
+        )
+        # residual: p with the rejected proposal removed, renormalized
+        lg_res = jnp.where(
+            jnp.arange(lg.shape[0], dtype=jnp.int32) == prop,
+            jnp.float32(-1e30), lg,
+        )
+        g_rej = jax.random.categorical(kr, lg_res).astype(jnp.int32)
+        g_bonus = jax.random.categorical(kr, lg).astype(jnp.int32)
+        g = jnp.where(accept, prop, jnp.where(is_bonus, g_bonus, g_rej))
+        nh, ns = sampling.push_history(history, hist_slot, g)
+        history = jnp.where(alive, nh, history)
+        hist_slot = jnp.where(alive, ns, hist_slot)
+        count = count + alive.astype(jnp.int32)
+        is_eos = (g == eos_ids).any()
+        # a rejection/bonus row emits its sample and ends the round
+        alive = alive & accept & ~is_eos
+        return (alive, count, history, hist_slot), g
+
+    (_, count, history, hist_slot), toks = jax.lax.scan(
+        body,
+        (jnp.asarray(True), jnp.int32(0), history, hist_slot),
+        jnp.arange(logits.shape[0], dtype=jnp.int32),
+    )
+    return toks, count, history, hist_slot
+
+
 class SpeculativeMixin:
     """The speculation loop, shared by the single-chip and mesh
     generators. Subclasses build ``self._verify`` (a compiled
@@ -130,19 +198,16 @@ class SpeculativeMixin:
         return logits
 
     def _spec_init(self, spec_k: int, spec_ngram: int) -> None:
-        if self.settings.temperature > 0:
-            raise ValueError(
-                "speculative decoding is exact only for greedy streams; "
-                "use temperature 0 (sampled streams would need rejection "
-                "sampling to preserve the output distribution)"
-            )
         self.spec_k = int(spec_k)
         self.spec_ngram = int(spec_ngram)
         if self.spec_k < 1:
             raise ValueError("spec_k must be >= 1")
         eos = sorted(self._eos_ids) or [-1]
         self._eos_arr = jnp.asarray(eos, jnp.int32)
-        self._accept = jax.jit(partial(accept_fn, settings=self.settings))
+        # greedy: exact match accept (bit-identical streams); sampled:
+        # rejection sampling (distribution-identical streams)
+        accept = accept_fn if self.settings.greedy else accept_sampled_fn
+        self._accept = jax.jit(partial(accept, settings=self.settings))
         self.dispatches = 0
         self.emitted = 0
 
@@ -167,10 +232,25 @@ class SpeculativeMixin:
         padded = np.full((self.spec_k,), -1, np.int32)
         padded[: len(proposal)] = proposal
         logits = self._verify_dispatch(fed, self._pos)
-        toks, count, self._history, self._hist_slot = self._accept(
-            logits, jnp.asarray(padded), self._history, self._hist_slot,
-            self._eos_arr,
-        )
+        if self.settings.greedy:
+            toks, count, self._history, self._hist_slot = self._accept(
+                logits, jnp.asarray(padded), self._history, self._hist_slot,
+                self._eos_arr,
+            )
+        else:
+            # One fresh key per round: _pos strictly increases between
+            # dispatches, so round keys never repeat within a stream. The
+            # round key lives in its own fold domain (0x5bec) — the plain
+            # single-step fallback samples with fold_in(self._key, index)
+            # (generator.py), and reusing that exact derivation here would
+            # correlate a round's draws with a fallback step's.
+            round_key = jax.random.fold_in(
+                jax.random.fold_in(self._key, 0x5BEC), self._pos
+            )
+            toks, count, self._history, self._hist_slot = self._accept(
+                logits, jnp.asarray(padded), self._history, self._hist_slot,
+                self._eos_arr, round_key,
+            )
         n = int(count)
         emitted = np.asarray(toks[:n]).tolist()
         self.dispatches += 1
@@ -184,13 +264,18 @@ class SpeculativeMixin:
 
 
 class SpeculativeGenerator(SpeculativeMixin, LlamaGenerator):
-    """Greedy single-stream generator with prompt-lookup speculation.
+    """Single-stream generator with prompt-lookup speculation.
 
     ``spec_k`` tokens are proposed per round (n-grams up to ``spec_ngram``
     long); each round is one verification dispatch emitting 1..K+1 tokens.
     When no proposal exists (or the window tail is near), falls back to the
     plain single-step program. ``dispatches``/``emitted`` counters expose
-    the speedup structure (tokens-per-dispatch > 1 is the win)."""
+    the speedup structure (tokens-per-dispatch > 1 is the win).
+
+    Greedy streams are bit-identical to plain decode; ``temperature > 0``
+    streams are distribution-identical via rejection sampling
+    (:func:`accept_sampled_fn`), so speculation composes with the serving
+    default sampler."""
 
     def __init__(
         self,
@@ -217,7 +302,8 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
     the verification pass runs as ONE compiled program across the
     (stage, tp) mesh (``parallel.pipeline.build_sharded_verify``), so
     multi-chip decode also lands 1..K+1 tokens per dispatch. Same
-    greedy-exactness contract as the single-chip variant."""
+    exactness contract as the single-chip variant: greedy bit-identical,
+    sampled distribution-identical (rejection sampling)."""
 
     def __init__(
         self,

@@ -93,8 +93,20 @@ class Worker:
         addr, port = address.rsplit(":", 1)
         self.listener = wire.Listener(addr, int(port))
         self.port = self.listener.port
+        self._bind_host = addr
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        # live counters behind the status surface (the reference's worker
+        # app renders this state in a SwiftUI view, ContentView.swift:28-56;
+        # on a headless TPU VM the equivalent is an HTTP JSON endpoint)
+        self._stat_lock = threading.Lock()
+        self._total_ops = 0
+        self._total_bytes_in = 0
+        self._total_bytes_out = 0
+        self._conns_live = 0
+        self._conns_total = 0
+        self._started = time.time()
+        self._status_httpd = None
 
     # -- serving ------------------------------------------------------------
     def serve_forever(self) -> None:
@@ -121,8 +133,77 @@ class Worker:
         th.start()
         return th
 
+    # -- status surface ------------------------------------------------------
+    def status(self) -> dict:
+        """Live worker state as a plain dict: identity (the WorkerInfo
+        handshake fields), assigned layer runs, and serving counters."""
+        from cake_tpu.utils.memory import rss_bytes
+
+        info = self._info()
+        with self._stat_lock:
+            return {
+                "name": info.name,
+                "version": info.version,
+                "os": info.os,
+                "arch": info.arch,
+                "device": info.device,
+                "device_idx": info.device_idx,
+                "dtype": info.dtype,
+                "kv_quant": self.kv_quant,
+                "max_seq": self.max_seq,
+                "port": self.port,
+                "layer_runs": [list(r) for r in self.runs],
+                "uptime_s": round(time.time() - self._started, 1),
+                "connections_live": self._conns_live,
+                "connections_total": self._conns_total,
+                "ops_total": self._total_ops,
+                "bytes_in": self._total_bytes_in,
+                "bytes_out": self._total_bytes_out,
+                "rss_bytes": rss_bytes(),
+            }
+
+    def start_status_server(self, port: int = 0) -> int:
+        """Serve ``status()`` as JSON over HTTP on ``port`` (0 = ephemeral;
+        returns the bound port). The headless-deployment equivalent of the
+        reference's worker GUI (`cake-ios-worker-app/Cake
+        Worker/ContentView.swift:28-56` renders name/device/layers/state;
+        here ``curl :port/`` or a browser does). Binds the same host the
+        worker's ``--address`` chose — a loopback-only worker must not
+        leak its status on every interface. Daemon-threaded; stopped by
+        :meth:`shutdown`."""
+        import http.server
+        import json as _json
+
+        worker = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib casing)
+                body = _json.dumps(worker.status(), indent=1).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                log.debug("status: " + fmt, *args)
+
+        self._status_httpd = http.server.ThreadingHTTPServer(
+            (self._bind_host, port), Handler)
+        th = threading.Thread(target=self._status_httpd.serve_forever,
+                              daemon=True)
+        th.start()
+        bound = self._status_httpd.server_address[1]
+        log.info("worker %s status page on http://%s:%d/", self.name,
+                 self._bind_host, bound)
+        return bound
+
     def shutdown(self) -> None:
         self._stop.set()
+        if self._status_httpd is not None:
+            self._status_httpd.shutdown()
+            self._status_httpd.server_close()
+            self._status_httpd = None
         # A blocked accept() does not return when the fd is closed from
         # another thread on Linux; wake it with a throwaway connection.
         try:
@@ -161,6 +242,9 @@ class Worker:
         ops_done = 0
         t_window = time.perf_counter()
         bytes_in = bytes_out = 0
+        with self._stat_lock:
+            self._conns_live += 1
+            self._conns_total += 1
         try:
             t, _ = conn.recv()
             if t != MsgType.HELLO:
@@ -192,6 +276,10 @@ class Worker:
                 bytes_out += len(reply)
                 conn.send(MsgType.TENSOR, reply)
                 ops_done += len(ops)
+                with self._stat_lock:
+                    self._total_ops += len(ops)
+                    self._total_bytes_in += len(payload)
+                    self._total_bytes_out += len(reply)
                 if ops_done >= STATS_EVERY:
                     dt = time.perf_counter() - t_window
                     log.info(
@@ -203,6 +291,8 @@ class Worker:
                     ops_done = 0
                     bytes_in = bytes_out = 0
         finally:
+            with self._stat_lock:
+                self._conns_live -= 1
             conn.close()
 
     def _run_ops(

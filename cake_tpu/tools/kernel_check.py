@@ -94,7 +94,6 @@ def check_kernels(dtype=jnp.bfloat16) -> tuple[list, bool]:
         flash_decode,
         interpret_default,
         quant_matmul_pallas,
-        rms_norm_pallas,
     )
 
     dev = jax.devices()[0]
@@ -168,19 +167,6 @@ def check_kernels(dtype=jnp.bfloat16) -> tuple[list, bool]:
     x_ms = _time_ms(qm_xla, x, ql.q, ql.scale)
     all_ok &= _report("quant_matmul_4096x4096_int8", device, compiled, err,
                       p_ms, x_ms, 1.0, results)
-
-    # -- rms_norm ------------------------------------------------------------
-    xr = jax.random.normal(ks[6], (512, 4096), dtype)
-    wr = 1.0 + 0.1 * jax.random.normal(ks[7], (4096,), dtype)
-    rn_pal = jax.jit(partial(rms_norm_pallas, eps=1e-5, interpret=not compiled))
-    rn_xla = jax.jit(partial(norms.rms_norm, eps=1e-5))
-    got = rn_pal(xr, wr)
-    want = rn_xla(xr, wr)
-    err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - want.astype(jnp.float32))))
-    p_ms = _time_ms(rn_pal, xr, wr)
-    x_ms = _time_ms(rn_xla, xr, wr)
-    all_ok &= _report("rms_norm_512x4096", device, compiled, err, p_ms, x_ms,
-                      0.05, results)
 
     return results, all_ok
 

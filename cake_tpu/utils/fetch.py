@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import fnmatch
 import logging
+import os
 import shutil
 from pathlib import Path
 
@@ -178,9 +179,23 @@ def _fetch_hub(repo: str, dest: Path, patterns: tuple[str, ...],
     ):
         verdict = _legacy_identity_ok(repo, revision, dest)
         if verdict is None:
+            # Hub unreachable: identity cannot be judged. Default is
+            # serve-with-a-warning (an offline pod must not be bricked by
+            # a transient hub outage); CAKE_FETCH_STRICT=1 closes the
+            # remaining serve-model-B-as-A window by refusing instead —
+            # the posture for anything where mislabeling is worse than
+            # unavailability.
+            if os.environ.get("CAKE_FETCH_STRICT") == "1":
+                raise RuntimeError(
+                    f"{dest} is a complete but unstamped checkout and the "
+                    f"hub is unreachable to verify it is {repo}; refusing "
+                    "under CAKE_FETCH_STRICT=1 (unset it, or re-run online "
+                    "once so the checkout can be verified and stamped)"
+                )
             log.warning(
                 "fetch: using unstamped checkout %s unverified (hub "
-                "unreachable); not stamping", dest,
+                "unreachable); not stamping (set CAKE_FETCH_STRICT=1 to "
+                "refuse instead)", dest,
             )
             return dest
         if verdict:

@@ -544,3 +544,82 @@ def test_batch_padding_to_dp_multiple(params):
     assert len(got) == 3
     for prompt, stream in zip(PROMPTS, got):
         assert stream == _single_stream(params, prompt, 6, settings)
+
+
+def test_arrivals_with_distinct_prefixes_each_hit_their_own_row(params):
+    """Generalized prefix store (r4): TWO different system prompts among
+    arrivals each hit their OWN cached prefix row — not just the batch's
+    single shared prefix. Every admitted arrival banks its block-aligned
+    prefix, so the second arrival per system prompt prefills only its
+    remainder (1 chunk instead of 3), bit-identical to a solo run."""
+    settings = SamplerSettings(**GREEDY)
+    sys_a = [(i * 7) % 100 + 2 for i in range(16)]
+    sys_b = [(i * 11) % 100 + 3 for i in range(16)]
+    arrivals = [
+        (sys_a + [5, 9, 2], 10),   # scratch; banks sys_a
+        (sys_b + [3, 1, 4], 11),   # scratch; banks sys_b
+        (sys_a + [8, 8, 4], 12),   # hits the sys_a row
+        (sys_b + [6, 2, 7], 13),   # hits the sys_b row
+    ]
+
+    g = BG(CFG, params, settings=settings, dp=1, admit_chunk=8,
+           prefix_share_min=8, prefix_block=8)
+    g.set_prompts([[4, 4, 4], [6, 6, 6]])
+    g.step()
+    admit_cost, emitted = {}, {}
+    for prompt, sid in arrivals:
+        for s in g.streams:
+            s.done = True  # free a slot for the next arrival
+        d0 = g.stats()["admit_dispatches"]
+        g.enqueue(list(prompt), stream_id=sid)
+        while g.pending_admissions():
+            g.step()
+        admit_cost[sid] = g.stats()["admit_dispatches"] - d0
+        for _ in range(4):  # decode a few tokens before the slot is reused
+            g.step()
+        s = next(s for s in g.streams if s.active and s.stream_id == sid)
+        emitted[sid] = list(s.generated)
+    # first-of-a-prefix pays the full ceil(19/8)=3 chunks; repeats pay 1
+    assert admit_cost[10] == 3 and admit_cost[11] == 3
+    assert admit_cost[12] == 1 and admit_cost[13] == 1
+    assert g.stats()["prefix_hits"] == 2
+    assert g.stats()["prefix_entries"] == 2
+
+    # bit-identity: each arrival's emitted tokens match a solo run of the
+    # same (seed, stream_id, prompt) — hit or miss, any admission order
+    for prompt, sid in arrivals:
+        got = emitted[sid]
+        assert got, sid
+        solo = BG(CFG, params, settings=settings, dp=1)
+        solo.set_prompts([list(prompt)], stream_ids=[sid])
+        want = solo.generate(len(got))[0]
+        assert got == want[: len(got)], (sid, got, want)
+
+
+def test_prefix_store_lru_eviction(params):
+    """The store is capped: a third distinct prefix evicts the least
+    recently used row and later arrivals with the evicted prefix prefill
+    from scratch again (correct, just unaided)."""
+    settings = SamplerSettings(**GREEDY)
+    mk = lambda seed: [(i * seed) % 90 + 2 for i in range(16)]
+    g = BG(CFG, params, settings=settings, dp=1, admit_chunk=8,
+           prefix_share_min=8, prefix_block=8, prefix_cache_entries=2)
+    g.set_prompts([[4, 4, 4], [6, 6, 6]])
+    g.step()
+    sid = 20
+    for seed in (7, 11, 13):  # third insert evicts the seed-7 row
+        for s in g.streams:
+            s.done = True
+        g.enqueue(mk(seed) + [1, 2], stream_id=sid)
+        sid += 1
+        while g.pending_admissions():
+            g.step()
+    assert g.stats()["prefix_entries"] == 2
+    for s in g.streams:
+        s.done = True
+    d0 = g.stats()["admit_dispatches"]
+    g.enqueue(mk(7) + [9, 9], stream_id=sid)  # evicted: full prefill
+    while g.pending_admissions():
+        g.step()
+    assert g.stats()["admit_dispatches"] - d0 == 3
+    assert g.stats()["prefix_hits"] == 0

