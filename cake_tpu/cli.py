@@ -92,8 +92,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "round from the context's own n-grams and verify "
                         "them in one dispatch (greedy streams bit-exact; "
                         "sampled streams distribution-exact via rejection "
-                        "sampling; local and mesh --stages/--tp "
-                        "paths)")
+                        "sampling; local, mesh --stages/--tp, and "
+                        "--prompts-file serving paths — serving verifies "
+                        "every stream's proposals per-row in one batched "
+                        "pass)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -216,9 +218,6 @@ def run_serve(args) -> int:
     if args.prefill_chunks > 1:
         sys.exit("error: --prefill-chunks is not supported with "
                  "--prompts-file serving")
-    if args.speculate:
-        sys.exit("error: --speculate is the single-stream local path; it "
-                 "is not supported with --prompts-file serving")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
     settings = _settings(args)
@@ -258,11 +257,14 @@ def run_serve(args) -> int:
     params = load_llama_params_on_mesh(
         args.model, config, plan.mesh, quantize=args.quantize,
         tie_word_embeddings=config.tie_word_embeddings)
+    # --decode-block composes with --speculate here: spec rounds replace
+    # block dispatches while proposals/window allow, and the fused block
+    # remains the fallback (e.g. a stream at its window edge)
     gen = BatchGenerator(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=args.max_seq,
                          block_size=(args.decode_block
                                      if args.decode_block is not None else 8),
-                         kv_quant=args.kv_quant)
+                         kv_quant=args.kv_quant, spec_k=args.speculate)
     gen.set_prompts(prompts)
     log.info("model loaded in %.1fs (%s); serving %d streams",
              time.perf_counter() - t0, memory_report(), len(prompts))

@@ -664,6 +664,58 @@ def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
     return jax.jit(sharded, donate_argnums=(2,))
 
 
+def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
+                              params_like: dict | None = None,
+                              kv_quant: str | None = None):
+    """Compile the PER-ROW speculation-verification pass: forward
+    ``tokens [B, T]`` (each row: its last emitted token + K proposals,
+    0-padded) from per-row positions ``pos [B]`` and return logits at
+    EVERY position for every row (``[B, T, vocab] f32``) — the serving
+    twin of :func:`build_sharded_verify`. Each row writes its own K+1 KV
+    slots at its own frontier; rejected slots hold garbage that the next
+    round's fed range fully overwrites before it becomes attendable (the
+    same invariant as the single-stream speculation plane). Requires
+    ``plan.sp == 1``.
+    """
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if plan.sp != 1:
+        raise ValueError("per-row speculative verification requires sp == 1 "
+                         "(serving plane)")
+
+    def step(params, tokens, cache, pos):
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq, config.rope_theta,
+            scaling=config.rope_scaling,
+        )
+        x = params["embed"][tokens].astype(config.jax_dtype)
+        x, ck, cv = _pipeline_layers(
+            x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
+            plan.num_stages, heads_l, kv_heads_l,
+        )
+        x = _select_stage0(x)  # [B, T, hidden], valid on stage 0
+        x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
+        logits = quant.dense(x, params["lm_head"]).astype(jnp.float32)
+        logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
+        return logits, KVCache(k=ck, v=cv)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(params_like),
+            P(DP, None),
+            cache_specs(kv_quant),
+            P(DP),
+        ),
+        out_specs=(
+            P(DP, None, None),
+            cache_specs(kv_quant),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
 def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
                           params_like: dict | None = None,
                           microbatch: int = 1,

@@ -113,6 +113,8 @@ class BatchGenerator:
         prefix_cache_entries: int = 2,
         prefix_block: int = 64,
         quant_backend: str | None = None,
+        spec_k: int = 0,
+        spec_ngram: int = 3,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -226,6 +228,21 @@ class BatchGenerator:
         self._prefix_entries = max(0, prefix_cache_entries)
         self._prefix_block = max(1, prefix_block)
         self._prefix_hits = 0
+        # Batched n-gram speculation (spec_k > 0): each dispatch verifies
+        # every live stream's K prompt-lookup proposals in ONE per-row
+        # pass (pipeline.build_sharded_verify_rows) and banks the accepted
+        # run — 1..K+1 tokens per stream per dispatch. Greedy streams stay
+        # bit-identical to plain serving decode (the accept emits the same
+        # repeat-penalized argmaxes); sampled streams are distribution-
+        # identical via the per-row rejection-sampling accept. A row with
+        # no proposal still advances exactly one token (-1 pads never
+        # match), so the batched verify subsumes a plain decode step.
+        self._spec_k = max(0, int(spec_k))
+        self._spec_ngram = int(spec_ngram)
+        self._spec_bank: list[list[int]] = []
+        self._n_spec_dispatches = 0
+        self.__verify_rows = None
+        self.__accept_rows = None
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
         # counters plus busy wall-clock, reported by stats().
@@ -309,6 +326,52 @@ class BatchGenerator:
                 kv_quant=self.kv_quant,
             ))
         return self.__admit_prefill
+
+    @property
+    def _verify_rows(self):
+        """Per-row speculation-verification program, compiled on first use."""
+        if self.__verify_rows is None:
+            from cake_tpu.parallel.pipeline import build_sharded_verify_rows
+
+            self.__verify_rows = self._pinned(build_sharded_verify_rows(
+                self.config, self.plan, params_like=self.params,
+                kv_quant=self.kv_quant,
+            ))
+        return self.__verify_rows
+
+    @property
+    def _accept_rows(self):
+        """Batched accept scan (greedy exact-match or rejection sampling),
+        jitted on first use."""
+        if self.__accept_rows is None:
+            from functools import partial
+
+            from cake_tpu.runtime.speculative import (
+                accept_fn_rows,
+                accept_sampled_fn_rows,
+            )
+
+            eos = jnp.asarray(sorted(self._eos_ids) or [-1], jnp.int32)
+            accept = (accept_fn_rows if self.settings.greedy
+                      else accept_sampled_fn_rows)
+            self.__accept_rows = jax.jit(partial(
+                accept, eos_ids=eos, settings=self.settings))
+        return self.__accept_rows
+
+    @staticmethod
+    def _host(x) -> np.ndarray:
+        """Device->host fetch that stays valid when the dp axis spans
+        PROCESSES (multi-host serving): every host runs the identical
+        serving loop and needs the full row for emission bookkeeping, so a
+        non-fully-addressable array is process_allgather'd (these are tiny
+        [B]-shaped token/count arrays)."""
+        try:
+            return np.asarray(x)
+        except RuntimeError:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x,
+                                                                tiled=True))
 
     def _pinned(self, fn):
         """Wrap a compiled program so every dispatch — and therefore its
@@ -483,6 +546,7 @@ class BatchGenerator:
         self._index = np.ones((b,), np.int32)
         self._emitted_first = False
         self._block_buf: list[np.ndarray] = []
+        self._spec_bank = [[] for _ in self.streams]
         # emission rows already recorded (admit() flushing the block buffer)
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
@@ -664,6 +728,8 @@ class BatchGenerator:
             detok=TokenOutputStream(self.tokenizer) if self.tokenizer else None,
         )
         self.streams[slot] = s
+        if self._spec_k:
+            self._spec_bank[slot] = []  # the slot's old stream is gone
         s.generated.append(tok_id)
         window_full = len(ids) + 1 >= self.max_seq
         s.done = (tok_id in self._eos_ids) or window_full
@@ -744,13 +810,111 @@ class BatchGenerator:
             # into a dummy slot before the first step() had its first token
             # returned by admit(), and must not be double-recorded here
             return self._emit(
-                np.asarray(self._last_tokens),
+                self._host(self._last_tokens),
                 skip=[bool(s.generated) for s in self.streams],
             )
         self._admission_tick()
         if self._pending_rows:
             return self._pending_rows.pop(0)
         return self._step_decode()
+
+    def _spec_emit_or_round(self):
+        """Drain the per-stream accepted-token banks one row per call;
+        when empty, run one batched verification round. Returns None — the
+        caller falls through to the plain decode path (single or fused
+        block) — when speculation cannot or should not run:
+
+        - no live streams;
+        - a live stream within K+1 slots of its window (its fed row's
+          per-row KV write would clamp-overwrite committed slots). This
+          gate is batch-global but BOUNDED: such a stream fills its window
+          and goes done within <= K+1 plain dispatches, after which spec
+          rounds resume;
+        - greedy with no proposal on any live stream: a proposal-less
+          round is a (K+1)-wide forward that advances every stream exactly
+          one token — strictly worse than a plain dispatch, and for greedy
+          the outputs are identical either way. Sampled streams keep the
+          always-verify path: their round draws live in the spec fold
+          domain, and skipping rounds based on OTHER streams' proposals
+          would break composition invariance."""
+        if any(self._spec_bank):
+            return self._emit_spec_bank()
+        live = [i for i, s in enumerate(self.streams)
+                if s.active and not s.done]
+        if not live:
+            return None
+        if any(int(self._pos[i]) + self._spec_k + 1 > self.max_seq
+               for i in live):
+            return None
+        from cake_tpu.runtime.speculative import ngram_propose
+
+        b = len(self.streams)
+        k = self._spec_k
+        props = np.full((b, k), -1, np.int32)
+        for i in live:
+            s = self.streams[i]
+            pr = ngram_propose(s.prompt + s.generated, self._spec_ngram, k)
+            props[i, : len(pr)] = pr
+        if self.settings.greedy and (props < 0).all():
+            return None
+        self._spec_round(live, props)
+        return self._emit_spec_bank()
+
+    def _spec_round(self, live: list[int], props: np.ndarray) -> None:
+        b = len(self.streams)
+        k = self._spec_k
+        fed = np.zeros((b, k + 1), np.int32)
+        fed[:, 0] = self._host(self._last_tokens)
+        fed[:, 1:] = np.maximum(props, 0)  # -1 pads embed as 0; never match
+        t0 = time.perf_counter()
+        logits, self.cache = self._verify_rows(
+            self.params, jnp.asarray(fed), self.cache,
+            jnp.asarray(self._pos),
+        )
+        if self.settings.greedy:
+            toks, count, self._history, self._hist_slot = self._accept_rows(
+                logits, jnp.asarray(props), self._history, self._hist_slot)
+        else:
+            # per-row round keys in their own fold domain (0x5bec), keyed
+            # by the row's position — unique per round, disjoint from the
+            # plain per-token-index sampling schedule
+            rkeys = jax.vmap(lambda kk, p: jax.random.fold_in(
+                jax.random.fold_in(kk, 0x5BEC), p))(
+                    self._keys, jnp.asarray(self._pos))
+            toks, count, self._history, self._hist_slot = self._accept_rows(
+                logits, jnp.asarray(props), self._history, self._hist_slot,
+                round_keys=rkeys)
+        toks = self._host(toks)
+        count = self._host(count)
+        self._n_decode_dispatches += 1
+        self._n_spec_dispatches += 1
+        self._busy_s += time.perf_counter() - t0
+        live_mask = np.zeros((b,), bool)
+        live_mask[live] = True
+        # non-live rows advance exactly one slot (parity with the plain
+        # path's clamped discarded writes); live rows bank their run
+        n = np.where(live_mask, np.maximum(count, 1), 1)
+        for i in live:
+            self._spec_bank[i] = toks[i, : n[i]].tolist()
+        self._pos = np.asarray(self._pos) + n
+        self._index = np.asarray(self._index) + n
+        last = toks[np.arange(b), n - 1]
+        # fed[:, 0] already holds this round's pre-fetched last tokens —
+        # no second device fetch (on multi-host each fetch is a collective)
+        self._last_tokens = jnp.asarray(
+            np.where(live_mask, last, fed[:, 0]), jnp.int32,
+        )
+
+    def _emit_spec_bank(self) -> list:
+        row = np.zeros((len(self.streams),), np.int64)
+        skip = []
+        for i, bank in enumerate(self._spec_bank):
+            if bank:
+                row[i] = bank.pop(0)
+                skip.append(False)
+            else:
+                skip.append(True)
+        return self._emit(row, skip=skip)
 
     def _pick_decode(self, block: bool):
         """Serialized vs interleaved schedule for this dispatch: the
@@ -764,6 +928,10 @@ class BatchGenerator:
         return il if local % self.plan.num_stages == 0 else serial
 
     def _step_decode(self):
+        if self._spec_k:
+            row = self._spec_emit_or_round()
+            if row is not None:
+                return row
         if self._block_buf:
             return self._emit(self._block_buf.pop(0))
 
@@ -794,7 +962,7 @@ class BatchGenerator:
                     self._hist_slot, jnp.asarray(self._index),
                 )
             )
-            rows = np.asarray(toks)  # [steps, B]
+            rows = self._host(toks)  # [steps, B]
             self._n_decode_dispatches += 1
             self._busy_s += time.perf_counter() - t0
             self._pos = self._pos + self.block_size
@@ -813,7 +981,7 @@ class BatchGenerator:
             jnp.asarray(self._pos), self._keys, self._history,
             self._hist_slot, jnp.asarray(self._index),
         )
-        row = np.asarray(tok)  # sync: dispatch is async, busy_s needs compute
+        row = self._host(tok)  # sync: dispatch is async, busy_s needs compute
         self._n_decode_dispatches += 1
         self._busy_s += time.perf_counter() - t0
         self._pos = self._pos + 1
@@ -843,6 +1011,7 @@ class BatchGenerator:
             "admit_dispatches": self._n_admit_dispatches,
             "prefix_hits": self._prefix_hits,
             "prefix_entries": len(self._prefix_store),
+            "spec_dispatches": self._n_spec_dispatches,
             "tokens_per_dispatch": (
                 round(self._n_emitted / dispatches, 2) if dispatches else None
             ),
@@ -854,13 +1023,40 @@ class BatchGenerator:
         }
 
     def generate(self, max_new_tokens: int) -> list[list[int]]:
-        """Run all streams to EOS or ``max_new_tokens``; returns per-stream
-        generated ids (active streams only, in prompt order)."""
-        for _ in range(max_new_tokens):
-            self.step()
-            if all(s.done for s in self.streams if s.active):
+        """Run all streams to EOS or ``max_new_tokens`` MORE tokens each
+        (repeated calls continue where the last left off); returns
+        per-stream generated ids (active streams only, in prompt order).
+        With batched speculation the emission is ragged (a stream banks
+        1..K+1 accepted tokens per dispatch), so the loop runs until every
+        live stream has this call's quota instead of a fixed step count —
+        identical behavior on the plain one-token-per-step path. A stream
+        admitted into a slot mid-call starts its quota from zero."""
+        start = {i: (s, len(s.generated))
+                 for i, s in enumerate(self.streams)}
+
+        def quota_met() -> bool:
+            for i, s in enumerate(self.streams):
+                if not s.active or s.done:
+                    continue
+                s0, b = start.get(i, (None, 0))
+                base = b if s0 is s else 0
+                if len(s.generated) - base < max_new_tokens:
+                    return False
+            return True
+
+        cap = 2 * max_new_tokens * max(1, len(self.streams)) + 8
+        for _ in range(cap):
+            if quota_met():
                 break
-        return [s.generated for s in self.streams if s.active]
+            self.step()
+        out = []
+        for i, s in enumerate(self.streams):
+            if not s.active:
+                continue
+            s0, b = start.get(i, (None, 0))
+            base = b if s0 is s else 0
+            out.append(s.generated[: base + max_new_tokens])
+        return out
 
     def texts(self) -> list[str | None]:
         """Each active stream's full generated text (None w/o tokenizer)."""

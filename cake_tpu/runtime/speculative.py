@@ -183,6 +183,28 @@ def accept_sampled_fn(
     return toks, count, history, hist_slot
 
 
+def accept_fn_rows(logits, proposals, history, hist_slot, eos_ids,
+                   settings: SamplerSettings):
+    """Batched greedy accept: vmap of :func:`accept_fn` over serving rows.
+    ``logits [B, T, V]``, ``proposals [B, K]`` (-1-padded), per-row
+    history/hist_slot. Returns ``(tokens [B, T], count [B], history,
+    hist_slot)``."""
+    return jax.vmap(
+        lambda l, p, h, s: accept_fn(l, p, h, s, eos_ids, settings)
+    )(logits, proposals, history, hist_slot)
+
+
+def accept_sampled_fn_rows(logits, proposals, history, hist_slot, eos_ids,
+                           round_keys, settings: SamplerSettings):
+    """Batched rejection-sampling accept: vmap of
+    :func:`accept_sampled_fn` over serving rows with per-row round keys
+    (``[B, 2] uint32``)."""
+    return jax.vmap(
+        lambda l, p, h, s, k: accept_sampled_fn(l, p, h, s, eos_ids, k,
+                                                settings)
+    )(logits, proposals, history, hist_slot, round_keys)
+
+
 class SpeculativeMixin:
     """The speculation loop, shared by the single-chip and mesh
     generators. Subclasses build ``self._verify`` (a compiled

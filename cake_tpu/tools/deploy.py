@@ -71,7 +71,9 @@ def plan_commands(
     prints and the tests assert on)."""
     cmds: list[list[str]] = []
     ssh_base = ["ssh"] + (shlex.split(ssh_opts) if ssh_opts else [])
-    rsh = " ".join(ssh_base) if len(ssh_base) > 1 else "ssh"
+    # rsync re-splits -e on whitespace: quote per token so an ssh option
+    # whose value contains spaces (-o ProxyCommand=...) survives the trip
+    rsh = shlex.join(ssh_base) if len(ssh_base) > 1 else "ssh"
     excludes = [f"--exclude={e}" for e in RSYNC_EXCLUDES]
     for name, node in topology.nodes.items():
         host, port = _host_port(node)

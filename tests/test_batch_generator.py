@@ -623,3 +623,116 @@ def test_prefix_store_lru_eviction(params):
         g.step()
     assert g.stats()["admit_dispatches"] - d0 == 3
     assert g.stats()["prefix_hits"] == 0
+
+
+# -- batched serving speculation ----------------------------------------------
+
+def test_serving_speculation_greedy_bit_identical(params):
+    """spec_k > 0: every live stream's n-gram proposals verified in one
+    per-row dispatch; greedy streams are bit-identical to plain serving
+    decode with tokens-per-dispatch > 1 on repeating streams."""
+    prompts = [[5, 9, 2, 5, 9, 2, 5, 9], [3, 1, 4, 1, 3, 1, 4, 1],
+               [7, 7, 2, 8]]
+    for penalty in (1.0, 1.1):
+        settings = SamplerSettings(temperature=0.0, repeat_penalty=penalty)
+        plain = BG(CFG, params, settings=settings)
+        plain.set_prompts([list(p) for p in prompts])
+        want = plain.generate(10)
+        spec = BG(CFG, params, settings=settings, spec_k=4)
+        spec.set_prompts([list(p) for p in prompts])
+        got = spec.generate(10)
+        assert got == want, penalty
+        st = spec.stats()
+        assert st["spec_dispatches"] >= 1
+        assert st["tokens_per_dispatch"] > 1.0
+
+
+def test_serving_speculation_sampled_invariant_to_composition(params):
+    """temperature > 0 with spec_k: a stream's rejection-sampling draws
+    derive only from (its key, its positions, its context), so the same
+    (seed, stream_id, prompt) emits identical tokens in any batch
+    composition."""
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=5)
+    target = [5, 9, 2, 5, 9, 2, 5, 9]
+
+    def run(other_prompts):
+        g = BG(CFG, params, settings=settings, spec_k=4)
+        g.set_prompts([list(target)] + [list(p) for p in other_prompts],
+                      stream_ids=[42] + list(range(1, len(other_prompts) + 1)))
+        return g.generate(8)[0]
+
+    a = run([[3, 1, 4, 1]])
+    b = run([[8, 8], [2, 6, 4], [9, 1, 1]])
+    assert a == b
+    assert all(0 <= t < CFG.vocab_size for t in a)
+
+
+def test_serving_speculation_window_edge_falls_back(params):
+    """A live stream too close to its window for K+1 fed slots forces the
+    plain decode path — correct output, no overrun."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    long_prompt = [(i * 5) % 90 + 2 for i in range(56)]  # 56 of 64 window
+    plain = BG(CFG, params, settings=settings)
+    plain.set_prompts([list(long_prompt)])
+    want = plain.generate(7)
+    spec = BG(CFG, params, settings=settings, spec_k=6)
+    spec.set_prompts([list(long_prompt)])
+    got = spec.generate(7)
+    assert got == want
+
+
+def test_serving_speculation_composes_with_admission(params):
+    """enqueue during spec serving: the admitted stream's tokens match the
+    same (seed, stream_id, prompt) served solo with speculation."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    g = BG(CFG, params, settings=settings, spec_k=4, admit_chunk=8)
+    g.set_prompts([[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]])
+    for _ in range(3):
+        g.step()
+    g.streams[1].done = True
+    new_prompt = [8, 2, 8, 2, 8, 2]
+    g.enqueue(list(new_prompt), stream_id=9)
+    while g.pending_admissions():
+        g.step()
+    for _ in range(14):
+        g.step()
+    got = next(s for s in g.streams if s.stream_id == 9).generated
+    solo = BG(CFG, params, settings=settings, spec_k=4)
+    solo.set_prompts([list(new_prompt)], stream_ids=[9])
+    want = solo.generate(len(got))[0]
+    assert got == want[: len(got)] and got
+
+
+def test_serving_speculation_with_int8_kv(params):
+    """spec_k composes with the quantized KV cache."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    prompts = [[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]]
+    plain = BG(CFG, params, settings=settings, kv_quant="int8")
+    plain.set_prompts([list(p) for p in prompts])
+    want = plain.generate(8)
+    spec = BG(CFG, params, settings=settings, kv_quant="int8", spec_k=4)
+    spec.set_prompts([list(p) for p in prompts])
+    assert spec.generate(8) == want
+
+
+def test_generate_is_incremental(params):
+    """Repeated generate(N) calls continue the streams — N MORE tokens
+    each call (the pre-r4 contract, preserved by the ragged-emission
+    rewrite)."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings)
+    g.set_prompts([[5, 9, 2], [3, 1, 4]])
+    first = [list(s) for s in g.generate(4)]
+    assert all(len(s) == 4 for s in first)
+    second = g.generate(3)
+    assert all(len(s) == 7 for s in second)
+    for a, b in zip(first, second):
+        assert b[:4] == a
+    # same for the speculative path
+    gs = BG(CFG, params, settings=settings, spec_k=4)
+    gs.set_prompts([[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]])
+    f = [list(s) for s in gs.generate(4)]
+    s2 = gs.generate(3)
+    assert all(len(x) == 7 for x in s2)
+    for a, b in zip(f, s2):
+        assert b[:4] == a

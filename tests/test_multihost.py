@@ -167,6 +167,34 @@ print('TOKENS', pid, [g.next_token(i).id for i in range(6)])
 """
 
 
+_DP_SERVE_DRIVER = r"""
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+pid = int(sys.argv[1])
+jax.distributed.initialize('127.0.0.1:{port}', 2, pid)
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan
+from cake_tpu.runtime.batch_generator import BatchGenerator
+from cake_tpu.utils import sharded_load
+
+cfg = tiny()
+plan = MeshPlan.build(cfg, dp=2, devices=jax.devices())
+grid = plan.mesh.devices
+span = tuple(sorted(d.process_index for d in grid[:, 0, 0, 0]))
+assert span == (0, 1), span  # the dp batch axis spans both processes
+params = sharded_load.load_llama_params_on_mesh(
+    {model_dir!r}, cfg, plan.mesh)
+g = BatchGenerator(cfg, params, plan=plan,
+                   settings=SamplerSettings(temperature=0.9, top_k=20,
+                                            seed=7))
+g.set_prompts([[3, 5, 7], [2, 8, 4]])
+outs = g.generate(6)
+print('TOKENS', pid, outs)
+"""
+
+
 def _oracle_tokens(model_dir) -> list:
     """Single-device greedy stream from the same checkpoint (the parity
     oracle every mesh layout must reproduce)."""
@@ -229,6 +257,28 @@ def test_two_process_sp_ring_crosses_process_boundary(model_dir):
     want = str(_oracle_tokens(model_dir))
     got0, got1 = _run_pair(_SP_DRIVER, model_dir, devices_per_proc=1)
     assert got0 == want and got1 == want, (got0, got1, want)
+
+
+def test_two_process_dp_serving_matches_single_process(model_dir):
+    """The SERVING plane crosses hosts: BatchGenerator on a dp=2 mesh over
+    2 processes x 1 device (each process owns one stream's rows; asserted
+    in the driver), sampled streams identical to the single-process dp=2
+    run of the same (seed, stream_id, prompt)s."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.parallel.mesh import MeshPlan
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.utils.weights import load_llama_params
+
+    params = load_llama_params(model_dir, CFG.num_hidden_layers,
+                               dtype=CFG.dtype)
+    plan = MeshPlan.build(CFG, dp=2, devices=jax.devices()[:2])
+    g = BatchGenerator(CFG, params, plan=plan,
+                       settings=SamplerSettings(temperature=0.9, top_k=20,
+                                                seed=7))
+    g.set_prompts([[3, 5, 7], [2, 8, 4]])
+    want = str(g.generate(6))
+    got0, got1 = _run_pair(_DP_SERVE_DRIVER, model_dir, devices_per_proc=1)
+    assert got0 == want and got1 == want, (got0, want)
 
 
 def test_two_process_sharded_load_reads_only_local_stages(model_dir):
