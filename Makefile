@@ -42,6 +42,10 @@ kernel-check:
 flash-sweep:
 	$(PY) -m cake_tpu.tools.flash_sweep --json-out flash_sweep.json
 
+# per-hop inter-stage (ppermute) latency/bandwidth — run on a pod slice
+ici-probe:
+	$(PY) -m cake_tpu.tools.ici_probe --json-out ici_probe.json
+
 ttft:
 	CAKE_BENCH_TTFT=1 $(PY) bench.py
 
@@ -58,4 +62,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep ttft deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep ici-probe ttft deploy clean

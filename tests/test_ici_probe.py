@@ -1,0 +1,42 @@
+"""ICI probe (tools/ici_probe): the machinery behind BASELINE.json's
+"inter-layer ICI latency" metric — a timed ppermute ring over the same
+stage axis the pipeline rides. On the CPU test mesh the numbers are
+host-memcpy (labeled by device kind); the contract proven here is the
+machinery: ring correctness, per-size records, JSON output."""
+
+import json
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from cake_tpu.parallel.mesh import STAGE, make_mesh
+from cake_tpu.tools.ici_probe import _build_ring, probe
+
+
+def test_ring_permutes_payload_correctly():
+    n, reps = 4, 3
+    mesh = make_mesh(num_stages=n, devices=jax.devices()[:n])
+    fn = _build_ring(mesh, n, reps)
+    x = jax.numpy.arange(n * 2, dtype=jax.numpy.bfloat16)
+    out = np.asarray(fn(x)).astype(np.float32)
+    # each 2-element shard moved reps hops around the ring
+    shards = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+    want = np.roll(shards, reps, axis=0).reshape(-1)
+    np.testing.assert_array_equal(out, want)
+
+
+def test_probe_emits_records(tmp_path, capsys):
+    out = tmp_path / "ici.json"
+    recs = probe(stages=4, reps=4, json_out=str(out))
+    assert len(recs) == 4
+    for r in recs:
+        assert r["per_hop_us"] > 0 and r["n_stages"] == 4
+        assert r["payload_bytes"] > 0
+    assert json.loads(out.read_text()) == recs
+
+
+def test_probe_refuses_single_device(monkeypatch, capsys):
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda: one)
+    assert probe() == []

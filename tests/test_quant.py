@@ -268,3 +268,24 @@ def test_pin_crosses_to_pallas_at_16_local_rows():
     gen.set_prompts([[1 + i % 5, 2, 3] for i in range(16)])
     gen.step()
     assert gen._quant_pin == "pallas"
+
+
+def test_pin_is_isolated_across_threads():
+    """The backend pin is a ContextVar: two threads holding different pins
+    (two serving instances dispatching concurrently) never observe each
+    other's value."""
+    import threading
+
+    seen = {}
+    barrier = threading.Barrier(2, timeout=10)
+
+    def worker(name, pin):
+        with quant.pinned_impl(pin):
+            barrier.wait()          # both pins active simultaneously
+            seen[name] = quant.pinned()
+            barrier.wait()
+    t1 = threading.Thread(target=worker, args=("a", "xla"))
+    t2 = threading.Thread(target=worker, args=("b", "pallas"))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert seen == {"a": "xla", "b": "pallas"}
+    assert quant.pinned() is None

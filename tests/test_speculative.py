@@ -300,3 +300,36 @@ def test_int8_kv_composes_with_speculation(params):
     g.set_prompt(prompt)
     plain_int8 = [g.next_token(i).id for i in range(16)]
     assert got == plain_int8[: len(got)]
+
+
+def test_rejection_accept_preserves_distribution_top_p():
+    """Same statistical contract through the top-p (nucleus) transform —
+    the masked-out tail must stay at zero probability through acceptance
+    AND residual sampling."""
+    import jax.numpy as jnp
+
+    from cake_tpu.ops import sampling
+    from cake_tpu.runtime.speculative import accept_sampled_fn
+
+    v, k, n = 24, 2, 6000
+    settings = SamplerSettings(temperature=0.8, top_p=0.7,
+                               repeat_penalty=1.0)
+    logits = jax.random.normal(jax.random.PRNGKey(3), (k + 1, v),
+                               jnp.float32) * 2.0
+    history = jnp.full((settings.repeat_last_n,), -1, jnp.int32)
+    eos = jnp.asarray([-1], jnp.int32)
+    p0 = np.asarray(jax.nn.softmax(
+        sampling.processed_logits(logits[0], history, settings)))
+    prop = int(np.argsort(p0)[-2])  # second-most-likely: real accept/reject mix
+    props = jnp.asarray([prop, -1], jnp.int32)
+    keys = jax.random.split(jax.random.PRNGKey(9), n)
+    toks, count, _, _ = jax.vmap(
+        lambda key: accept_sampled_fn(
+            logits, props, history, jnp.zeros((), jnp.int32), eos, key,
+            settings=settings)
+    )(keys)
+    toks, count = np.asarray(toks), np.asarray(count)
+    freq = np.bincount(toks[:, 0], minlength=v) / n
+    assert np.abs(freq - p0).sum() < 0.08
+    # nucleus-masked tokens never appear
+    assert freq[p0 == 0].sum() == 0.0
