@@ -328,6 +328,33 @@ def build_sharded_decode(
     return jax.jit(sharded, donate_argnums=(2,))
 
 
+def _head_split_safe(hw, S: int) -> bool:
+    """Whether vocab-splitting the lm_head over S stages cannot change
+    which quant_matmul backend the program gets: the pallas kernel's
+    256-column tileability gate sees ``chunk`` on a split head but
+    ``v_local`` on the serialized full-width head, so a backend-divergent
+    split would make interleaved and serialized programs' logits differ in
+    low-order bits and break their bit-identity contract. Split when the
+    backend provably cannot differ — all-XLA (kernels off or an "xla"
+    pin), all-pallas (interpret mode), or both widths on the same side of
+    the tileability gate. Evaluate at TRACE time so a BatchGenerator's pin
+    (quant.pinned_impl around the dispatch) is visible. bf16 heads slice
+    bitwise-safely at any width."""
+    v_local = quant.out_features(hw)
+    if v_local % S:
+        return False
+    if not isinstance(hw, quant.QuantizedLinear):
+        return True
+    from cake_tpu.ops import pallas as pk
+
+    pin = quant.pinned()
+    if not pk.kernels_enabled() or pin == "xla":
+        return True  # everything runs XLA either way
+    if pin == "pallas" and pk.interpret_default():
+        return True  # everything runs (interpreted) pallas
+    return ((v_local // S) % 256 == 0) == (v_local % 256 == 0)
+
+
 def build_interleaved_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
     params_like: dict | None = None, steps: int = 1,
@@ -400,35 +427,7 @@ def build_interleaved_decode(
         perm = [(i, (i + 1) % S) for i in range(S)]
         hw = params["lm_head"]
         v_local = quant.out_features(hw)
-
-        def _split_safe() -> bool:
-            """Vocab-splitting an int8 head must not change which
-            quant_matmul backend the program gets: the pallas kernel's
-            256-column tileability gate sees ``chunk`` here but
-            ``v_local`` on the serialized head, so a backend-divergent
-            split would make the two schedules' logits differ in
-            low-order bits and break the bit-identity contract
-            (`_pick_decode` swaps schedules freely). Split when the
-            backend provably cannot differ — all-XLA (kernels off or an
-            "xla" pin), all-pallas (interpret mode), or both widths on
-            the same side of the tileability gate. Evaluated at TRACE
-            time so a BatchGenerator's pin (quant.pinned_impl around the
-            dispatch) is visible. bf16 heads slice bitwise-safely at any
-            width."""
-            if v_local % S:
-                return False
-            if not isinstance(hw, quant.QuantizedLinear):
-                return True
-            from cake_tpu.ops import pallas as pk
-
-            pin = quant.pinned()
-            if not pk.kernels_enabled() or pin == "xla":
-                return True  # everything runs XLA either way
-            if pin == "pallas" and pk.interpret_default():
-                return True  # everything runs (interpreted) pallas
-            return ((v_local // S) % 256 == 0) == (v_local % 256 == 0)
-
-        split_safe = _split_safe()
+        split_safe = _head_split_safe(hw, S)  # trace-time: sees the pin
 
         def head_logits(x_n):
             """Full [bm, V] f32 logits with the vocab additionally split
@@ -695,6 +694,133 @@ def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
         x = _select_stage0(x)  # [B, T, hidden], valid on stage 0
         x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
         logits = quant.dense(x, params["lm_head"]).astype(jnp.float32)
+        logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
+        return logits, KVCache(k=ck, v=cv)
+
+    sharded = jax.shard_map(
+        step,
+        mesh=plan.mesh,
+        in_specs=(
+            param_specs(params_like),
+            P(DP, None),
+            cache_specs(kv_quant),
+            P(DP),
+        ),
+        out_specs=(
+            P(DP, None, None),
+            cache_specs(kv_quant),
+        ),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(2,))
+
+
+def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
+                                  params_like: dict | None = None,
+                                  kv_quant: str | None = None):
+    """Interleaved-microbatch twin of :func:`build_sharded_verify_rows`.
+
+    The serialized per-row verify runs S pipeline cycles with EVERY stage
+    computing the full batch and one result kept. Here the dp-local batch's
+    S microbatches stream through the stages GPipe-style (microbatch ``m``
+    is at stage ``t - m`` on cycle ``t``; 2S-1 cycles total), so each cycle
+    does B/S rows of useful layer work per stage — total layer FLOPs and KV
+    traffic drop ~S/2× (one pass has a fill/drain bubble the steady-state
+    interleaved decode does not). Stage S-1 collects each microbatch's
+    final hidden states; the head (rms_norm + lm_head + tp gather) then
+    runs on the reassembled ``[B, T, H]`` exactly like the serialized
+    program, so logits are bit-identical per row.
+
+    Same signature and specs as ``build_sharded_verify_rows``; requires
+    ``plan.sp == 1`` and ``B_local % num_stages == 0``. Int8 weights need
+    a pinned quant backend for bit-identity with the serialized program
+    (same contract as ``build_interleaved_decode``)."""
+    heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    S = plan.num_stages
+    if plan.sp != 1:
+        raise ValueError("per-row speculative verification requires sp == 1 "
+                         "(serving plane)")
+
+    def step(params, tokens, cache, pos):
+        b, t = tokens.shape
+        if b % S:
+            raise ValueError(
+                f"interleaved verify needs the dp-local batch ({b}) "
+                f"divisible by num_stages ({S})"
+            )
+        bm = b // S
+        cos, sin = rope_tables(
+            config.head_dim, cache.max_seq, config.rope_theta,
+            scaling=config.rope_scaling,
+        )
+        my_stage = jax.lax.axis_index(STAGE)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+        x_all = params["embed"][tokens].astype(config.jax_dtype)  # [B,T,H]
+
+        def body(c_t, carry):
+            x, ck, cv, y = carry
+            # stage 0 injects microbatch c_t
+            base_in = jnp.minimum(c_t, S - 1) * bm
+            xin = jax.lax.dynamic_slice_in_dim(x_all, base_in, bm, 0)
+            x = jnp.where((my_stage == 0) & (c_t < S), xin, x)
+            # this stage's resident microbatch
+            m_res = c_t - my_stage
+            valid = (m_res >= 0) & (m_res < S)
+            base = jnp.clip(m_res, 0, S - 1) * bm
+            pos_rows = jax.lax.dynamic_slice_in_dim(pos, base, bm, 0)
+            rows = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, base, bm, 1),
+                KVCache(k=ck, v=cv),
+            )
+            h, rows = llama.forward_layers(
+                params["layers"], x, rows, cos, sin, pos_rows, config,
+                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+                write_gate=valid,
+            )
+            x = jnp.where(valid, h, x)
+            ck, cv = jax.tree.map(
+                lambda buf, r: jax.lax.dynamic_update_slice_in_dim(
+                    buf, r, base, 1),
+                (ck, cv), (rows.k, rows.v),
+            )
+            # stage S-1 collects the finished microbatch's hidden states
+            collect = valid & (my_stage == S - 1)
+            cur = jax.lax.dynamic_slice_in_dim(y, base, bm, 0)
+            y = jax.lax.dynamic_update_slice_in_dim(
+                y, jnp.where(collect, x, cur), base, 0)
+            x = jax.lax.ppermute(x, STAGE, perm)
+            return x, ck, cv, y
+
+        x0 = jnp.zeros((bm, t, config.hidden_size), config.jax_dtype)
+        y0 = jnp.zeros((b, t, config.hidden_size), config.jax_dtype)
+        _, ck, cv, y = jax.lax.fori_loop(
+            0, 2 * S - 1,
+            lambda c_t, carry: body(c_t, carry),
+            (x0, cache.k, cache.v, y0),
+        )
+        # broadcast stage S-1's collection, then the head — vocab-split
+        # over the stage axis when that cannot change the quant backend
+        # class (same _head_split_safe gate as the interleaved decode), so
+        # each stage reads V/S of the lm_head instead of all of it
+        y = jax.lax.psum(
+            jnp.where(my_stage == S - 1, y, jnp.zeros_like(y)), STAGE)
+        y = rms_norm(y, params["norm_f"], config.rms_norm_eps)
+        hw = params["lm_head"]
+        if S > 1 and _head_split_safe(hw, S):
+            chunk = quant.out_features(hw) // S
+            start = my_stage * chunk
+            if isinstance(hw, quant.QuantizedLinear):
+                sub = quant.QuantizedLinear(
+                    q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
+                    scale=jax.lax.dynamic_slice_in_dim(
+                        hw.scale, start, chunk, 0),
+                )
+            else:
+                sub = jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
+            logits = quant.dense(y, sub).astype(jnp.float32)
+            logits = jax.lax.all_gather(logits, STAGE, axis=-1, tiled=True)
+        else:
+            logits = quant.dense(y, hw).astype(jnp.float32)
         logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
         return logits, KVCache(k=ck, v=cv)
 

@@ -242,6 +242,7 @@ class BatchGenerator:
         self._spec_bank: list[list[int]] = []
         self._n_spec_dispatches = 0
         self.__verify_rows = None
+        self.__verify_rows_il = None
         self.__accept_rows = None
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
@@ -338,6 +339,28 @@ class BatchGenerator:
                 kv_quant=self.kv_quant,
             ))
         return self.__verify_rows
+
+    def _pick_verify(self):
+        """Serialized vs interleaved verification for this dispatch (the
+        same schedule choice _pick_decode makes): interleaved needs
+        num_stages > 1 and the dp-local batch divisible by the stage
+        count; logits are bit-identical either way."""
+        S = self.plan.num_stages
+        if not self._interleave or S < 2:
+            return self._verify_rows
+        if (len(self.streams) // self.plan.dp) % S:
+            return self._verify_rows
+        if self.__verify_rows_il is None:
+            from cake_tpu.parallel.pipeline import (
+                build_interleaved_verify_rows,
+            )
+
+            self.__verify_rows_il = self._pinned(
+                build_interleaved_verify_rows(
+                    self.config, self.plan, params_like=self.params,
+                    kv_quant=self.kv_quant,
+                ))
+        return self.__verify_rows_il
 
     @property
     def _accept_rows(self):
@@ -867,7 +890,7 @@ class BatchGenerator:
         fed[:, 0] = self._host(self._last_tokens)
         fed[:, 1:] = np.maximum(props, 0)  # -1 pads embed as 0; never match
         t0 = time.perf_counter()
-        logits, self.cache = self._verify_rows(
+        logits, self.cache = self._pick_verify()(
             self.params, jnp.asarray(fed), self.cache,
             jnp.asarray(self._pos),
         )
