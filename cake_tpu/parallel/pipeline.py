@@ -355,6 +355,20 @@ def _head_split_safe(hw, S: int) -> bool:
     return ((v_local // S) % 256 == 0) == (v_local % 256 == 0)
 
 
+def _head_chunk(hw, my_stage, S: int):
+    """This stage's V/S column slice of the (possibly int8) lm_head — the
+    one shared implementation behind every vocab-split head, so the
+    schedules that must stay bit-identical can never drift apart."""
+    chunk = quant.out_features(hw) // S
+    start = my_stage * chunk
+    if isinstance(hw, quant.QuantizedLinear):
+        return quant.QuantizedLinear(
+            q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
+            scale=jax.lax.dynamic_slice_in_dim(hw.scale, start, chunk, 0),
+        )
+    return jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
+
+
 def build_interleaved_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
     params_like: dict | None = None, steps: int = 1,
@@ -435,17 +449,8 @@ def build_interleaved_decode(
             the local vocab does not divide or the split would change the
             quantized head's backend class)."""
             if S > 1 and split_safe:
-                chunk = v_local // S
-                start = my_stage * chunk
-                if isinstance(hw, quant.QuantizedLinear):
-                    sub = quant.QuantizedLinear(
-                        q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
-                        scale=jax.lax.dynamic_slice_in_dim(
-                            hw.scale, start, chunk, 0),
-                    )
-                else:
-                    sub = jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
-                lg = quant.dense(x_n, sub).astype(jnp.float32)
+                lg = quant.dense(x_n, _head_chunk(hw, my_stage, S)).astype(
+                    jnp.float32)
                 lg = jax.lax.all_gather(lg, STAGE, axis=-1, tiled=True)
             else:
                 lg = quant.dense(x_n, hw).astype(jnp.float32)
@@ -807,17 +812,8 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
         y = rms_norm(y, params["norm_f"], config.rms_norm_eps)
         hw = params["lm_head"]
         if S > 1 and _head_split_safe(hw, S):
-            chunk = quant.out_features(hw) // S
-            start = my_stage * chunk
-            if isinstance(hw, quant.QuantizedLinear):
-                sub = quant.QuantizedLinear(
-                    q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
-                    scale=jax.lax.dynamic_slice_in_dim(
-                        hw.scale, start, chunk, 0),
-                )
-            else:
-                sub = jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
-            logits = quant.dense(y, sub).astype(jnp.float32)
+            logits = quant.dense(y, _head_chunk(hw, my_stage, S)).astype(
+                jnp.float32)
             logits = jax.lax.all_gather(logits, STAGE, axis=-1, tiled=True)
         else:
             logits = quant.dense(y, hw).astype(jnp.float32)

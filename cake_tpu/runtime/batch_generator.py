@@ -244,6 +244,7 @@ class BatchGenerator:
         self.__verify_rows = None
         self.__verify_rows_il = None
         self.__accept_rows = None
+        self.__prefill_pipelined = None
         # Serving observability (the worker-side ops/s + master tok/s story
         # of the reference, on the batch plane): dispatch and token
         # counters plus busy wall-clock, reported by stats().
@@ -549,7 +550,7 @@ class BatchGenerator:
                 self.config, self.plan.mesh, batch=b, max_seq=self.max_seq,
                 quant=self.kv_quant,
             )
-            logits, self.cache = self._prefill(
+            logits, self.cache = self._pick_prefill(tokens.shape[1])(
                 self.params, jnp.asarray(tokens), self.cache,
                 jnp.asarray(last)
             )
@@ -938,6 +939,22 @@ class BatchGenerator:
             else:
                 skip.append(True)
         return self._emit(row, skip=skip)
+
+    def _pick_prefill(self, t: int):
+        """Serialized vs GPipe-pipelined batch prefill: on a staged mesh a
+        prompt bucket divisible into num_stages chunks streams through the
+        stages concurrently (~S× prompt throughput once the pipeline
+        fills, identical results — parallel.pipeline microbatch mode);
+        anything else uses the serialized program."""
+        S = self.plan.num_stages
+        if not self._interleave or S < 2 or t % S:
+            return self._prefill
+        if self.__prefill_pipelined is None:
+            self.__prefill_pipelined = self._pinned(build_sharded_prefill(
+                self.config, self.plan, params_like=self.params,
+                microbatch=S, kv_quant=self.kv_quant,
+            ))
+        return self.__prefill_pipelined
 
     def _pick_decode(self, block: bool):
         """Serialized vs interleaved schedule for this dispatch: the

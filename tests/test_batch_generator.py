@@ -736,3 +736,21 @@ def test_generate_is_incremental(params):
     assert all(len(x) == 7 for x in s2)
     for a, b in zip(f, s2):
         assert b[:4] == a
+
+
+def test_staged_batch_prefill_uses_pipelined_chunks(params):
+    """On a staged mesh, set_prompts' batch prefill streams prompt chunks
+    through the stages (GPipe microbatch mode) when the bucket divides —
+    streams bit-identical to the 1-stage serving oracle."""
+    from cake_tpu.parallel.mesh import MeshPlan
+
+    settings = SamplerSettings(**GREEDY)
+    prompts = [[5, 9, 2, 11, 3, 8], [3, 1, 4, 1, 5, 9], [7, 7, 2, 4]]
+    flat = BG(CFG, params, settings=settings)
+    flat.set_prompts([list(p) for p in prompts])
+    want = flat.generate(8)
+    plan = MeshPlan.build(CFG, num_stages=2, devices=jax.devices()[:2])
+    staged = BG(CFG, params, plan=plan, settings=settings)
+    staged.set_prompts([list(p) for p in prompts])
+    assert staged._BatchGenerator__prefill_pipelined is not None
+    assert staged.generate(8) == want

@@ -308,3 +308,39 @@ def test_spec_serving_on_stages_uses_interleaved_verify():
     assert staged.stats()["spec_dispatches"] >= 1
     # the interleaved verify program was actually built and used
     assert staged._BatchGenerator__verify_rows_il is not None
+
+
+def test_interleaved_verify_int8_weights_under_pin():
+    """Int8 WEIGHTS through the interleaved verify's vocab-split head:
+    logits bit-identical to the serialized verify under a pinned backend
+    (the QuantizedLinear q/scale sub-head slice path)."""
+    from cake_tpu.ops import quant
+    from cake_tpu.ops.quant import quantize_params
+    from cake_tpu.parallel.pipeline import (
+        build_interleaved_verify_rows,
+        build_sharded_verify_rows,
+    )
+
+    cfg = _cfg(vocab_size=96)
+    plan = MeshPlan.build(cfg, num_stages=4, devices=jax.devices()[:4])
+    qparams = quantize_params(init_params(cfg, jax.random.PRNGKey(6)))
+    p = shard_params(qparams, plan.mesh)
+    batch = 8
+
+    def run(build):
+        cache = init_cache_on_mesh(cfg, plan.mesh, batch=batch, max_seq=64)
+        prefill = build_sharded_prefill(cfg, plan, params_like=p)
+        prompt = jnp.asarray([[1, 5, 9, 14, 3, 8, 2, 4]] * batch, jnp.int32)
+        _, cache = prefill(p, prompt, cache,
+                           jnp.full((batch,), 7, jnp.int32))
+        fed = jnp.asarray(
+            np.random.default_rng(2).integers(1, 90, (batch, 4)), jnp.int32)
+        pos = jnp.asarray([8, 9, 8, 10, 8, 9, 11, 8], jnp.int32)
+        v = build(cfg, plan, params_like=p)
+        logits, _ = v(p, fed, cache, pos)
+        return np.asarray(logits)
+
+    with quant.pinned_impl("xla"):
+        l1 = run(build_sharded_verify_rows)
+        l2 = run(build_interleaved_verify_rows)
+    np.testing.assert_array_equal(l1, l2)
