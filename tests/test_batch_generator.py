@@ -681,26 +681,38 @@ def test_serving_speculation_window_edge_falls_back(params):
     assert got == want
 
 
-def test_serving_speculation_composes_with_admission(params):
-    """enqueue during spec serving: the admitted stream's tokens match the
-    same (seed, stream_id, prompt) served solo with speculation."""
-    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
-    g = BG(CFG, params, settings=settings, spec_k=4, admit_chunk=8)
-    g.set_prompts([[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]])
+def _drive_spec_admission(params, settings, plan=None):
+    """Shared scaffold: spec serving, retire a slot, admit an arrival,
+    decode on; returns the generator (streams 0 and 9 live)."""
+    g = BG(CFG, params, plan=plan, settings=settings, spec_k=4,
+           admit_chunk=8)
+    g.set_prompts([[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]], stream_ids=[0, 1])
     for _ in range(3):
         g.step()
     g.streams[1].done = True
-    new_prompt = [8, 2, 8, 2, 8, 2]
-    g.enqueue(list(new_prompt), stream_id=9)
+    g.enqueue([8, 2, 8, 2, 8, 2], stream_id=9)
     while g.pending_admissions():
         g.step()
     for _ in range(14):
         g.step()
-    got = next(s for s in g.streams if s.stream_id == 9).generated
+    return g
+
+
+def _assert_matches_solo_spec(params, settings, g, sid, prompt):
+    got = next(s for s in g.streams
+               if s.active and s.stream_id == sid).generated
     solo = BG(CFG, params, settings=settings, spec_k=4)
-    solo.set_prompts([list(new_prompt)], stream_ids=[9])
+    solo.set_prompts([list(prompt)], stream_ids=[sid])
     want = solo.generate(len(got))[0]
-    assert got == want[: len(got)] and got
+    assert got == want[: len(got)] and got, sid
+
+
+def test_serving_speculation_composes_with_admission(params):
+    """enqueue during spec serving: the admitted stream's tokens match the
+    same (seed, stream_id, prompt) served solo with speculation."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    g = _drive_spec_admission(params, settings)
+    _assert_matches_solo_spec(params, settings, g, 9, [8, 2, 8, 2, 8, 2])
 
 
 def test_serving_speculation_with_int8_kv(params):
@@ -754,3 +766,17 @@ def test_staged_batch_prefill_uses_pipelined_chunks(params):
     staged.set_prompts([list(p) for p in prompts])
     assert staged._BatchGenerator__prefill_pipelined is not None
     assert staged.generate(8) == want
+
+
+def test_spec_admission_staged_mesh_triple_composition(params):
+    """The full r4 serving stack at once: staged mesh (interleaved verify +
+    decode fallback), batched speculation, and continuous admission — the
+    admitted stream and the survivors all match their solo oracles."""
+    from cake_tpu.parallel.mesh import MeshPlan
+
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    plan = MeshPlan.build(CFG, num_stages=2, devices=jax.devices()[:2])
+    g = _drive_spec_admission(params, settings, plan=plan)
+    assert g.stats()["spec_dispatches"] >= 1
+    for sid, prompt in ((0, [5, 9, 2, 5, 9, 2]), (9, [8, 2, 8, 2, 8, 2])):
+        _assert_matches_solo_spec(params, settings, g, sid, prompt)
