@@ -7,6 +7,8 @@ Shows the capabilities the single-request reference has no answer to
 - shared-prefix detection (the system prompt is prefilled once)
 - continuous batching: an arrival enqueued mid-run is admitted chunk by
   chunk alongside decode, then its slot streams like any other
+- batched n-gram speculation: every stream's proposals verified in one
+  per-row dispatch (tokens/dispatch > 1 on repetitive streams)
 - int8 KV cache + serving stats
 
 Run:  python examples/serve_continuous.py
@@ -31,7 +33,7 @@ def main() -> None:
         cfg, params,
         settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
         dp=1, block_size=4, kv_quant="int8", admit_chunk=16,
-        prefix_share_min=16,
+        prefix_share_min=16, spec_k=4,
     )
     gen.set_prompts([
         system_prompt + [5, 9, 2],
@@ -54,8 +56,10 @@ def main() -> None:
 
     st = gen.stats()
     print(f"\n{st['tokens_emitted']} tokens over "
-          f"{st['decode_dispatches']} decode + {st['admit_dispatches']} "
-          f"admission dispatches ({st['tokens_per_dispatch']} tokens/dispatch)")
+          f"{st['decode_dispatches']} decode ({st['spec_dispatches']} "
+          f"speculative) + {st['admit_dispatches']} admission dispatches "
+          f"({st['tokens_per_dispatch']} tokens/dispatch, "
+          f"{st['prefix_hits']} prefix hit(s))")
     for i, s in enumerate(gen.streams):
         if s.active:
             print(f"stream {i} (id {s.stream_id}): {s.generated}")

@@ -681,16 +681,20 @@ def test_serving_speculation_window_edge_falls_back(params):
     assert got == want
 
 
+_SPEC_ADMIT_STREAMS = ((0, [5, 9, 2, 5, 9, 2]), (9, [8, 2, 8, 2, 8, 2]))
+
+
 def _drive_spec_admission(params, settings, plan=None):
     """Shared scaffold: spec serving, retire a slot, admit an arrival,
-    decode on; returns the generator (streams 0 and 9 live)."""
+    decode on; returns the generator (the _SPEC_ADMIT_STREAMS ids live)."""
     g = BG(CFG, params, plan=plan, settings=settings, spec_k=4,
            admit_chunk=8)
-    g.set_prompts([[5, 9, 2, 5, 9, 2], [3, 1, 4, 1]], stream_ids=[0, 1])
+    g.set_prompts([list(_SPEC_ADMIT_STREAMS[0][1]), [3, 1, 4, 1]],
+                  stream_ids=[0, 1])
     for _ in range(3):
         g.step()
     g.streams[1].done = True
-    g.enqueue([8, 2, 8, 2, 8, 2], stream_id=9)
+    g.enqueue(list(_SPEC_ADMIT_STREAMS[1][1]), stream_id=9)
     while g.pending_admissions():
         g.step()
     for _ in range(14):
@@ -712,7 +716,8 @@ def test_serving_speculation_composes_with_admission(params):
     same (seed, stream_id, prompt) served solo with speculation."""
     settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
     g = _drive_spec_admission(params, settings)
-    _assert_matches_solo_spec(params, settings, g, 9, [8, 2, 8, 2, 8, 2])
+    _assert_matches_solo_spec(params, settings, g,
+                              *_SPEC_ADMIT_STREAMS[1])
 
 
 def test_serving_speculation_with_int8_kv(params):
@@ -778,5 +783,5 @@ def test_spec_admission_staged_mesh_triple_composition(params):
     plan = MeshPlan.build(CFG, num_stages=2, devices=jax.devices()[:2])
     g = _drive_spec_admission(params, settings, plan=plan)
     assert g.stats()["spec_dispatches"] >= 1
-    for sid, prompt in ((0, [5, 9, 2, 5, 9, 2]), (9, [8, 2, 8, 2, 8, 2])):
+    for sid, prompt in _SPEC_ADMIT_STREAMS:
         _assert_matches_solo_spec(params, settings, g, sid, prompt)
