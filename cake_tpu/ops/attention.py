@@ -243,20 +243,25 @@ def self_attention_block(
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
-        if jnp.asarray(pos).ndim:
-            raise ValueError(
-                "per-row positions are not supported with sequence "
-                "parallelism (sp is the long-context single-stream plane); "
-                "use sp=1 for multi-stream serving"
-            )
         quantized = isinstance(k_cache, kv.QuantizedKV)
         s_l = kv._kv_data(k_cache).shape[2]
         sp_idx = jax.lax.axis_index(sp_axis)
         is_prefill = sp_prefill if sp_prefill is not None else t > 1
+        # pos may be [B] (multi-stream sp serving: per-row frontiers) on
+        # the decode path; the prefill path positions by chunk offset and
+        # never reads it
         if is_prefill:
             # Sequence-parallel prefill: the prompt (bucketed to a multiple
             # of sp) is sharded over the ring; ring attention costs are
             # prompt-proportional, not window-proportional.
+            if jnp.asarray(pos).ndim:
+                # this branch positions by chunk offset and never reads
+                # pos — a caller passing per-row positions here would get
+                # silently wrong RoPE/causal offsets
+                raise ValueError(
+                    "per-row positions are not supported by sp prefill "
+                    "(rows share the chunk-offset position layout)"
+                )
             if t > s_l:
                 raise ValueError(
                     f"sp prefill chunk (T_local {t}) exceeds the cache "

@@ -52,7 +52,8 @@ def attend_stats(
 
     Causality: key position ``k_off + s`` attends iff ``<= q_off + t``. Rows
     with no valid key yield ``m = NEG_INF, l = 0, o = 0`` and drop out of any
-    merge.
+    merge. ``q_off`` may be scalar or ``[B]`` (per-batch-row causal
+    frontiers — the multi-stream sp serving path).
     """
     b, n_heads, t, d = q.shape
     kv_heads, s = k.shape[1], k.shape[2]
@@ -64,13 +65,19 @@ def attend_stats(
     ) / jnp.sqrt(jnp.float32(d))
 
     kpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 1) + jnp.asarray(k_off, jnp.int32)
-    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0) + jnp.asarray(q_off, jnp.int32)
-    mask = kpos <= qpos  # [T, S]
-    scores = jnp.where(mask[None, None, None], scores, NEG_INF)
+    qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
+    q_off = jnp.asarray(q_off, jnp.int32)
+    if q_off.ndim == 0:
+        mask = (kpos <= qpos + q_off)[None, None, None]  # [1,1,1,T,S]
+    else:
+        mask = (kpos[None] <= qpos[None] + q_off[:, None, None])[
+            :, None, None
+        ]  # [B,1,1,T,S]
+    scores = jnp.where(mask, scores, NEG_INF)
 
     m = jnp.max(scores, axis=-1)  # [B, KH, G, T]
     # Guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1, so re-mask.
-    p = jnp.where(mask[None, None, None], jnp.exp(scores - m[..., None]), 0.0)
+    p = jnp.where(mask, jnp.exp(scores - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
     o = jnp.einsum(
         "bkgts,bksd->bkgtd", p.astype(v.dtype), v,
@@ -167,14 +174,15 @@ def sp_decode_attend(
     q: jax.Array,  # [B, H, 1, D] (replicated across sp, already roped)
     k_local: jax.Array,  # [B, KH, S_l, D] this shard's KV slice
     v_local: jax.Array,
-    pos,  # scalar: global position of the query token
+    pos,  # scalar or [B]: global position(s) of the query token(s)
     axis_name: str,
     shard_start,  # scalar: global position of k_local[..., 0, :]
 ) -> jax.Array:
     """Distributed flash decoding over a sequence-sharded KV cache.
 
     Each shard computes partial stats over its slice (keys beyond the causal
-    frontier ``pos`` masked), then the exact softmax is reassembled with one
+    frontier ``pos`` masked — scalar, or ``[B]`` for multi-stream serving
+    with per-row frontiers), then the exact softmax is reassembled with one
     pmax + two psum. Traffic per step is O(B·H·D), independent of S.
     """
     o, m, l = attend_stats(q, k_local, v_local, pos, shard_start)
@@ -258,7 +266,7 @@ def sp_cache_write(
     v_cache,
     k_new: jax.Array,  # [B, KH, 1, D]
     v_new: jax.Array,
-    pos,  # scalar global write position
+    pos,  # scalar or [B]: global write position(s)
     shard_start,  # scalar global position of this shard's slot 0
     gate: jax.Array | None = None,
 ):
@@ -267,22 +275,35 @@ def sp_cache_write(
     Every shard executes the same program (SPMD); only the shard whose range
     contains ``pos`` commits the new KV — the rest rewrite their current slot
     value, which XLA lowers to an in-place dynamic-update on donated buffers.
+    ``pos`` may be scalar (single-stream) or ``[B]`` (multi-stream serving:
+    each row writes at its own frontier, possibly on different shards).
     ``gate``: additional scalar predicate (pipeline-stage activity) ANDed in.
     Quantized halves write their int8 bytes and per-slot scale the same way.
     """
     from cake_tpu.ops.kvcache import _kv_data
 
     s_l = _kv_data(k_cache).shape[2]
-    local = jnp.asarray(pos, jnp.int32) - jnp.asarray(shard_start, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    local = pos - jnp.asarray(shard_start, jnp.int32)
     owner = (local >= 0) & (local < s_l)
     if gate is not None:
         owner = owner & gate
     off = jnp.clip(local, 0, s_l - 1)
 
-    def write_leaf(cache, new):
-        cur = jax.lax.dynamic_slice_in_dim(cache, off, 1, axis=2)
-        val = jnp.where(owner, new.astype(cache.dtype), cur)
-        return jax.lax.dynamic_update_slice_in_dim(cache, val, off, axis=2)
+    if pos.ndim == 0:
+        def write_leaf(cache, new):
+            cur = jax.lax.dynamic_slice_in_dim(cache, off, 1, axis=2)
+            val = jnp.where(owner, new.astype(cache.dtype), cur)
+            return jax.lax.dynamic_update_slice_in_dim(cache, val, off,
+                                                       axis=2)
+    else:
+        def write_leaf(cache, new):
+            def one(c, n, ok, o):  # c [KH, S_l(, D)], n [KH, 1(, D)]
+                cur = jax.lax.dynamic_slice_in_dim(c, o, 1, axis=1)
+                val = jnp.where(ok, n.astype(c.dtype), cur)
+                return jax.lax.dynamic_update_slice_in_dim(c, val, o, axis=1)
+
+            return jax.vmap(one)(cache, new.astype(cache.dtype), owner, off)
 
     def write(cache, new):
         pairs, rebuild = _leaf_pairs(cache, new)

@@ -247,12 +247,14 @@ def build_sharded_decode(
     into its key (``fold_in(row_key, index0[b] + i)``), so a stream's
     output depends only on (its key, its prompt) — invariant to batch
     composition, mesh layout, and admission time. The signature always
-    ends with ``index0`` in this mode. Requires ``plan.sp == 1``.
+    ends with ``index0`` in this mode. ``per_row`` composes with ``sp > 1``
+    (r4): each stream decodes at its own frontier against the
+    sequence-sharded cache — the per-row positions flow through the sp
+    owner-masked KV write and the per-row-masked distributed flash decode
+    (ops/ring.py), which is what lets MULTI-stream serving ride a window
+    sharded across chips.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
-    if per_row and plan.sp != 1:
-        raise ValueError("per_row decode requires sp == 1 (sequence "
-                         "parallelism is the single-stream long-context plane)")
 
     def one_step(params, token, cache, pos, key, history, hist_slot):
         # cache.max_seq inside shard_map is the per-shard slice; RoPE tables

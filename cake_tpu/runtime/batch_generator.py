@@ -24,6 +24,15 @@ Per-stream independence is real, not cosmetic:
   the batch keeps running (its rows keep computing into discarded outputs —
   the SPMD analogue of the pipeline's gated inactive stages).
 
+Sequence parallelism (r4): on an ``sp > 1`` plan the KV window is sharded
+across the sp axis and every stream still decodes at its own frontier —
+the per-row positions flow through the owner-masked sp cache write and the
+per-row-masked distributed flash decode (ops/ring.py). This is the
+many-LONG-streams composition: window HBM splits over sp while the batch
+splits over dp. Admission, the prefix store, speculation, and the
+interleaved schedules remain ``sp == 1`` features (gated with clear
+errors).
+
 Continuous batching: arrivals ``enqueue`` into a FIFO and are admitted into
 freed slots without stalling the batch — each ``step()`` advances the head
 arrival's prefill by one chunk dispatch (one replicated row into a staging
@@ -119,15 +128,30 @@ class BatchGenerator:
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
                                   dp=dp, sp=1, devices=devices)
-        if plan.sp != 1:
+        # sp > 1 (r4): multi-stream serving over a sequence-sharded window —
+        # per-row frontiers flow through the sp owner-masked KV write and
+        # per-row-masked distributed flash decode. The admission /
+        # prefix-store / speculation / interleave machinery still requires
+        # sp == 1 programs and is gated off below.
+        if plan.sp != 1 and spec_k:
             raise ValueError(
-                "BatchGenerator requires sp == 1 (sequence parallelism is "
-                "the single-stream long-context plane)"
+                "batched speculation requires sp == 1 (the verification "
+                "programs are the serving-plane sp == 1 path)"
+            )
+        if plan.sp != 1 and interleave:
+            raise ValueError(
+                "the interleaved schedules require sp == 1 (pass "
+                "interleave=None to auto-select where supported)"
             )
         self.config = config
         self.plan = plan
         self.settings = settings or SamplerSettings()
         self.max_seq = max_seq or config.max_seq_len
+        if plan.sp > 1 and self.max_seq % plan.sp:
+            raise ValueError(
+                f"max_seq {self.max_seq} must divide by sp {plan.sp} (the "
+                "KV window shards over the sp axis)"
+            )
         self.tokenizer = tokenizer
         self.block_size = max(1, block_size)
         # int8 KV roughly doubles servable batch x window on a fixed HBM
@@ -167,7 +191,7 @@ class BatchGenerator:
         # dispatch whenever the batch divides by the stage count; serialized
         # programs remain the fallback (programs compile lazily on first
         # use, so the unused path costs nothing).
-        self._interleave = (
+        self._interleave = plan.sp == 1 and (
             plan.num_stages > 1 if interleave is None
             else interleave and plan.num_stages > 1
         )
@@ -490,7 +514,7 @@ class BatchGenerator:
         # every row keeps >= 1 remainder token. Bit-identical output —
         # positions and tokens are unchanged, only the redundancy goes.
         lcp = 0
-        if b > 1 and self._prefix_share_min:
+        if b > 1 and self._prefix_share_min and self.plan.sp == 1:
             first = self.streams[0].prompt
             lcp = min(len(s.prompt) for s in self.streams) - 1
             for i in range(lcp):
@@ -509,6 +533,11 @@ class BatchGenerator:
         # path). The cap still covers every remainder (n_max < max_seq).
         n_max = max(len(s.prompt) for s in self.streams)
         t_pad = min(_bucket(n_max - lcp, self.max_seq), self.max_seq - lcp)
+        if self.plan.sp > 1 and t_pad % self.plan.sp:
+            # sp prefill shards the bucket over the ring: round up to a
+            # multiple of sp (junk slots stay beyond every frontier)
+            t_pad = min(-(-t_pad // self.plan.sp) * self.plan.sp,
+                        self.max_seq)
         tokens = np.zeros((b, t_pad), np.int32)
         last = np.zeros((b,), np.int32)
         for i, s in enumerate(self.streams):
@@ -590,7 +619,10 @@ class BatchGenerator:
         emitted in that step's row and the stream joins the batch. Output
         is bit-identical to the same (seed, stream_id, prompt) in any other
         batch or admission timing (per-row positions + per-row token
-        indices)."""
+        indices). Requires ``sp == 1`` (the admission programs are the
+        sp == 1 serving path)."""
+        if self.plan.sp != 1:
+            raise ValueError("continuous admission requires sp == 1")
         self._arrivals.append((self._encode(prompt), stream_id))
 
     def pending_admissions(self) -> int:
@@ -779,7 +811,9 @@ class BatchGenerator:
         completion here and the first token is returned (recorded;
         subsequent ``step()`` calls carry the stream forward). Use
         ``enqueue`` to interleave the prefill with decode instead. Raises
-        if no stream is done."""
+        if no stream is done. Requires ``sp == 1`` like ``enqueue``."""
+        if self.plan.sp != 1:
+            raise ValueError("continuous admission requires sp == 1")
         if not self.streams:
             raise RuntimeError("set_prompts first")
         ids = self._encode(prompt)
