@@ -209,12 +209,12 @@ def run_serve(args) -> int:
         sys.exit("error: --prompts-file serving runs the mesh pipeline; "
                  "--topology (cross-host workers) is not supported here")
     # Reject flags this path would otherwise silently ignore (run_master
-    # gives the same treatment to its invalid combinations): serving is the
-    # sp=1 multi-stream plane, and pipelined prefill requires mesh stages.
-    if args.sp > 1:
-        sys.exit("error: --sp (sequence parallelism) is the long-context "
-                 "single-stream plane; it is not supported with "
-                 "--prompts-file serving")
+    # gives the same treatment to its invalid combinations). --sp composes
+    # with serving since r4 (the KV window shards across the sp axis —
+    # many long streams per chip set) except with --speculate, whose
+    # verification programs are the sp == 1 path.
+    if args.sp > 1 and args.speculate:
+        sys.exit("error: --speculate requires --sp 1 on the serving path")
     if args.prefill_chunks > 1:
         sys.exit("error: --prefill-chunks is not supported with "
                  "--prompts-file serving")
@@ -249,7 +249,7 @@ def run_serve(args) -> int:
 
     try:
         plan = MeshPlan.build(config, num_stages=args.stages, tp=args.tp,
-                              dp=args.dp, sp=1)
+                              dp=args.dp, sp=args.sp)
     except ValueError as e:
         sys.exit(f"error: {e}")
     # direct-to-mesh load: each shard's bytes only, no full-model host copy
@@ -260,11 +260,15 @@ def run_serve(args) -> int:
     # --decode-block composes with --speculate here: spec rounds replace
     # block dispatches while proposals/window allow, and the fused block
     # remains the fallback (e.g. a stream at its window edge)
-    gen = BatchGenerator(config, params, plan=plan, tokenizer=tokenizer,
-                         settings=settings, max_seq=args.max_seq,
-                         block_size=(args.decode_block
-                                     if args.decode_block is not None else 8),
-                         kv_quant=args.kv_quant, spec_k=args.speculate)
+    try:
+        gen = BatchGenerator(config, params, plan=plan, tokenizer=tokenizer,
+                             settings=settings, max_seq=args.max_seq,
+                             block_size=(args.decode_block
+                                         if args.decode_block is not None
+                                         else 8),
+                             kv_quant=args.kv_quant, spec_k=args.speculate)
+    except ValueError as e:  # e.g. --max-seq not divisible by --sp
+        sys.exit(f"error: {e}")
     gen.set_prompts(prompts)
     log.info("model loaded in %.1fs (%s); serving %d streams",
              time.perf_counter() - t0, memory_report(), len(prompts))

@@ -45,10 +45,15 @@ def model_dir(tmp_path_factory):
     return d
 
 
-def _run_cli(argv, timeout=240):
+def _run_cli(argv, timeout=240, devices=None):
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO)
     env["JAX_PLATFORMS"] = "cpu"
+    if devices:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices}"
+        ).strip()
     return subprocess.run(
         [sys.executable, "-m", "cake_tpu.cli"] + argv,
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
@@ -192,17 +197,16 @@ def test_prompts_file_serves_batch(model_dir, tmp_path):
 def test_prompts_file_numeric_text_needs_explicit_mode(model_dir, tmp_path):
     """A numeric-looking line is NEVER silently id-parsed: without
     --prompts-ids it is a text prompt (and errors without a tokenizer);
-    serving also rejects flags it would silently ignore (--sp,
-    --prefill-chunks)."""
+    serving also rejects flags it would silently ignore
+    (--prefill-chunks)."""
     pf = tmp_path / "prompts.txt"
     pf.write_text("1, 2, 3\n")
     r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
                   "-n", "2", "--cpu"])
     assert r.returncode != 0
     assert "tokenizer" in r.stderr
-    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
-                  "--prompts-ids", "-n", "2", "--cpu", "--sp", "2"])
-    assert r.returncode != 0 and "--sp" in r.stderr
+    # (--sp composes with serving since r4 — covered by
+    # test_prompts_file_serves_over_sp_window)
     r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
                   "--prompts-ids", "-n", "2", "--cpu",
                   "--prefill-chunks", "2"])
@@ -356,3 +360,30 @@ def test_master_worker_loopback_via_cli(model_dir, tmp_path):
             worker.wait(timeout=30)
         except subprocess.TimeoutExpired:
             worker.kill()  # don't mask the real failure or leak the process
+
+
+def test_prompts_file_serves_over_sp_window(model_dir, tmp_path):
+    """--prompts-file --sp 2 (r4): the serving batch decodes against a
+    sequence-sharded KV window; streams identical to the sp=1 run."""
+    pf = tmp_path / "prompts.txt"
+    pf.write_text("3,5,7\n2,4\n")
+
+    def run(extra):
+        r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                      "--prompts-ids", "-n", "4", "--temperature", "0",
+                      "--max-seq", "32", "--cpu"] + extra, devices=8)
+        assert r.returncode == 0, r.stderr
+        return [l for l in r.stdout.splitlines() if l.startswith("[")]
+
+    assert run(["--sp", "2"]) == run([])
+    # --speculate stays the sp == 1 serving path
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "--prompts-ids", "--cpu", "--sp", "2", "--speculate", "4"],
+                 timeout=120, devices=8)
+    assert r.returncode != 0 and "--sp 1" in r.stderr
+    # --max-seq not divisible by --sp: clean error, not a traceback
+    r = _run_cli(["--model", str(model_dir), "--prompts-file", str(pf),
+                  "--prompts-ids", "--cpu", "--sp", "2", "--max-seq", "31"],
+                 timeout=120, devices=8)
+    assert r.returncode != 0 and r.stderr.startswith("error:")
+    assert "sp 2" in r.stderr and "Traceback" not in r.stderr
