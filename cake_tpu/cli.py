@@ -95,7 +95,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "sampling; local, mesh --stages/--tp, and "
                         "--prompts-file serving paths — serving verifies "
                         "every stream's proposals per-row in one batched "
-                        "pass)")
+                        "pass. NOTE: with temperature > 0 serving rounds "
+                        "always run the K+1-wide verify (skipping on other "
+                        "streams' proposals would break per-stream "
+                        "reproducibility), so sampled speculation only "
+                        "pays off on repetitive/structured streams)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
@@ -276,10 +280,12 @@ def run_serve(args) -> int:
     outs = gen.generate(args.sample_len)
     dt = time.perf_counter() - t_gen0
     total = sum(len(o) for o in outs)
-    texts = gen.texts()
     for i, o in enumerate(outs):
-        if texts[i] is not None:
-            print(f"[{i}] {texts[i]}")
+        # decode the quota-truncated ids, not gen.texts(): ragged
+        # speculation can bank tokens past -n, and printed text must agree
+        # with the token counts the log reports
+        if tokenizer is not None:
+            print(f"[{i}] {tokenizer.decode(o)}")
         else:
             print(f"[{i}] {','.join(map(str, o))}")
     log.info("%d streams, %d tokens, %.2f tok/s aggregate — %s",

@@ -170,6 +170,13 @@ class BatchGenerator:
                 f"{quant_backend!r}"
             )
         self._quant_pin: str | None = quant_backend
+
+        def _has_quant(p) -> bool:
+            if isinstance(p, dict):
+                return any(_has_quant(v) for v in p.values())
+            return isinstance(p, quant.QuantizedLinear)
+
+        self._params_quantized = _has_quant(self.params)
         self._prefill = self._pinned(build_sharded_prefill(
             config, plan, params_like=self.params, kv_quant=kv_quant))
         self._decode_single = self._pinned(build_sharded_decode(
@@ -671,7 +678,19 @@ class BatchGenerator:
         shape depends only on the chunk for ``prompt_len``; with prefix
         sharing active, call again with the expected REMAINDER length
         (arrival length minus the shared prefix), since that is the shape
-        a prefix-cache hit dispatches."""
+        a prefix-cache hit dispatches.
+
+        With int8 weights, call AFTER ``set_prompts`` (or pass
+        ``quant_backend=`` at construction): the warm trace is permanent
+        in the jit cache, so tracing before the instance's backend pin is
+        decided would bake the per-shape gate in and silently void the
+        determinism contract — enforced below."""
+        if self._params_quantized and self._quant_pin is None:
+            raise ValueError(
+                "warm_admission with int8 weights needs the backend pin "
+                "decided first: call set_prompts before warming, or pass "
+                "quant_backend= at construction"
+            )
         chunk = self._admission_chunk_for(prompt_len)
         staging = init_cache_on_mesh(
             self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
@@ -1002,12 +1021,16 @@ class BatchGenerator:
         return il if local % self.plan.num_stages == 0 else serial
 
     def _step_decode(self):
+        # Buffered fused-block rows are EARLIER tokens than anything a new
+        # spec round would produce: drain them first, or a round that finds
+        # proposals mid-drain would emit later tokens ahead of buffered
+        # earlier ones and scramble per-stream order (r4 review repro).
+        if self._block_buf:
+            return self._emit(self._block_buf.pop(0))
         if self._spec_k:
             row = self._spec_emit_or_round()
             if row is not None:
                 return row
-        if self._block_buf:
-            return self._emit(self._block_buf.pop(0))
 
         # Capacity is per-stream: a finished stream's row keeps advancing
         # (its clamped writes touch only its own cache row, whose output is
@@ -1118,7 +1141,13 @@ class BatchGenerator:
                     return False
             return True
 
-        cap = 2 * max_new_tokens * max(1, len(self.streams)) + 8
+        # Worst-case steps per slow-stream token: draining another stream's
+        # full K+1 bank costs up to spec_k+1 step() calls while the slow
+        # stream gains one token — size the safety cap to that skew, not
+        # just 2x (r4 review: a 2-stream spec_k=8 run could hit the old
+        # 2x cap and silently under-deliver).
+        per_tok = max(2, self._spec_k + 2)
+        cap = per_tok * max_new_tokens * max(1, len(self.streams)) + 8
         for _ in range(cap):
             if quota_met():
                 break

@@ -785,3 +785,47 @@ def test_spec_admission_staged_mesh_triple_composition(params):
     assert g.stats()["spec_dispatches"] >= 1
     for sid, prompt in _SPEC_ADMIT_STREAMS:
         _assert_matches_solo_spec(params, settings, g, sid, prompt)
+
+
+def test_spec_with_block_decode_preserves_emission_order(params):
+    """spec_k composed with block_size > 1 (the CLI serving default): a
+    spec round must never run while fused-block rows are still buffered,
+    or later tokens would emit before buffered earlier ones (r4 review
+    repro — the proposal-less first steps fall to the block path, then
+    proposals appear mid-drain)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompts = [[5, 9, 2, 5, 9, 2, 5, 9], [7, 7, 2, 8]]
+    plain = BG(CFG, params, settings=settings)
+    plain.set_prompts([list(p) for p in prompts])
+    want = plain.generate(12)
+    for block in (2, 4):
+        g = BG(CFG, params, settings=settings, spec_k=4, block_size=block)
+        g.set_prompts([list(p) for p in prompts])
+        assert g.generate(12) == want, block
+
+
+def test_generate_quota_under_skewed_acceptance(params):
+    """One repetitive stream banking K+1 tokens per round must not starve
+    a non-repetitive stream of its generate(N) quota (the safety cap
+    scales with spec_k)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    g = BG(CFG, params, settings=settings, spec_k=8)
+    g.set_prompts([[5, 9, 2, 5, 9, 2, 5, 9], [7, 3, 8, 1]])
+    outs = g.generate(6)
+    assert all(len(o) == 6 for o in outs), [len(o) for o in outs]
+
+
+def test_warm_admission_requires_pin_with_int8(params):
+    from cake_tpu.ops.quant import quantize_params
+
+    qp = quantize_params(params)
+    settings = SamplerSettings(temperature=0.9, top_k=10)
+    g = BG(CFG, qp, settings=settings)
+    with pytest.raises(ValueError, match="backend pin"):
+        g.warm_admission(8)
+    # explicit pin or set_prompts-first both unblock it
+    g2 = BG(CFG, qp, settings=settings, quant_backend="xla")
+    g2.warm_admission(8)
+    g3 = BG(CFG, qp, settings=settings)
+    g3.set_prompts([[5, 9, 2]])
+    g3.warm_admission(8)
