@@ -755,19 +755,22 @@ def test_generate_is_incremental(params):
         assert b[:4] == a
 
 
-def test_staged_batch_prefill_uses_pipelined_chunks(params):
+@pytest.mark.parametrize("kv_quant", [None, "int8"])
+def test_staged_batch_prefill_uses_pipelined_chunks(params, kv_quant):
     """On a staged mesh, set_prompts' batch prefill streams prompt chunks
     through the stages (GPipe microbatch mode) when the bucket divides —
-    streams bit-identical to the 1-stage serving oracle."""
+    streams bit-identical to the 1-stage serving oracle, with and without
+    the quantized KV cache."""
     from cake_tpu.parallel.mesh import MeshPlan
 
     settings = SamplerSettings(**GREEDY)
     prompts = [[5, 9, 2, 11, 3, 8], [3, 1, 4, 1, 5, 9], [7, 7, 2, 4]]
-    flat = BG(CFG, params, settings=settings)
+    flat = BG(CFG, params, settings=settings, kv_quant=kv_quant)
     flat.set_prompts([list(p) for p in prompts])
     want = flat.generate(8)
     plan = MeshPlan.build(CFG, num_stages=2, devices=jax.devices()[:2])
-    staged = BG(CFG, params, plan=plan, settings=settings)
+    staged = BG(CFG, params, plan=plan, settings=settings,
+                kv_quant=kv_quant)
     staged.set_prompts([list(p) for p in prompts])
     assert staged._BatchGenerator__prefill_pipelined is not None
     assert staged.generate(8) == want
@@ -829,3 +832,28 @@ def test_warm_admission_requires_pin_with_int8(params):
     g3 = BG(CFG, qp, settings=settings)
     g3.set_prompts([[5, 9, 2]])
     g3.warm_admission(8)
+
+
+def test_spec_serving_with_prefix_store_hit(params):
+    """Speculation x prefix store: an arrival admitted through a prefix-
+    cache HIT joins a speculating batch and still matches its solo spec
+    oracle (the banked prefix row and the spec verify touch the same
+    cache rows)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    sysp = [(i * 7) % 100 + 2 for i in range(16)]
+    g = BG(CFG, params, settings=settings, spec_k=4, admit_chunk=8,
+           prefix_share_min=8, prefix_block=8)
+    g.set_prompts([sysp + [5, 9, 2], sysp + [3, 1, 4]], stream_ids=[0, 1])
+    for _ in range(3):
+        g.step()
+    g.streams[1].done = True
+    new_prompt = sysp + [8, 8, 4]
+    d0 = g.stats()["admit_dispatches"]
+    g.enqueue(list(new_prompt), stream_id=9)
+    while g.pending_admissions():
+        g.step()
+    assert g.stats()["admit_dispatches"] - d0 == 1  # prefix hit: 1 chunk
+    assert g.stats()["prefix_hits"] >= 1
+    for _ in range(10):
+        g.step()
+    _assert_matches_solo_spec(params, settings, g, 9, new_prompt)
