@@ -14,7 +14,8 @@ Knobs (env):
   CAKE_BENCH_PRESET  8b (default) | small | tiny  — model size
   CAKE_BENCH_STEPS   timed decode steps (default 128)
   CAKE_BENCH_SEQ     KV capacity (default 512)
-  CAKE_BENCH_QUANT   int8 — quantize linear weights (per-channel int8)
+  CAKE_BENCH_QUANT   int8 | int4 — quantize linear weights (per-channel
+                     symmetric; int4 is packed two-per-byte)
   CAKE_BENCH_MULTISTEP  fused decode steps per dispatch (default 16; 1 =
                         one program per token like the reference's loop).
                         Measured on v5e (small preset): 1 -> 16% of the HBM
@@ -87,7 +88,7 @@ def _hbm_gbps(device) -> float:
 
 def _wtag(quant: str, kv_quant: str | None) -> str:
     """Metric tag for the weight/KV dtype combination."""
-    tag = "int8" if quant == "int8" else "bf16"
+    tag = quant if quant in ("int8", "int4") else "bf16"
     return tag + "_kv8" if kv_quant else tag
 
 
@@ -647,8 +648,10 @@ def main() -> int:
     # 8B int8 — the same model at half the bytes, matching the reference's
     # quantized deployment tier (BASELINE.md config 5).
     quant = os.environ.get("CAKE_BENCH_QUANT", "")
-    if quant not in ("", "int8"):
-        sys.exit(f"error: CAKE_BENCH_QUANT must be 'int8', got {quant!r}")
+    if quant not in ("", "int8", "int4"):
+        sys.exit(
+            f"error: CAKE_BENCH_QUANT must be 'int8' or 'int4', got {quant!r}"
+        )
     rung = (preset, quant)
     default_ladder = [("8b", ""), ("8b", "int8"), ("small", ""), ("tiny", "")]
     on_default = rung == ("8b", "") or (
@@ -728,6 +731,10 @@ def main() -> int:
                 from cake_tpu.models.llama import init_params_int8
 
                 candidate = init_params_int8(cfg, key)
+            elif quant == "int4":
+                from cake_tpu.models.llama import init_params_int4
+
+                candidate = init_params_int4(cfg, key)
             else:
                 candidate = init_params(cfg, key)
             _sync(candidate)

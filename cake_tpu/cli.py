@@ -26,6 +26,17 @@ from pathlib import Path
 log = logging.getLogger("cake_tpu.cli")
 
 
+def _quant_spec(s: str) -> str:
+    """argparse validator for --quantize (int8 | int4 | int4:gN)."""
+    from cake_tpu.ops.quant import parse_quant_spec
+
+    try:
+        parse_quant_spec(s)
+    except ValueError as e:
+        raise argparse.ArgumentTypeError(str(e))
+    return s
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="cake-tpu",
@@ -75,8 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="repeat_last_n")
     p.add_argument("--dtype", choices=["bf16", "f16", "f32"], default="bf16",
                    help="f16 maps to bf16 on TPU")
-    p.add_argument("--quantize", choices=["int8"], default=None,
-                   help="quantize linear weights on load (per-channel int8)")
+    p.add_argument("--quantize", type=_quant_spec, default=None,
+                   metavar="{int8,int4,int4:gN}",
+                   help="quantize linear weights on load (per-channel "
+                        "symmetric; int4 is packed two-per-byte; int4:gN "
+                        "uses N-row group-wise scales, the accuracy tier)")
     p.add_argument("--kv-quant", choices=["int8"], default=None,
                    dest="kv_quant",
                    help="store the KV cache as int8 + per-slot scales "

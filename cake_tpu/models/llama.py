@@ -91,9 +91,33 @@ def init_params_int8(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
     inside one jitted ``lax.map`` over layers, so the f32 temporaries are
     per-layer-sized and freed at jit exit; peak stays near the int8 total.
     """
+    return _init_params_quantized(config, key, dtype, bits=8)
+
+
+def init_params_int4(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-init params with every linear packed-int4 quantized
+    (:class:`cake_tpu.ops.quant.Quantized4Linear`) — quarter the bf16 weight
+    bytes, the bandwidth tier below :func:`init_params_int8`."""
+    return _init_params_quantized(config, key, dtype, bits=4)
+
+
+def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
     from functools import partial as _partial
 
-    from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear
+    from cake_tpu.ops.quant import (
+        LAYER_LINEARS,
+        Quantized4Linear,
+        QuantizedLinear,
+        quantize_linear,
+        quantize_linear4,
+    )
+
+    if bits == 8:
+        qfn, cls = quantize_linear, QuantizedLinear
+        fields = ("q", "scale")
+    else:
+        qfn, cls = quantize_linear4, Quantized4Linear
+        fields = ("qp", "scale")
 
     dt = dtype or config.jax_dtype
     L = config.num_hidden_layers
@@ -103,8 +127,8 @@ def init_params_int8(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
     def qdense(k, shape, fan_in, stacked):
         def one(kk):
             w = jax.random.normal(kk, shape, jnp.float32) / jnp.sqrt(fan_in)
-            ql = quantize_linear(w)  # the one quantization convention
-            return ql.q, ql.scale
+            ql = qfn(w)  # the one quantization convention per tier
+            return tuple(getattr(ql, f) for f in fields)
 
         if not stacked:
             return one(k)
@@ -116,7 +140,7 @@ def init_params_int8(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
         k = next(keys)
         if name in LAYER_LINEARS:
             q, scale = qdense(k, shape, shape[0], True)
-            layers[name] = QuantizedLinear(q=q, scale=scale)
+            layers[name] = cls(q, scale)
         else:  # norms
             layers[name] = jnp.ones((L,) + shape, dt)
 
@@ -134,7 +158,7 @@ def init_params_int8(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
         "embed": embed,
         "layers": layers,
         "norm_f": jnp.ones((config.hidden_size,), dt),
-        "lm_head": QuantizedLinear(q=hq, scale=hscale),
+        "lm_head": cls(hq, hscale),
     }
 
 

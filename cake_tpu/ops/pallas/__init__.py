@@ -53,7 +53,10 @@ from cake_tpu.ops.pallas.flash import (  # noqa: E402
     flash_attention_q8,
     flash_decode,
 )
-from cake_tpu.ops.pallas.quant import quant_matmul_pallas  # noqa: E402
+from cake_tpu.ops.pallas.quant import (  # noqa: E402
+    quant4_matmul_pallas,
+    quant_matmul_pallas,
+)
 
 __all__ = [
     "kernels_enabled",
@@ -63,4 +66,5 @@ __all__ = [
     "flash_attention_q8",
     "flash_decode",
     "quant_matmul_pallas",
+    "quant4_matmul_pallas",
 ]

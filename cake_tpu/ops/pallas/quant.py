@@ -86,3 +86,117 @@ def quant_matmul_pallas(
         interpret=interpret,
     )(x, q, scale.reshape(1, n).astype(jnp.float32))
     return out
+
+
+def _kernel4(
+    xlo_ref, xhi_ref, qp_ref, s_ref, o_ref, acc_ref, *,
+    num_k_blocks: int, grouped: bool,
+):
+    """Packed-int4 matmul kernel. ``grouped`` is a Python static: per-channel
+    applies the scale once in the epilogue; grouped multiplies each K
+    block's f32 partial by its group's scale before accumulating (every K
+    block lies inside one group — bk2 divides group_size/2) — same math as
+    the grouped XLA einsum path up to f32 summation order."""
+    kb = pl.program_id(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
+
+    x_lo = xlo_ref[:]  # [BM, BK2] activation dtype (even K rows)
+    x_hi = xhi_ref[:]  # [BM, BK2] (odd K rows)
+    # Unpack both nibbles of the SAME packed block (adjacent-pair layout,
+    # ops/quant.py:pack_int4). Shifts run in int32 on the VPU — the int8
+    # bytes are what streamed from HBM, which is all that matters for the
+    # bandwidth-bound regime.
+    p = qp_ref[:].astype(jnp.int32)  # [BK2, BN]
+    w_lo = ((p << 28) >> 28).astype(x_lo.dtype)
+    w_hi = (p >> 4).astype(x_lo.dtype)
+    partial = jax.lax.dot_general(
+        x_lo, w_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ) + jax.lax.dot_general(
+        x_hi, w_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    acc_ref[:] += partial * s_ref[:] if grouped else partial
+
+    @pl.when(kb == num_k_blocks - 1)
+    def _finish():
+        if grouped:
+            o_ref[:] = acc_ref[:].astype(o_ref.dtype)
+        else:
+            o_ref[:] = (acc_ref[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def quant4_matmul_pallas(
+    x: jax.Array,  # [M, K]
+    qp: jax.Array,  # [K/2, N] int8 packed (two int4 per byte)
+    scale: jax.Array,  # [N] f32 per-channel, or [ngroups, N] grouped
+    *,
+    block_m: int = 256,
+    block_n: int = 512,
+    block_k: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Fused packed-int4 matmul: quarter the bf16 weight bytes from HBM.
+
+    ``y = (x[:, 0::2] @ lo(qp) + x[:, 1::2] @ hi(qp)) * scale`` with the
+    even/odd activation slices materialized OUTSIDE the kernel (M x K/2
+    each, activation-sized), so the K-axis grid walks packed weight rows
+    directly and the weight side never strides or interleaves. A grouped
+    ``scale [ngroups, N]`` caps the K block at half a group and applies
+    each group's scale to its own f32 partial."""
+    m, k = x.shape
+    k2, n = qp.shape
+    if k != 2 * k2:
+        raise ValueError(f"x in-dim {k} != 2 * packed rows {k2}")
+    grouped = scale.ndim == 2
+    bm = _pick_block(m, block_m)
+    bn = _pick_block(n, block_n)
+    if grouped:
+        g2 = k2 // scale.shape[0]  # packed rows per group
+        bk2 = _pick_block(g2, block_k)
+    else:
+        g2 = k2
+        bk2 = _pick_block(k2, block_k)
+    if interpret is None:
+        from cake_tpu.ops.pallas import interpret_default
+
+        interpret = interpret_default()
+
+    s_in = (
+        scale.astype(jnp.float32)
+        if grouped
+        else scale.reshape(1, n).astype(jnp.float32)
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel4, num_k_blocks=k2 // bk2, grouped=grouped),
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        grid=(m // bm, n // bn, k2 // bk2),
+        in_specs=[
+            pl.BlockSpec((bm, bk2), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((bm, bk2), lambda i, j, kb: (i, kb)),
+            pl.BlockSpec((bk2, bn), lambda i, j, kb: (kb, j)),
+            pl.BlockSpec(
+                (1, bn), lambda i, j, kb: (kb * bk2 // g2, j)
+            ),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * n * k,
+            bytes_accessed=m * k * x.dtype.itemsize
+            + k2 * n
+            + m * n * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(
+        x[:, 0::2],
+        x[:, 1::2],
+        qp,
+        s_in,
+    )
+    return out

@@ -110,7 +110,7 @@ def param_specs(params: dict | None = None) -> dict:
     }
     if params is None:
         return base
-    from cake_tpu.ops.quant import QuantizedLinear
+    from cake_tpu.ops.quant import Quantized4Linear, QuantizedLinear
 
     def refine(p, s):
         if isinstance(p, dict):
@@ -118,6 +118,19 @@ def param_specs(params: dict | None = None) -> dict:
         if isinstance(p, QuantizedLinear):
             scale_spec = P(*(tuple(s)[:-2] + (s[-1],)))
             return QuantizedLinear(q=s, scale=scale_spec)
+        if isinstance(p, Quantized4Linear):
+            # The packed qp takes the weight's spec unchanged: adjacent-pair
+            # packing (ops/quant.py) makes packed rows [a, b) the contiguous
+            # original rows [2a, 2b), so in-axis (row-parallel tp) sharding
+            # of the packed array is exactly the packing of the shard.
+            # Per-channel scale [..., out] drops the in axis; a grouped
+            # scale [..., ngroups, out] keeps the weight's spec verbatim —
+            # its group axis lives along (and shards with) the in axis.
+            if p.scale.ndim == p.qp.ndim:
+                scale_spec = s
+            else:
+                scale_spec = P(*(tuple(s)[:-2] + (s[-1],)))
+            return Quantized4Linear(qp=s, scale=scale_spec)
         return s
 
     return refine(params, base)

@@ -345,7 +345,7 @@ def _head_split_safe(hw, S: int) -> bool:
     v_local = quant.out_features(hw)
     if v_local % S:
         return False
-    if not isinstance(hw, quant.QuantizedLinear):
+    if not isinstance(hw, (quant.QuantizedLinear, quant.Quantized4Linear)):
         return True
     from cake_tpu.ops import pallas as pk
 
@@ -367,6 +367,15 @@ def _head_chunk(hw, my_stage, S: int):
         return quant.QuantizedLinear(
             q=jax.lax.dynamic_slice_in_dim(hw.q, start, chunk, 1),
             scale=jax.lax.dynamic_slice_in_dim(hw.scale, start, chunk, 0),
+        )
+    if isinstance(hw, quant.Quantized4Linear):
+        # vocab (out) axis slice — the packed in-axis is untouched; the
+        # out axis is the LAST scale axis for both per-channel [V] and
+        # grouped [ngroups, V] scales
+        return quant.Quantized4Linear(
+            qp=jax.lax.dynamic_slice_in_dim(hw.qp, start, chunk, 1),
+            scale=jax.lax.dynamic_slice_in_dim(
+                hw.scale, start, chunk, hw.scale.ndim - 1),
         )
     return jax.lax.dynamic_slice_in_dim(hw, start, chunk, 1)
 

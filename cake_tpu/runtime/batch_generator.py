@@ -174,7 +174,9 @@ class BatchGenerator:
         def _has_quant(p) -> bool:
             if isinstance(p, dict):
                 return any(_has_quant(v) for v in p.values())
-            return isinstance(p, quant.QuantizedLinear)
+            return isinstance(
+                p, (quant.QuantizedLinear, quant.Quantized4Linear)
+            )
 
         self._params_quantized = _has_quant(self.params)
         self._prefill = self._pinned(build_sharded_prefill(

@@ -1,24 +1,26 @@
-"""quantize-model: write a pre-quantized int8 checkpoint.
+"""quantize-model: write a pre-quantized int8 or int4 checkpoint.
 
-Quantize-on-load (``--quantize int8``) re-runs per-channel quantization on
-every start — minutes of host work for 70B-class checkpoints, on every
-host. This tool pays that cost ONCE, offline (the same role the reference's
-`cake-split-model` plays for layer filtering, main.rs:144-223): each linear
-is quantized per-output-channel (the one convention, ops/quant.py) and
-stored as two tensors
+Quantize-on-load (``--quantize int8``/``int4``) re-runs per-channel
+quantization on every start — minutes of host work for 70B-class
+checkpoints, on every host. This tool pays that cost ONCE, offline (the
+same role the reference's `cake-split-model` plays for layer filtering,
+main.rs:144-223): each linear is quantized per-output-channel (the one
+convention, ops/quant.py) and stored as two tensors
 
-    <hf_name>.q8     int8, HF [out, in] orientation
+    <hf_name>.q8     int8, HF [out, in] orientation        (--bits 8)
+    <hf_name>.q4     int8 packed two-per-byte, [out, in/2]  (--bits 4)
     <hf_name>.scale  f32 [out]
 
 alongside the untouched norms/embedding. Loaders (utils/weights.py,
-utils/sharded_load.py) detect the ``.q8`` names and read the int8 bytes
-directly — startup reads half the bytes and does zero quantize compute,
-and sharded loads slice the stored scales instead of reading full weights.
-Like the reference splitter, the written file is verified by re-loading it.
+utils/sharded_load.py) detect the ``.q8``/``.q4`` names and read the
+quantized bytes directly — startup reads a fraction of the bytes and does
+zero quantize compute, and sharded loads slice the stored scales instead
+of reading full weights. Like the reference splitter, the written file is
+verified by re-loading it.
 
 Usage:
   python -m cake_tpu.tools.quantize_model \\
-      --model-path /path/to/llama --output /path/to/llama-int8
+      --model-path /path/to/llama --output /path/to/llama-int8 [--bits 4]
 """
 
 from __future__ import annotations
@@ -31,7 +33,8 @@ from pathlib import Path
 
 import numpy as np
 
-from cake_tpu.ops.quant import LAYER_LINEARS, quantize_linear_np
+from cake_tpu.ops.quant import (LAYER_LINEARS, quantize_linear4_np,
+                                quantize_linear_np)
 from cake_tpu.utils.weights import _LAYER_MAP, load_safetensors_index
 
 # HF names of quantizable linears (torch [out, in] orientation), DERIVED
@@ -48,9 +51,14 @@ def _is_linear(name: str) -> bool:
 
 
 def quantize_checkpoint(model_path: str | Path, output: str | Path,
-                        shard_bytes: int = 4 << 30) -> Path:
+                        shard_bytes: int = 4 << 30, bits: int = 8,
+                        group_size: int | None = None) -> Path:
     """Quantize every linear of the checkpoint at ``model_path`` into
     ``output`` (config/tokenizer copied alongside); returns ``output``.
+    ``bits`` selects the tier: 8 (``.q8``) or 4 (packed ``.q4``);
+    ``group_size`` (int4 only) writes group-wise scales — int4's accuracy
+    tier for real checkpoints, detected by the loaders from the stored
+    scale's shape.
 
     Output is written incrementally in ~``shard_bytes`` safetensors shards
     — host RAM is bounded by one shard, not the checkpoint (a 70B-class
@@ -58,6 +66,18 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
     from safetensors import safe_open
     from safetensors.numpy import save_file
 
+    if bits not in (4, 8):
+        raise ValueError(f"bits must be 4 or 8, got {bits}")
+    if group_size is not None and bits != 4:
+        raise ValueError("--group-size applies to --bits 4 only")
+    qsuffix = ".q8" if bits == 8 else ".q4"
+    if bits == 8:
+        np_qfn = quantize_linear_np
+    else:
+        import functools
+
+        np_qfn = functools.partial(quantize_linear4_np,
+                                   group_size=group_size)
     model_path, output = Path(model_path), Path(output)
     output.mkdir(parents=True, exist_ok=True)
     name_to_file = load_safetensors_index(model_path)
@@ -98,6 +118,9 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
 
     def emit(name: str, arr: np.ndarray):
         nonlocal pending_bytes, total
+        # belt-and-braces: safetensors serializes the raw buffer, so a
+        # strided/F-ordered array would be scrambled on disk
+        arr = np.ascontiguousarray(arr)
         pending[name] = arr
         pending_bytes += arr.nbytes
         total += arr.nbytes
@@ -109,9 +132,10 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
         if _is_linear(name):
             # stored [out, in]; scale is per out channel, computed over the
             # in axis — quantize in the logical [in, out] layout and store
-            # back transposed so the file keeps the HF orientation
-            q, scale = quantize_linear_np(w.T)
-            emit(f"{name}.q8", np.ascontiguousarray(q.T))
+            # back transposed so the file keeps the HF orientation (int4:
+            # [out, in/2], packed along the in axis)
+            q, scale = np_qfn(w.T)
+            emit(f"{name}{qsuffix}", np.ascontiguousarray(q.T))
             emit(f"{name}.scale", scale)
             n_q += 1
         else:
@@ -122,7 +146,10 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
             h.close()
 
     index = {
-        "metadata": {"total_size": int(total), "cake_quant": "int8"},
+        "metadata": {"total_size": int(total),
+                     "cake_quant": ("int8" if bits == 8 else
+                                    f"int4:g{group_size}" if group_size
+                                    else "int4")},
         "weight_map": weight_map,
     }
     (output / "model.safetensors.index.json").write_text(json.dumps(index))
@@ -139,9 +166,11 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
         with safe_open(output / fname, framework="np") as sf:
             names = set(sf.keys())
             seen |= names
-            probe = next((n for n in names if n.endswith(".q8")), None)
+            probe = next(
+                (n for n in names if n.endswith(qsuffix)), None)
             if probe and sf.get_tensor(probe).dtype != np.int8:
-                raise RuntimeError("self-check failed: q8 tensor not int8")
+                raise RuntimeError(
+                    f"self-check failed: {qsuffix} tensor not int8 storage")
     missing = set(weight_map) - seen
     if missing:
         raise RuntimeError(f"self-check failed: missing {missing}")
@@ -154,9 +183,13 @@ def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--model-path", required=True)
     ap.add_argument("--output", required=True)
+    ap.add_argument("--bits", type=int, choices=[4, 8], default=8)
+    ap.add_argument("--group-size", type=int, default=None,
+                    help="int4 group-wise scale rows (accuracy tier)")
     args = ap.parse_args()
     try:
-        quantize_checkpoint(args.model_path, args.output)
+        quantize_checkpoint(args.model_path, args.output, bits=args.bits,
+                            group_size=args.group_size)
     except Exception as e:
         sys.exit(f"error: {e}")
     return 0

@@ -39,7 +39,8 @@ def hbm_budget(
     CACHE_SPEC): stacked layers shard over stage, linear in/out features over
     tp, KV sequence over sp and kv-heads over tp; **embed is replicated** on
     every chip and lm_head shards its vocab over tp. ``quant='int8'`` prices
-    the linears at 1 byte + f32 scales (ops/quant.py layout).
+    the linears at 1 byte + f32 scales, ``quant='int4'`` at half a byte
+    (packed) + f32 scales (ops/quant.py layouts).
 
     This is the planning arithmetic behind BASELINE.md configs 4/5 (70B on
     v5e-16): it makes the "int8 is load-bearing, not optional" claim of
@@ -47,7 +48,17 @@ def hbm_budget(
     """
     c = config
     el = 2 if c.dtype in ("bfloat16", "float16") else 4
-    lin_el, scale_el = (1, 4) if quant == "int8" else (el, 0)
+    group = None
+    if quant:
+        from cake_tpu.ops.quant import parse_quant_spec
+
+        quant, group = parse_quant_spec(quant)
+    if quant == "int8":
+        lin_el, scale_el = 1, 4
+    elif quant == "int4":
+        lin_el, scale_el = 0.5, 4  # packed two-per-byte (ops/quant.py int4)
+    else:
+        lin_el, scale_el = el, 0
     S = max_seq or c.max_seq_len
     d = c.head_dim
 
@@ -60,13 +71,21 @@ def hbm_budget(
     norms = 2 * c.hidden_size
 
     layers_per_chip = c.num_hidden_layers / num_stages
+    # scale elements: one per output channel (per-channel), or one per
+    # (in-group, channel) = weight elements / group_size (grouped int4 —
+    # e.g. g=128 on 70B w_down stores 224 scales per channel, ~6% of the
+    # int4 weight bytes; a near-limit config must price them)
+    layer_scales = lin / group if group else lin_out
     layer_bytes = layers_per_chip * (
-        lin / tp * lin_el + lin_out / tp * scale_el + norms * el
+        lin / tp * lin_el + layer_scales / tp * scale_el + norms * el
     )
     embed_bytes = c.vocab_size * c.hidden_size * el  # replicated
+    head_scales = (
+        c.hidden_size * c.vocab_size / group if group else c.vocab_size
+    )
     head_bytes = (
         c.hidden_size * c.vocab_size / tp * lin_el
-        + (c.vocab_size / tp) * scale_el
+        + (head_scales / tp) * scale_el
         + c.hidden_size * el
     )
     kv_bytes = (

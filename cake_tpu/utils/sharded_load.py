@@ -11,13 +11,16 @@ stages own. Contrast ``load_llama_params`` + ``shard_params``, which builds
 the entire pytree on host first (~70 GB host RAM for 70B int8, with
 full-model quantize time, on *every* host).
 
-Quantize-on-load (``quantize="int8"``) stays shard-local where the math
-allows: column-parallel linears (wq/wk/wv/w_gate/w_up, and lm_head) shard
-out-features, and the per-output-channel scale depends only on the full
-in-axis — present in every shard — so quantizing the column slice equals
-quantizing the full weight and slicing. Row-parallel linears (wo/w_down)
-shard the in-axis, so their callbacks read the full ``[in, out]`` layer
-weight, quantize, and slice — one layer at a time, never the whole stage.
+Quantize-on-load (``quantize="int8"``/``"int4"``) stays shard-local where
+the math allows: column-parallel linears (wq/wk/wv/w_gate/w_up, and lm_head)
+shard out-features, and the per-output-channel scale depends only on the
+full in-axis — present in every shard — so quantizing the column slice
+equals quantizing the full weight and slicing. Row-parallel linears
+(wo/w_down) shard the in-axis, so their callbacks read the full
+``[in, out]`` layer weight, quantize, and slice — one layer at a time,
+never the whole stage. For int4 the *packed* row axis is what shards:
+adjacent-pair packing (ops/quant.py) keeps every packed-row range a
+contiguous original-row range, so the reads stay single slices.
 """
 
 from __future__ import annotations
@@ -72,6 +75,10 @@ class CheckpointReader:
         self.bytes_read += out.nbytes
         return out
 
+    def shape(self, name: str) -> tuple:
+        """Stored shape without reading tensor bytes."""
+        return tuple(self._slice(name).get_shape())
+
     def close(self) -> None:
         for h in self._handles.values():
             if hasattr(h, "close"):
@@ -115,13 +122,54 @@ def load_llama_params_on_mesh(
     equal to ``shard_params(load_llama_params(...), mesh)`` — tested — but
     reads only addressable shards' bytes and holds at most one layer weight
     of host scratch at a time."""
-    if quantize not in (None, "int8"):
-        raise ValueError(f"unsupported quantize={quantize!r}")
-    from cake_tpu.ops.quant import QuantizedLinear, quantize_linear_np
+    from cake_tpu.ops.quant import (
+        Quantized4Linear,
+        QuantizedLinear,
+        pack_int4_np,
+        parse_quant_spec,
+        quantize_linear4_np,
+        quantize_linear_np,
+    )
     from cake_tpu.utils.weights import check_prequantized
+
+    tier, gsize = parse_quant_spec(quantize)
+    int4 = tier == "int4"
+    # tier plumbing: stored-tensor suffix, host quantizer, quantized class,
+    # and the packed-row factor (int4 stores K/2 rows per K in-features)
+    qsuffix = ".q4" if int4 else ".q8"
+    np_qfn = quantize_linear4_np if int4 else quantize_linear_np
+    qcls = Quantized4Linear if int4 else QuantizedLinear
+    qmax = 7 if int4 else 127
+    krows = 2 if int4 else 1  # original rows per stored quantized row
 
     reader = CheckpointReader(model_dir)
     prequantized = check_prequantized(reader.name_to_file, quantize)
+    # Grouped int4 (the accuracy tier): the direct-to-mesh path supports it
+    # for PRE-QUANTIZED checkpoints (stored [ngroups, out] scales slice
+    # like any tensor); on-the-fly grouped quantize would re-read full
+    # weights per shard for no benefit over quantizing once offline.
+    group = None  # in-rows per scale group, detected from the checkpoint
+    if int4 and prequantized:
+        probe = f"model.layers.0.{_LAYER_MAP['wq'][0]}.scale"
+        if probe in reader.name_to_file:
+            sshape = reader.shape(probe)
+            if len(sshape) == 2:
+                group = config.hidden_size // sshape[0]
+    if gsize is not None and not prequantized:
+        raise ValueError(
+            "grouped int4 quantize-on-load is not supported on the "
+            "direct-to-mesh path; pre-quantize once with "
+            "`python -m cake_tpu.tools.quantize_model --bits 4 "
+            f"--group-size {gsize}` and load that checkpoint"
+        )
+    if gsize is not None and prequantized and gsize != group:
+        # covers both a different stored group size AND a per-channel
+        # checkpoint (group None) — never silently drop a requested tier
+        raise ValueError(
+            f"checkpoint stores "
+            f"{'group_size=' + str(group) if group else 'per-channel'} "
+            f"int4, but quantize spec asked for g{gsize}"
+        )
     dt = _np_dtype(config.dtype)
     L = config.num_hidden_layers
     h = config.hidden_size
@@ -180,32 +228,41 @@ def load_llama_params_on_mesh(
                 scale_memo[key] = scale_memo[full][csl]
             else:
                 w = reader.read2d(name, slice(None), csl, transpose)
-                scale_memo[key] = quantize_linear_np(w)[1]
+                scale_memo[key] = np_qfn(w)[1]
         return scale_memo[key]
 
-    def quant_q_cb(suffix, transpose, row_parallel):
+    def quant_q_cb(suffix, transpose, row_parallel, kdim):
         def cb(index):
             lsl, rsl, csl = index
             lo, hi, _ = lsl.indices(L)
+            # int4 shards the PACKED row axis: stored rows [a, b) are the
+            # contiguous original rows [2a, 2b) (adjacent-pair packing,
+            # ops/quant.py), so the weight read stays one contiguous slice
+            a, b, _ = rsl.indices(kdim // krows)
+            wr = slice(a * krows, b * krows)
             per = []
             for i in range(lo, hi):
                 name = f"model.layers.{i}.{suffix}"
                 if prequantized:
-                    # stored int8 in the HF [out, in] orientation: read
-                    # exactly this shard's slice, no quantize compute
-                    per.append(reader.read2d(f"{name}.q8", rsl, csl, True))
+                    # stored quantized bytes in the HF [out, in(/2)]
+                    # orientation: read exactly this shard's slice
+                    per.append(
+                        reader.read2d(f"{name}{qsuffix}", rsl, csl, True))
                 elif row_parallel:
                     # scale needs the full in-axis (memoized: one full read
                     # per layer, shared across tp shards and the scale
-                    # leaf); the int8 bytes then need only this shard's rows
+                    # leaf); the quantized bytes then need only this
+                    # shard's rows
                     s = _scale(name, transpose, csl)
-                    w = reader.read2d(name, rsl, csl, transpose)
-                    per.append(np.clip(
+                    w = reader.read2d(name, wr, csl, transpose)
+                    q = np.clip(
                         np.round(np.asarray(w, np.float32) / s),
-                        -127, 127).astype(np.int8))
+                        -qmax, qmax).astype(np.int8)
+                    if int4:
+                        q = pack_int4_np(q)
+                    per.append(q)
                 else:
-                    q, s = quantize_linear_np(
-                        reader.read2d(name, rsl, csl, transpose))
+                    q, s = np_qfn(reader.read2d(name, wr, csl, transpose))
                     scale_memo.setdefault(_key(name, csl), s)
                     per.append(q)
             return np.stack(per)
@@ -214,6 +271,15 @@ def load_llama_params_on_mesh(
 
     def quant_scale_cb(suffix, transpose):
         def cb(index):
+            if group is not None:
+                # grouped scale leaf [L, ngroups, out]: stored exactly so
+                lsl, gsl, csl = index
+                lo, hi, _ = lsl.indices(L)
+                return np.stack([
+                    reader.read2d(f"model.layers.{i}.{suffix}.scale",
+                                  gsl, csl, False)
+                    for i in range(lo, hi)
+                ])
             lsl, csl = index
             lo, hi, _ = lsl.indices(L)
             if prequantized:
@@ -238,15 +304,24 @@ def load_llama_params_on_mesh(
                 continue
             spec = (P(STAGE, TP, None) if ours in _ROW_PARALLEL
                     else P(STAGE, None, TP))
-            if quantize == "int8":
-                scale_spec = (P(STAGE, None) if ours in _ROW_PARALLEL
-                              else P(STAGE, TP))
-                layers[ours] = QuantizedLinear(
-                    q=_assemble(shape, mesh, spec,
-                                quant_q_cb(suffix, transpose,
-                                           ours in _ROW_PARALLEL)),
-                    scale=_assemble((L, shape[2]), mesh, scale_spec,
-                                    quant_scale_cb(suffix, transpose)),
+            if tier is not None:
+                qshape = (L, shape[1] // krows, shape[2])
+                if group is not None:
+                    # grouped scale [L, ngroups, out] takes the weight's
+                    # spec — the group axis lives along (and shards with)
+                    # the in axis (mesh.param_specs, same rule)
+                    scale_spec = spec
+                    scale_shape = (L, shape[1] // group, shape[2])
+                else:
+                    scale_spec = (P(STAGE, None) if ours in _ROW_PARALLEL
+                                  else P(STAGE, TP))
+                    scale_shape = (L, shape[2])
+                layers[ours] = qcls(
+                    _assemble(qshape, mesh, spec,
+                              quant_q_cb(suffix, transpose,
+                                         ours in _ROW_PARALLEL, shape[1])),
+                    _assemble(scale_shape, mesh, scale_spec,
+                              quant_scale_cb(suffix, transpose)),
                 )
             else:
                 layers[ours] = _assemble(shape, mesh, spec,
@@ -265,34 +340,66 @@ def load_llama_params_on_mesh(
             lambda index: reader.read1d("model.norm.weight",
                                         index[0]).astype(dt),
         )
-        if quantize == "int8":
+        if tier is not None:
             # lm_head is column-parallel over vocab: shard-local quantize
             # is exact (full in-axis per shard); its scales ride the same
             # memo so the scale leaf re-reads nothing. A tied head has no
-            # stored .q8 (the embedding stays full-precision) and falls
-            # back to on-the-fly quantize.
-            head_prequant = (prequantized
-                             and f"{head_name}.q8" in reader.name_to_file)
+            # stored .q8/.q4 (the embedding stays full-precision) and falls
+            # back to on-the-fly quantize — at the checkpoint's detected
+            # group size, so the head matches the layers' tier.
+            head_prequant = (
+                prequantized
+                and f"{head_name}{qsuffix}" in reader.name_to_file
+            )
+
+            # one read + one quantize per column range for the grouped
+            # tied-head fallback — head_q and head_scale share the result
+            # (the grouped analog of scale_memo; both specs are P(None, TP),
+            # so the row axis is always full and columns key the memo)
+            head_g_memo: dict[tuple, tuple] = {}
+
+            def _head_grouped(csl: slice) -> tuple:
+                key = (csl.start, csl.stop)
+                if key not in head_g_memo:
+                    w = reader.read2d(head_name, slice(0, h), csl, True)
+                    head_g_memo[key] = quantize_linear4_np(
+                        w, group_size=group)
+                return head_g_memo[key]
 
             def head_q(index):
                 if head_prequant:
-                    return reader.read2d(f"{head_name}.q8", index[0],
+                    return reader.read2d(f"{head_name}{qsuffix}", index[0],
                                          index[1], True)
-                q, s = quantize_linear_np(
-                    reader.read2d(head_name, index[0], index[1], True))
+                if group is not None:
+                    return _head_grouped(index[1])[0]
+                a, b, _ = index[0].indices(h // krows)
+                w = reader.read2d(
+                    head_name, slice(a * krows, b * krows), index[1], True)
+                q, s = np_qfn(w)
                 scale_memo.setdefault(_key(head_name, index[1]), s)
                 return q
 
             def head_scale(index):
+                if group is not None:
+                    if head_prequant:
+                        return reader.read2d(f"{head_name}.scale",
+                                             index[0], index[1], False)
+                    return _head_grouped(index[1])[1]
                 if head_prequant:
                     return reader.read1d(f"{head_name}.scale", index[0])
                 return _scale(head_name, True, index[0])
 
-            params["lm_head"] = QuantizedLinear(
-                q=_assemble((h, config.vocab_size), mesh, P(None, TP),
-                            head_q),
-                scale=_assemble((config.vocab_size,), mesh, P(TP),
-                                head_scale),
+            if group is not None:
+                head_scale_leaf = _assemble(
+                    (h // group, config.vocab_size), mesh, P(None, TP),
+                    head_scale)
+            else:
+                head_scale_leaf = _assemble(
+                    (config.vocab_size,), mesh, P(TP), head_scale)
+            params["lm_head"] = qcls(
+                _assemble((h // krows, config.vocab_size), mesh,
+                          P(None, TP), head_q),
+                head_scale_leaf,
             )
         else:
             params["lm_head"] = _assemble(

@@ -55,54 +55,105 @@ def params_from_hf_tensors(
     ``layer_range=(lo, hi)`` loads only blocks ``lo..hi-1`` (still stacked,
     dense from 0) — the worker/stage path.
 
-    ``quantize="int8"`` quantizes every linear *on the host as it streams in*
-    (per-output-channel symmetric int8, ops.quant) — the bf16 weights never
-    reach the device, so peak HBM is the int8 bytes. Norms and the embedding
-    stay in ``dtype``. ``prequantized=True`` (a checkpoint written by
-    tools/quantize_model: ``<name>.q8`` + ``<name>.scale`` tensors) reads
-    the stored int8 bytes directly — half the IO, zero quantize compute."""
-    if quantize not in (None, "int8"):
-        raise ValueError(f"unsupported quantize={quantize!r}")
-    if prequantized and quantize != "int8":
+    ``quantize="int8"``/``"int4"``/``"int4:gN"`` quantizes every linear *on
+    the host as it streams in* (symmetric per-output-channel, ops.quant;
+    int4 is packed two-per-byte; ``:gN`` selects N-row group-wise scales,
+    int4's accuracy tier) — the bf16 weights never reach the device, so
+    peak HBM is the quantized bytes. Norms and the embedding stay in
+    ``dtype``. ``prequantized=True`` (a checkpoint written by
+    tools/quantize_model: ``<name>.q8``/``.q4`` + ``<name>.scale`` tensors)
+    reads the stored quantized bytes directly — a fraction of the IO, zero
+    quantize compute; a grouped checkpoint's scale shape carries its own
+    grouping, so plain ``"int4"`` loads it."""
+    from cake_tpu.ops.quant import (
+        LAYER_LINEARS,
+        Quantized4Linear,
+        QuantizedLinear,
+        parse_quant_spec,
+        quantize_linear4_np,
+        quantize_linear_np,
+    )
+
+    tier, gsize = parse_quant_spec(quantize)
+    if prequantized and tier is None:
         raise ValueError(
-            "prequantized=True requires quantize='int8'"
+            "prequantized=True requires quantize='int8' or 'int4'"
         )
-    from cake_tpu.ops.quant import LAYER_LINEARS, QuantizedLinear, quantize_linear_np
 
     lo, hi = layer_range or (0, num_layers)
     dt = jnp.dtype(dtype)
 
-    def get_q8(name: str) -> tuple[np.ndarray, np.ndarray]:
-        """(q [in, out] int8, scale [out] f32) for one linear — stored
-        pre-quantized or quantized here on the fly (a tied head reads the
-        un-quantized embedding even in a pre-quantized checkpoint)."""
-        if prequantized:
+    _det: list = []  # lazy one-slot cache for _stored_group()
+
+    def _stored_group() -> int | None:
+        """The group size a pre-quantized int4 checkpoint was written at
+        (None = per-channel), read off a stored scale's shape. Lazy: only
+        probed when a tied head must match the layers' tier or an explicit
+        :gN spec needs validation."""
+        if not _det:
             try:
-                return (np.asarray(get(f"{name}.q8")).T,
+                name = f"model.layers.{lo}.self_attn.q_proj.weight"
+                s = np.asarray(get(f"{name}.scale"))
+                if s.ndim == 2:
+                    in_dim = 2 * np.asarray(get(f"{name}.q4")).shape[1]
+                    _det.append(in_dim // s.shape[0])
+                else:
+                    _det.append(None)
+            except KeyError:
+                _det.append(None)
+        return _det[0]
+
+    if prequantized and tier == "int4" and gsize is not None:
+        stored = _stored_group()
+        if stored != gsize:
+            raise ValueError(
+                f"checkpoint stores "
+                f"{'group_size=' + str(stored) if stored else 'per-channel'}"
+                f" int4, but quantize spec asked for g{gsize}"
+            )
+
+    def get_quant(name: str) -> tuple[np.ndarray, np.ndarray]:
+        """(q [in, out] or qp [in/2, out] int8, scale f32) for one linear —
+        stored pre-quantized or quantized here on the fly (a tied head
+        reads the un-quantized embedding even in a pre-quantized
+        checkpoint, at the checkpoint's OWN group size so both loaders
+        stay bit-equal)."""
+        if prequantized:
+            suffix = ".q8" if tier == "int8" else ".q4"
+            try:
+                # stored in the HF [out, in] orientation (int4: [out, in/2]
+                # packed along in) — transpose to the pytree layout; the
+                # scale is stored in the pytree layout already
+                return (np.asarray(get(f"{name}{suffix}")).T,
                         np.asarray(get(f"{name}.scale")))
             except KeyError:
                 pass
-        return quantize_linear_np(np.asarray(get(name)).T)
+        if tier == "int8":
+            return quantize_linear_np(np.asarray(get(name)).T)
+        g_eff = _stored_group() if prequantized else gsize
+        return quantize_linear4_np(np.asarray(get(name)).T, group_size=g_eff)
+
+    qcls = QuantizedLinear if tier == "int8" else Quantized4Linear
 
     params: dict = {}
     if hi > lo:
         layers = {}
         for ours, (suffix, transpose) in _LAYER_MAP.items():
-            do_quant = quantize == "int8" and ours in LAYER_LINEARS
+            do_quant = tier is not None and ours in LAYER_LINEARS
             per, scales = [], []
             for i in range(lo, hi):
                 name = f"model.layers.{i}.{suffix}"
                 if do_quant:
-                    q, s = get_q8(name)
+                    q, s = get_quant(name)
                     per.append(q)
                     scales.append(s)
                 else:
                     w = np.asarray(get(name))
                     per.append(w.T if transpose else w)
             if do_quant:
-                layers[ours] = QuantizedLinear(
-                    q=jnp.asarray(np.stack(per)),
-                    scale=jnp.asarray(np.stack(scales)),
+                layers[ours] = qcls(
+                    jnp.asarray(np.stack(per)),
+                    jnp.asarray(np.stack(scales)),
                 )
             else:
                 layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
@@ -114,9 +165,9 @@ def params_from_hf_tensors(
         head_name = (
             "model.embed_tokens.weight" if tie_word_embeddings else "lm_head.weight"
         )
-        if quantize == "int8":
-            q, s = get_q8(head_name)
-            params["lm_head"] = QuantizedLinear(q=jnp.asarray(q), scale=jnp.asarray(s))
+        if tier is not None:
+            q, s = get_quant(head_name)
+            params["lm_head"] = qcls(jnp.asarray(q), jnp.asarray(s))
         else:
             params["lm_head"] = jnp.asarray(np.asarray(get(head_name)).T).astype(dt)
     return params
@@ -141,22 +192,30 @@ def load_safetensors_index(model_dir: str | Path) -> dict[str, Path]:
     raise FileNotFoundError(f"no safetensors index or file under {model_dir}")
 
 
-def is_prequantized(name_to_file: dict) -> bool:
-    """Was this checkpoint written by tools/quantize_model (int8 ``.q8`` +
-    ``.scale`` tensors)?"""
-    return any(n.endswith(".q8") for n in name_to_file)
+def is_prequantized(name_to_file: dict) -> str | None:
+    """Which tier tools/quantize_model wrote this checkpoint at: ``"int8"``
+    (``.q8`` tensors), ``"int4"`` (``.q4``), or None (not pre-quantized).
+    Truthy exactly when pre-quantized, so boolean use keeps working."""
+    if any(n.endswith(".q8") for n in name_to_file):
+        return "int8"
+    if any(n.endswith(".q4") for n in name_to_file):
+        return "int4"
+    return None
 
 
 def check_prequantized(name_to_file: dict, quantize: str | None) -> bool:
     """Detect a pre-quantized checkpoint and validate the requested load
     mode against it (shared by the host and direct-to-mesh loaders)."""
+    from cake_tpu.ops.quant import parse_quant_spec
+
     pre = is_prequantized(name_to_file)
-    if pre and quantize != "int8":
+    tier, _ = parse_quant_spec(quantize)
+    if pre and tier != pre:
         raise ValueError(
-            "this checkpoint is pre-quantized (int8 .q8/.scale tensors); "
-            "load it with quantize='int8' (--quantize int8)"
+            f"this checkpoint is pre-quantized ({pre} .q8/.q4/.scale "
+            f"tensors); load it with quantize='{pre}' (--quantize {pre})"
         )
-    return pre
+    return bool(pre)
 
 
 def load_llama_params(

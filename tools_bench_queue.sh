@@ -31,7 +31,7 @@ echo "monitor start $(date -u +%FT%TZ)" >>"$LOG"
 for i in $(seq 1 40); do
   if probe; then
     echo "grant healthy at probe $i $(date -u +%FT%TZ)" >>"$LOG"
-    run_row CAKE_BENCH_ROW=default            # driver-grade record first
+    run_row                                   # default row: driver-grade record first
     run_row CAKE_BENCH_TTFT=1                 # p50/p95 TTFT (metric of record)
     run_row CAKE_BENCH_SPEC=8                 # n-gram speculation
     run_row CAKE_BENCH_CHURN=1                # continuous-batching churn
