@@ -32,10 +32,12 @@ for i in $(seq 1 40); do
   if probe; then
     echo "grant healthy at probe $i $(date -u +%FT%TZ)" >>"$LOG"
     run_row                                   # default row: driver-grade record first
+    run_row CAKE_BENCH_QUANT=int4             # int4 tier: 2x the int8 roofline
     run_row CAKE_BENCH_TTFT=1                 # p50/p95 TTFT (metric of record)
     run_row CAKE_BENCH_SPEC=8                 # n-gram speculation
     run_row CAKE_BENCH_CHURN=1                # continuous-batching churn
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_BATCH=4  # batched serving speculation
+    run_row CAKE_BENCH_QUANT=int4 CAKE_BENCH_BATCH=8  # int4 aggregate serving
     run_row CAKE_BENCH_BATCH=8 CAKE_BENCH_SEQ=4096 CAKE_BENCH_KV=int8  # riskiest last
     echo "=== $(date -u +%FT%TZ) flash_sweep ===" >>"$LOG"
     python -u -m cake_tpu.tools.flash_sweep --json-out KERNELS_TPU_r4.json >>"$LOG" 2>&1
