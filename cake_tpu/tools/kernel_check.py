@@ -168,6 +168,37 @@ def check_kernels(dtype=jnp.bfloat16) -> tuple[list, bool]:
     all_ok &= _report("quant_matmul_4096x4096_int8", device, compiled, err,
                       p_ms, x_ms, 1.0, results)
 
+    # -- quant4_matmul: packed int4, per-channel and grouped -----------------
+    # proves the Mosaic lowering of the int32 nibble-unpack shifts and the
+    # grouped scale index map on real hardware (the CPU suite only ever
+    # interprets), and measures the m=1 gemv regime that decides the decode
+    # dispatch frontier
+    from cake_tpu.ops.pallas import quant4_matmul_pallas
+
+    q4 = quant.quantize_linear4(w)
+    q4m_pal = jax.jit(partial(quant4_matmul_pallas, interpret=not compiled))
+    q4m_xla = jax.jit(quant.quant4_matmul_xla)
+    for label, rows in (("m8", 8), ("m1", 1), ("m16", 16)):
+        xr = jax.random.normal(ks[6], (rows, kk), dtype)
+        got = q4m_pal(xr, q4.qp, q4.scale)
+        want = q4m_xla(xr, q4.qp, q4.scale)
+        err = float(jnp.max(jnp.abs(
+            got.astype(jnp.float32) - want.astype(jnp.float32))))
+        p_ms = _time_ms(q4m_pal, xr, q4.qp, q4.scale)
+        x_ms = _time_ms(q4m_xla, xr, q4.qp, q4.scale)
+        all_ok &= _report(f"quant4_matmul_4096x4096_{label}", device,
+                          compiled, err, p_ms, x_ms, 1.0, results)
+
+    q4g = quant.quantize_linear4(w, group_size=256)  # g2=128: tileable
+    got = q4m_pal(x, q4g.qp, q4g.scale)
+    want = q4m_xla(x, q4g.qp, q4g.scale)
+    err = float(jnp.max(jnp.abs(
+        got.astype(jnp.float32) - want.astype(jnp.float32))))
+    p_ms = _time_ms(q4m_pal, x, q4g.qp, q4g.scale)
+    x_ms = _time_ms(q4m_xla, x, q4g.qp, q4g.scale)
+    all_ok &= _report("quant4_matmul_4096x4096_g256", device, compiled, err,
+                      p_ms, x_ms, 1.0, results)
+
     return results, all_ok
 
 

@@ -39,8 +39,10 @@ for i in $(seq 1 40); do
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_BATCH=4  # batched serving speculation
     run_row CAKE_BENCH_QUANT=int4 CAKE_BENCH_BATCH=8  # int4 aggregate serving
     run_row CAKE_BENCH_BATCH=8 CAKE_BENCH_SEQ=4096 CAKE_BENCH_KV=int8  # riskiest last
+    echo "=== $(date -u +%FT%TZ) kernel_check ===" >>"$LOG"
+    python -u -m cake_tpu.tools.kernel_check --json-out KERNELS_TPU_r4.json >>"$LOG" 2>&1
     echo "=== $(date -u +%FT%TZ) flash_sweep ===" >>"$LOG"
-    python -u -m cake_tpu.tools.flash_sweep --json-out KERNELS_TPU_r4.json >>"$LOG" 2>&1
+    python -u -m cake_tpu.tools.flash_sweep --json-out FLASH_SWEEP_r4.json >>"$LOG" 2>&1
     echo "queue done $(date -u +%FT%TZ)" >>"$LOG"
     exit 0
   fi
