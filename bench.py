@@ -477,6 +477,7 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     next_sid = batch
     t0 = time.perf_counter()
     e0 = gen.stats()["tokens_emitted"]
+    b0 = gen.stats()["busy_s"]  # exclude warm-up/compile busy time
     admitted = 0
     max_steps = steps * 4
     for _ in range(max_steps):
@@ -512,7 +513,8 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
         f"device={dev.device_kind} batch={batch} stream_len={stream_len} "
         f"admitted={admitted} dispatches={st['decode_dispatches']}d+"
         f"{st['admit_dispatches']}a tokens/dispatch="
-        f"{st['tokens_per_dispatch']}\n"
+        f"{st['tokens_per_dispatch']} busy_s={st['busy_s'] - b0:.3f} "
+        f"timed_s={dt:.3f}\n"
     )
     return 0
 
@@ -587,7 +589,7 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     warm = 8
     for i in range(1, warm):
         gen.next_token(i)
-    d0, e0 = gen.dispatches, gen.emitted
+    d0, e0, r0 = gen.dispatches, gen.emitted, gen.rounds
     t0 = time.perf_counter()
     n = 0
     while gen.emitted - e0 < steps and gen._pos < config.max_seq_len - k - 1:
@@ -598,6 +600,7 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     timed = gen.emitted - e0
     tok_s = timed / dt
     accept = timed / max(1, gen.dispatches - d0)
+    per_round = timed / max(1, gen.rounds - r0)
     model_gb = _param_bytes(params) / 1e9
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
@@ -607,8 +610,11 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
         "unit": "tokens/s",
         "vs_baseline": round(tok_s / roofline, 4),
     }, dev)
+    rounds_per_dispatch = (gen.rounds - r0) / max(1, gen.dispatches - d0)
     sys.stderr.write(
         f"device={dev.device_kind} params={model_gb:.2f}GB spec_k={k} "
+        f"rounds/dispatch={rounds_per_dispatch:.2f} "
+        f"tokens/round={per_round:.2f} "
         f"tokens/dispatch={accept:.2f} timed_tokens={timed} "
         f"(self-repeating stream: favorable-regime acceptance)\n"
     )

@@ -201,9 +201,9 @@ def _prefill_q8_kernel(
     pos_ref,  # scalar prefetch: [1] int32
     q_ref,  # [1, 1, BQ, D]
     kq_ref,  # [1, 1, BK, D] int8
-    ks_ref,  # [1, 1, BK] f32 (per-token-per-head scales)
+    ks_ref,  # [1, KVH, BK] f32 (per-token-per-head scales, full head axis)
     vq_ref,  # [1, 1, BK, D] int8
-    vs_ref,  # [1, 1, BK] f32
+    vs_ref,  # [1, KVH, BK] f32
     o_ref,  # [1, 1, BQ, D]
     acc_ref,  # VMEM [BQ, D] f32
     m_ref,  # VMEM [BQ, LANES] f32
@@ -213,15 +213,22 @@ def _prefill_q8_kernel(
     block_k: int,
     scale: float,
     num_kv_blocks: int,
+    group: int,
 ):
     """Same online softmax as :func:`_prefill_kernel`, reading int8 KV. The
     per-token dequant scale is constant along D, so it factors OUT of both
     matmuls: ``q . (s_j * kq_j) = s_j * (q . kq_j)`` folds into the score
     column, and ``p @ diag(vs) @ vq = (p * vs) @ vq`` folds into the
     probabilities — the kernel never materializes dequantized KV, and HBM
-    reads stay at the int8 bytes + one f32 scale per token."""
+    reads stay at the int8 bytes + one f32 scale per token.
+
+    The scale blocks carry the FULL kv-head axis: a (1, 1, BK) block would
+    put a size-1 block over that axis, which Mosaic's sublane rule rejects
+    on real TPUs whenever KVH > 1 (caught on v5e, r4). The kernel selects
+    its head's row dynamically — the stripe is a few KB."""
     qb = pl.program_id(2)
     kb = pl.program_id(3)
+    hk = pl.program_id(1) // group  # this grid cell's kv head
     pos = pos_ref[0]
 
     @pl.when(kb == 0)
@@ -239,7 +246,8 @@ def _prefill_q8_kernel(
         s = jax.lax.dot_general(
             q, kq, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        s = s * scale * ks_ref[0, 0][None, :]  # fold key scales per column
+        ks_row = jax.lax.dynamic_slice_in_dim(ks_ref[0], hk, 1, 0)  # [1, BK]
+        s = s * scale * ks_row  # fold key scales per column
 
         qpos = (
             pos
@@ -259,8 +267,9 @@ def _prefill_q8_kernel(
         l_ref[:] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         m_ref[:] = m_new
         vq = vq_ref[0, 0].astype(q.dtype)
+        vs_row = jax.lax.dynamic_slice_in_dim(vs_ref[0], hk, 1, 0)  # [1, BK]
         pv = jax.lax.dot_general(
-            (p * vs_ref[0, 0][None, :]).astype(q.dtype), vq,
+            (p * vs_row).astype(q.dtype), vq,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -311,8 +320,10 @@ def flash_attention_q8(
         return (bi, hi // group, jnp.minimum(kb, max_kb), 0)
 
     def scale_map(bi, hi, qb, kb, pos_ref):
+        # full kv-head axis per block (see the kernel docstring); only
+        # batch and the (clamped) S block vary
         max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
-        return (bi, hi // group, jnp.minimum(kb, max_kb))
+        return (bi, 0, jnp.minimum(kb, max_kb))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -320,9 +331,9 @@ def flash_attention_q8(
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), q_map),
             pl.BlockSpec((1, 1, bk, d), kv_map),
-            pl.BlockSpec((1, 1, bk), scale_map),
+            pl.BlockSpec((1, kvh, bk), scale_map),
             pl.BlockSpec((1, 1, bk, d), kv_map),
-            pl.BlockSpec((1, 1, bk), scale_map),
+            pl.BlockSpec((1, kvh, bk), scale_map),
         ],
         out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
         scratch_shapes=[
@@ -333,7 +344,7 @@ def flash_attention_q8(
     )
     kernel = functools.partial(
         _prefill_q8_kernel, block_q=bq, block_k=bk, scale=scale,
-        num_kv_blocks=nk,
+        num_kv_blocks=nk, group=group,
     )
     return pl.pallas_call(
         kernel,

@@ -90,13 +90,20 @@ def quant_matmul_pallas(
 
 def _kernel4(
     xlo_ref, xhi_ref, qp_ref, s_ref, o_ref, acc_ref, *,
-    num_k_blocks: int, grouped: bool,
+    num_k_blocks: int, grouped: bool, blocks_per_group: int,
 ):
     """Packed-int4 matmul kernel. ``grouped`` is a Python static: per-channel
     applies the scale once in the epilogue; grouped multiplies each K
     block's f32 partial by its group's scale before accumulating (every K
     block lies inside one group — bk2 divides group_size/2) — same math as
-    the grouped XLA einsum path up to f32 summation order."""
+    the grouped XLA einsum path up to f32 summation order.
+
+    Grouped ``s_ref`` holds the FULL ``[ngroups, BN]`` scale column: a
+    per-K-block scale BlockSpec would need a (1, BN) block over the group
+    axis, which Mosaic rejects whenever ngroups isn't the whole axis (the
+    sublane-divisibility rule — caught on real v5e, r4). The kernel
+    dynamically indexes its group's row instead; scales are tiny, so
+    re-fetching the column per N block costs nothing."""
     kb = pl.program_id(2)
 
     @pl.when(kb == 0)
@@ -117,7 +124,11 @@ def _kernel4(
     ) + jax.lax.dot_general(
         x_hi, w_hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    acc_ref[:] += partial * s_ref[:] if grouped else partial
+    if grouped:
+        s_row = s_ref[pl.ds(kb // blocks_per_group, 1), :]  # [1, BN]
+        acc_ref[:] += partial * s_row
+    else:
+        acc_ref[:] += partial
 
     @pl.when(kb == num_k_blocks - 1)
     def _finish():
@@ -125,6 +136,11 @@ def _kernel4(
             o_ref[:] = acc_ref[:].astype(o_ref.dtype)
         else:
             o_ref[:] = (acc_ref[:] * s_ref[:]).astype(o_ref.dtype)
+
+
+def _sublane(dtype) -> int:
+    """Minimum second-to-last tile dim for ``dtype`` on TPU."""
+    return {2: 16, 4: 8}.get(jnp.dtype(dtype).itemsize, 32)
 
 
 def quant4_matmul_pallas(
@@ -144,12 +160,31 @@ def quant4_matmul_pallas(
     each, activation-sized), so the K-axis grid walks packed weight rows
     directly and the weight side never strides or interleaves. A grouped
     ``scale [ngroups, N]`` caps the K block at half a group and applies
-    each group's scale to its own f32 partial."""
+    each group's scale to its own f32 partial.
+
+    Decode (skinny M): M below the dtype sublane is zero-padded up to it —
+    a sub-sublane block would make Mosaic mask every weight tile, and the
+    padded rows cost only activation-sized traffic. The weight stream (the
+    bandwidth bound) is unchanged, so the kernel's win over the XLA
+    fallback (which re-materializes bf16 weights every step, 4x the bytes)
+    holds at M=1; blocks are widened in the skinny regime to amortize
+    per-grid-step overhead over the ~0.5 byte/weight stream."""
     m, k = x.shape
     k2, n = qp.shape
     if k != 2 * k2:
         raise ValueError(f"x in-dim {k} != 2 * packed rows {k2}")
     grouped = scale.ndim == 2
+    pad_m = 0
+    sub = _sublane(x.dtype)
+    if m < sub:
+        pad_m = sub - m
+        x = jnp.pad(x, ((0, pad_m), (0, 0)))
+        m = sub
+    if m <= 32:
+        # skinny regime: fewer, larger grid steps (weights dominate VMEM
+        # and HBM; the activation block is tiny either way)
+        block_n = max(block_n, 1024)
+        block_k = max(block_k, 1024)
     bm = _pick_block(m, block_m)
     bn = _pick_block(n, block_n)
     if grouped:
@@ -169,15 +204,23 @@ def quant4_matmul_pallas(
         else scale.reshape(1, n).astype(jnp.float32)
     )
     out = pl.pallas_call(
-        functools.partial(_kernel4, num_k_blocks=k2 // bk2, grouped=grouped),
+        functools.partial(
+            _kernel4,
+            num_k_blocks=k2 // bk2,
+            grouped=grouped,
+            blocks_per_group=g2 // bk2,
+        ),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         grid=(m // bm, n // bn, k2 // bk2),
         in_specs=[
             pl.BlockSpec((bm, bk2), lambda i, j, kb: (i, kb)),
             pl.BlockSpec((bm, bk2), lambda i, j, kb: (i, kb)),
             pl.BlockSpec((bk2, bn), lambda i, j, kb: (kb, j)),
+            # grouped: the whole group axis rides in the block (a (1, bn)
+            # block over it fails Mosaic's sublane rule on real TPUs); the
+            # kernel picks its row. Per-channel: scale is [1, n].
             pl.BlockSpec(
-                (1, bn), lambda i, j, kb: (kb * bk2 // g2, j)
+                (s_in.shape[0], bn), lambda i, j, kb: (0, j)
             ),
         ],
         out_specs=pl.BlockSpec((bm, bn), lambda i, j, kb: (i, j)),
@@ -199,4 +242,4 @@ def quant4_matmul_pallas(
         qp,
         s_in,
     )
-    return out
+    return out[: m - pad_m] if pad_m else out

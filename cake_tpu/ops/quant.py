@@ -449,11 +449,17 @@ def quant4_matmul(
                 else "xla"
             )
         else:
-            m = x.size // x.shape[-1]
+            # Unlike int8 (where XLA's gemv fuses the convert and wins below
+            # m=16), the int4 XLA fallback cannot fuse the shift-unpack into
+            # the dot: it re-materializes bf16 weights every step — 4x the
+            # packed bytes (measured 47.8 tok/s at M=1 on the 8B v5e
+            # single-stream bench, i.e. the bf16 rate). The kernel (with
+            # sublane M-padding) streams the packed bytes, so tileability
+            # is the only gate.
             impl = (
                 "pallas"
                 if pk.kernels_enabled()
-                and (pk.interpret_default() or (m >= 16 and tileable))
+                and (pk.interpret_default() or tileable)
                 else "xla"
             )
     if impl == "pallas":

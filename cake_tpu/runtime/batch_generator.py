@@ -171,14 +171,15 @@ class BatchGenerator:
             )
         self._quant_pin: str | None = quant_backend
 
-        def _has_quant(p) -> bool:
+        def _has_quant(p, kinds) -> bool:
             if isinstance(p, dict):
-                return any(_has_quant(v) for v in p.values())
-            return isinstance(
-                p, (quant.QuantizedLinear, quant.Quantized4Linear)
-            )
+                return any(_has_quant(v, kinds) for v in p.values())
+            return isinstance(p, kinds)
 
-        self._params_quantized = _has_quant(self.params)
+        self._params_quantized = _has_quant(
+            self.params, (quant.QuantizedLinear, quant.Quantized4Linear)
+        )
+        self._params_int4 = _has_quant(self.params, quant.Quantized4Linear)
         self._prefill = self._pinned(build_sharded_prefill(
             config, plan, params_like=self.params, kv_quant=kv_quant))
         self._decode_single = self._pinned(build_sharded_decode(
@@ -493,12 +494,16 @@ class BatchGenerator:
         dp = self.plan.dp
         batch = -(-n_active // dp) * dp
         if self._quant_pin is None:
-            # instance-lifetime int8 backend choice from the dp-local
-            # decode geometry (the measured m>=16 crossover, BASELINE.md
-            # r2); decided before any program traces so every bucket and
-            # admission path sees the same backend
+            # instance-lifetime backend choice, decided before any program
+            # traces so every bucket and admission path sees the same
+            # backend. int8: the measured m>=16 crossover (BASELINE.md r2).
+            # int4: the kernel wins at every geometry (the XLA fallback
+            # streams 4x the packed bytes — ops/quant.py), so pin pallas
+            # unconditionally.
             self._quant_pin = (
-                "pallas" if batch // dp >= 16 else "xla"
+                "pallas"
+                if self._params_int4 or batch // dp >= 16
+                else "xla"
             )
         self.streams = [
             _Stream(

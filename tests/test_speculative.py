@@ -333,3 +333,115 @@ def test_rejection_accept_preserves_distribution_top_p():
     assert np.abs(freq - p0).sum() < 0.08
     # nucleus-masked tokens never appear
     assert freq[p0 == 0].sum() == 0.0
+
+
+# -- fused multi-round speculation (device-side propose) ----------------------
+
+def test_device_propose_matches_host():
+    """ngram_propose_device must reproduce the host proposer's -1-padded
+    array bit-for-bit (longest-n-first, most-recent hit, end clamp)."""
+    import jax.numpy as jnp
+
+    from cake_tpu.runtime.speculative import ngram_propose_device
+
+    rng = np.random.default_rng(7)
+    for _ in range(40):
+        L = int(rng.integers(2, 40))
+        ctx_list = rng.integers(0, 6, size=L).tolist()  # small vocab: hits
+        k, n_max = 5, 3
+        want = np.full((k,), -1, np.int64)
+        prop = ngram_propose(ctx_list, n_max, k)
+        want[: len(prop)] = prop
+        buf = np.zeros((64,), np.int32)
+        buf[:L] = ctx_list
+        got = np.asarray(
+            ngram_propose_device(jnp.asarray(buf), jnp.int32(L),
+                                 n_max=n_max, k=k)
+        )
+        assert got.tolist() == want.tolist(), (ctx_list, got, want)
+
+
+def test_fused_matches_host_loop_and_syncs_less(params):
+    """spec_rounds=8 (fused) must emit the same greedy stream as
+    spec_rounds=1 (per-round host loop) with ~rounds/dispatch fewer
+    dispatches."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+    want, host = _spec(params, prompt, 40, settings, spec_k=6,
+                       spec_rounds=1)
+    got, fused = _spec(params, prompt, 40, settings, spec_k=6,
+                       spec_rounds=8)
+    assert got == want
+    assert fused._spec_block is not None and host._spec_block is None
+    # one device sync per 8 rounds: far fewer dispatches for the same
+    # emission count
+    assert fused.dispatches < host.dispatches
+    assert fused.emitted >= host.emitted  # fused block may overshoot n
+
+
+def test_fused_sampled_stream_invariant_to_rounds_per_dispatch(params):
+    """temperature>0: the fused key schedule depends only on the stream
+    position (fold_in(fold_in(key, 0x5bec), pos)), never on how rounds are
+    grouped into dispatches — so any spec_rounds>1 settings yield the SAME
+    sampled stream bit-for-bit. (Host-loop parity can't be bitwise in
+    sampled mode: its no-proposal rounds fall back to the single-step
+    program whose keys live in the fold_in(key, index) domain;
+    test_sampled_spec_stream_distribution covers that equivalence at the
+    distribution level.)"""
+    settings = SamplerSettings(temperature=0.7, repeat_penalty=1.0,
+                               seed=11)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9, 2, 5, 9, 2]
+    want, _ = _spec(params, prompt, 24, settings, spec_k=4, spec_rounds=2)
+    got, _ = _spec(params, prompt, 24, settings, spec_k=4, spec_rounds=8)
+    assert got == want
+
+
+def test_fused_eos_freezes_trailing_rounds(params):
+    """EOS inside a fused block: rounds after the EOS round emit nothing
+    and the stream's tokens match the host loop's exactly."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    ref = _plain(params, [5, 9, 2, 5, 9, 2, 5, 9], 24, settings)
+    eos_cfg = tiny(max_seq_len=128, eos_token_id=ref[5])
+    g = SpeculativeGenerator(eos_cfg, params, settings=settings, spec_k=6,
+                             spec_rounds=8)
+    g.set_prompt([5, 9, 2, 5, 9, 2, 5, 9])
+    out = []
+    for i in range(24):
+        t = g.next_token(i)
+        out.append(t.id)
+        if t.is_end_of_stream:
+            break
+    assert out == ref[:6]
+
+
+def test_fused_device_ctx_tracks_true_context(params):
+    """After fused dispatches the device ctx buffer must hold EXACTLY
+    prompt + every device-emitted token (ctx[pos] = last): a shifted or
+    clobbered buffer silently degrades proposals (r4 review repro)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    g = SpeculativeGenerator(CFG, params, settings=settings, spec_k=6,
+                             spec_rounds=4)
+    g.set_prompt([5, 9, 2, 5, 9, 2, 5, 9])
+    for i in range(20):
+        g.next_token(i)
+    assert g._ctx is not None and g._ctx_synced_pos == g._pos
+    true_ctx = g._prompt_tokens + g._generated + g._block_buf
+    got = np.asarray(g._ctx)[: g._pos + 1].tolist()
+    assert got == true_ctx
+
+
+def test_fused_ctx_invalidated_on_new_prompt(params):
+    """set_prompt must drop the device ctx: a second stream whose prefill
+    position collides with the first stream's synced position must not
+    propose from the first stream's tokens."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    g = SpeculativeGenerator(CFG, params, settings=settings, spec_k=6,
+                             spec_rounds=4)
+    g.set_prompt([5, 9, 2, 5, 9, 2, 5, 9])
+    for i in range(12):
+        g.next_token(i)
+    assert g._ctx is not None
+    g.set_prompt([7, 1, 3, 7, 1, 3, 7, 1])
+    assert g._ctx is None and g._ctx_synced_pos == -1
+    out = [g.next_token(i).id for i in range(12)]
+    assert out == _plain(params, [7, 1, 3, 7, 1, 3, 7, 1], 12, settings)
