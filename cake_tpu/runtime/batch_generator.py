@@ -124,6 +124,7 @@ class BatchGenerator:
         quant_backend: str | None = None,
         spec_k: int = 0,
         spec_ngram: int = 3,
+        spec_rounds: int = 8,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -275,6 +276,19 @@ class BatchGenerator:
         self._spec_ngram = int(spec_ngram)
         self._spec_bank: list[list[int]] = []
         self._n_spec_dispatches = 0
+        self._n_spec_chains = 0
+        # Fused round chaining (spec_rounds > 1): per-round device programs
+        # — device n-gram propose, the (mesh) verify, accept+state-update —
+        # are dispatched back-to-back with NO host fetch between rounds;
+        # banks are fetched once per chain. On a tunneled chip the
+        # per-round host sync RTT (~200 ms measured r4) dominates the
+        # verify forward itself, so chaining is the serving twin of the
+        # single-stream fused scan (runtime/speculative.spec_rounds_fn).
+        self._spec_rounds = max(1, int(spec_rounds))
+        self._spec_ctx = None  # [B, max_seq] int32 device context rows
+        self._spec_ctx_pos: np.ndarray | None = None  # host pos at sync
+        self.__spec_propose = None
+        self.__spec_update = None
         self.__verify_rows = None
         self.__verify_rows_il = None
         self.__accept_rows = None
@@ -614,6 +628,8 @@ class BatchGenerator:
         self._emitted_first = False
         self._block_buf: list[np.ndarray] = []
         self._spec_bank = [[] for _ in self.streams]
+        self._spec_ctx = None  # fresh prompts: device ctx rows are stale
+        self._spec_ctx_pos = None
         # emission rows already recorded (admit() flushing the block buffer)
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
@@ -812,6 +828,10 @@ class BatchGenerator:
         self.streams[slot] = s
         if self._spec_k:
             self._spec_bank[slot] = []  # the slot's old stream is gone
+            # the device ctx row still holds the OLD stream's tokens; a
+            # pos-coincidence could otherwise pass the staleness check
+            self._spec_ctx = None
+            self._spec_ctx_pos = None
         s.generated.append(tok_id)
         window_full = len(ids) + 1 >= self.max_seq
         s.done = (tok_id in self._eos_ids) or window_full
@@ -927,6 +947,17 @@ class BatchGenerator:
                 if s.active and not s.done]
         if not live:
             return None
+        if (self._spec_rounds > 1
+                and all(int(self._pos[i])
+                        + self._spec_rounds * (self._spec_k + 1)
+                        < self.max_seq for i in live)):
+            # fused chain: R rounds, one sync. A proposal-less greedy round
+            # inside the chain costs one weight sweep for one token — the
+            # same per-token HBM cost as the plain path — so the chain
+            # skips the host-side "all proposals empty" probe (which would
+            # itself force the per-round sync the chain exists to avoid).
+            self._spec_chain(live)
+            return self._emit_spec_bank()
         if any(int(self._pos[i]) + self._spec_k + 1 > self.max_seq
                for i in live):
             return None
@@ -988,6 +1019,145 @@ class BatchGenerator:
         self._last_tokens = jnp.asarray(
             np.where(live_mask, last, fed[:, 0]), jnp.int32,
         )
+
+    @property
+    def _spec_propose(self):
+        """Jitted batched device proposer: per-row prompt-lookup over the
+        device ctx rows + fed assembly — the host proposer never runs
+        inside a fused chain."""
+        if self.__spec_propose is None:
+            from functools import partial
+
+            from cake_tpu.runtime.speculative import ngram_propose_device
+
+            def propose(ctx, pos, last, *, n_max, k):
+                props = jax.vmap(
+                    lambda c, p: ngram_propose_device(
+                        c, p + 1, n_max=n_max, k=k)
+                )(ctx, pos)
+                fed = jnp.concatenate(
+                    [last[:, None], jnp.maximum(props, 0)], axis=1)
+                return props, fed
+
+            self.__spec_propose = jax.jit(partial(
+                propose, n_max=self._spec_ngram, k=self._spec_k))
+        return self.__spec_propose
+
+    @property
+    def _spec_update(self):
+        """Jitted accept + state update for one fused round: batched accept
+        scan, per-row freeze (``done``), ctx append, pos/last advance. The
+        same key schedule as :meth:`_spec_round` (fold domain 0x5bec keyed
+        by the row's position), so sampled streams are bit-identical to the
+        per-round host loop."""
+        if self.__spec_update is None:
+            from functools import partial
+
+            from cake_tpu.runtime.speculative import (
+                accept_fn_rows,
+                accept_sampled_fn_rows,
+            )
+
+            eos = jnp.asarray(sorted(self._eos_ids) or [-1], jnp.int32)
+            greedy = self.settings.greedy
+            settings = self.settings
+
+            def update(logits, props, ctx, pos, history, hist_slot, done,
+                       last, keys):
+                if greedy:
+                    toks, count, h2, s2 = accept_fn_rows(
+                        logits, props, history, hist_slot, eos, settings)
+                else:
+                    rkeys = jax.vmap(lambda kk, p: jax.random.fold_in(
+                        jax.random.fold_in(kk, 0x5BEC), p))(keys, pos)
+                    toks, count, h2, s2 = accept_sampled_fn_rows(
+                        logits, props, history, hist_slot, eos, rkeys,
+                        settings)
+                n = jnp.where(done, 0, count)
+                history = jnp.where(done[:, None], history, h2)
+                hist_slot = jnp.where(done, hist_slot, s2)
+                # append each row's run at pos+1 (ctx[i, pos_i] holds the
+                # token that fed this round). Frozen rows write junk past
+                # their frontier — masked by pos everywhere; a frozen row
+                # parked near the window end may clamp-write inside its own
+                # dead row, which is never proposed from again.
+                ctx = jax.vmap(
+                    lambda c, t, p: jax.lax.dynamic_update_slice(
+                        c, t, (p + 1,))
+                )(ctx, toks, pos)
+                t_idx = jnp.arange(toks.shape[1], dtype=jnp.int32)
+                eos_hit = (
+                    (toks[:, :, None] == eos[None, None, :]).any(-1)
+                    & (t_idx[None, :] < n[:, None])
+                ).any(axis=1)
+                new_last = jnp.take_along_axis(
+                    toks, jnp.maximum(n - 1, 0)[:, None], axis=1)[:, 0]
+                last = jnp.where(done, last, new_last)
+                pos = pos + n
+                done = done | eos_hit
+                return toks, n, ctx, pos, history, hist_slot, done, last
+
+            self.__spec_update = self._pinned(jax.jit(update))
+        return self.__spec_update
+
+    def _spec_chain(self, live: list[int]) -> None:
+        """Run ``spec_rounds`` propose→verify→accept rounds with a single
+        host↔device sync at the end (async dispatch pipelines the chained
+        programs). The caller guarantees every live row has
+        ``pos + spec_rounds*(K+1) < max_seq`` headroom."""
+        b = len(self.streams)
+        if (self._spec_ctx is None or self._spec_ctx_pos is None
+                or not np.array_equal(self._spec_ctx_pos,
+                                      np.asarray(self._pos))):
+            buf = np.zeros((b, self.max_seq), np.int32)
+            for i, s in enumerate(self.streams):
+                ctx_i = (s.prompt + s.generated + self._spec_bank[i]
+                         if s.active else [0])
+                buf[i, : len(ctx_i)] = ctx_i
+            self._spec_ctx = jnp.asarray(buf)
+        t0 = time.perf_counter()
+        ctx = self._spec_ctx
+        pos = jnp.asarray(np.asarray(self._pos, np.int32))
+        done = jnp.asarray(np.asarray(
+            [not (s.active and not s.done) for s in self.streams]))
+        last = self._last_tokens
+        verify = self._pick_verify()
+        toks_rounds, n_rounds = [], []
+        for _ in range(self._spec_rounds):
+            props, fed = self._spec_propose(ctx, pos, last)
+            logits, self.cache = verify(self.params, fed, self.cache, pos)
+            (toks, n, ctx, pos, self._history, self._hist_slot, done,
+             last) = self._spec_update(
+                logits, props, ctx, pos, self._history, self._hist_slot,
+                done, last, self._keys)
+            toks_rounds.append(toks)
+            n_rounds.append(n)
+        # one combined fetch — two sequential _host calls would pay a
+        # second tunnel round trip, the very latency the chain amortizes
+        # (cross-process dp still takes the allgather path per array)
+        try:
+            toks_all, n_all = jax.device_get(
+                (jnp.stack(toks_rounds), jnp.stack(n_rounds))
+            )  # [R, B, K+1], [R, B]
+        except RuntimeError:
+            toks_all = self._host(jnp.stack(toks_rounds))
+            n_all = self._host(jnp.stack(n_rounds))
+        self._n_decode_dispatches += self._spec_rounds
+        self._n_spec_dispatches += self._spec_rounds
+        self._n_spec_chains += 1
+        self._busy_s += time.perf_counter() - t0
+        for i in live:
+            self._spec_bank[i] = [
+                int(t)
+                for r in range(n_all.shape[0])
+                for t in toks_all[r, i, : n_all[r, i]]
+            ]
+        adv = n_all.sum(axis=0)
+        self._pos = np.asarray(self._pos) + adv
+        self._index = np.asarray(self._index) + adv
+        self._last_tokens = last
+        self._spec_ctx = ctx
+        self._spec_ctx_pos = np.asarray(self._pos).copy()
 
     def _emit_spec_bank(self) -> list:
         row = np.zeros((len(self.streams),), np.int64)
@@ -1116,6 +1286,7 @@ class BatchGenerator:
             "prefix_hits": self._prefix_hits,
             "prefix_entries": len(self._prefix_store),
             "spec_dispatches": self._n_spec_dispatches,
+            "spec_chains": self._n_spec_chains,
             "tokens_per_dispatch": (
                 round(self._n_emitted / dispatches, 2) if dispatches else None
             ),

@@ -412,8 +412,9 @@ class SpeculativeMixin:
         self.cache = cache
         self._ctx = ctx
         self._history, self._hist_slot = history, hist_slot
-        counts_np = np.asarray(counts)
-        toks_np = np.asarray(tokens)
+        # one combined fetch: two np.asarray calls would pay a second
+        # tunnel round trip per dispatch
+        counts_np, toks_np = jax.device_get((counts, tokens))
         emitted: list[int] = []
         for r in range(counts_np.shape[0]):
             emitted.extend(toks_np[r, : int(counts_np[r])].tolist())
