@@ -120,6 +120,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
     p.add_argument("--sp", type=int, default=1,
                    help="sequence-parallel width (ring attention prefill)")
+    p.add_argument("--ep", type=int, default=1,
+                   help="expert-parallel width (MoE families: the expert "
+                        "stacks shard over this mesh axis)")
     p.add_argument("--prefill-chunks", type=int, default=1,
                    dest="prefill_chunks",
                    help="pipeline the prompt pass through the stages in M "
@@ -154,7 +157,14 @@ def _load_config(args):
     overrides = {"dtype": _DTYPES[args.dtype]}
     if args.max_seq:
         overrides["max_seq_len"] = args.max_seq
-    return LlamaConfig.from_hf_json(cfg_path, **overrides)
+    config = LlamaConfig.from_hf_json(cfg_path, **overrides)
+    if config.sliding_window and getattr(args, "sp", 1) > 1:
+        sys.exit("error: sliding-window attention (this checkpoint's "
+                 "family) does not compose with --sp; run with --sp 1")
+    if getattr(args, "ep", 1) > 1 and not config.num_local_experts:
+        sys.exit("error: --ep requires an MoE checkpoint "
+                 "(num_local_experts > 0 in config.json)")
+    return config
 
 
 def _load_tokenizer(model_dir: str):
@@ -267,7 +277,7 @@ def run_serve(args) -> int:
 
     try:
         plan = MeshPlan.build(config, num_stages=args.stages, tp=args.tp,
-                              dp=args.dp, sp=args.sp)
+                              dp=args.dp, sp=args.sp, ep=args.ep)
     except ValueError as e:
         sys.exit(f"error: {e}")
     # direct-to-mesh load: each shard's bytes only, no full-model host copy
@@ -341,7 +351,8 @@ def run_master(args) -> int:
                 "one or the other"
             )
         topo_mesh = bool(with_dev)
-    use_mesh = args.stages > 1 or args.tp > 1 or args.sp > 1 or topo_mesh
+    use_mesh = (args.stages > 1 or args.tp > 1 or args.sp > 1
+                or args.ep > 1 or topo_mesh)
     if args.speculate and (args.sp > 1 or args.topology):
         sys.exit("error: --speculate runs the local or mesh (stages/tp) "
                  "paths; it is not supported with --sp or --topology (it "
@@ -387,12 +398,14 @@ def run_master(args) -> int:
         try:
             if topo_mesh:
                 plan = MeshPlan.from_topology(config, topology, tp=args.tp,
-                                              sp=args.sp)
-                log.info("mesh plan from topology: %d stages x tp=%d x sp=%d",
-                         plan.num_stages, plan.tp, plan.sp)
+                                              sp=args.sp, ep=args.ep)
+                log.info("mesh plan from topology: %d stages x tp=%d x sp=%d"
+                         " x ep=%d",
+                         plan.num_stages, plan.tp, plan.sp, plan.ep)
             else:
                 plan = MeshPlan.build(config, num_stages=args.stages,
-                                      tp=args.tp, dp=1, sp=args.sp)
+                                      tp=args.tp, dp=1, sp=args.sp,
+                                      ep=args.ep)
         except ValueError as e:
             sys.exit(f"error: {e}")
         # direct-to-mesh load: each shard's bytes only, no full-model host

@@ -46,6 +46,22 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     max_seq_len: int = DEFAULT_MAX_SEQ_LEN
     dtype: str = "bfloat16"
+    # --- model-family axes (all default to the Llama-3 shape) -------------
+    # HF `model_type`: "llama" | "mistral" | "qwen2" | "mixtral". The same
+    # functional decoder serves every family; the fields below are the only
+    # architectural deltas (the reference serves exactly one family,
+    # llama.rs — families are a capability extension of the Generator seam,
+    # model/mod.rs:21-29).
+    model_type: str = "llama"
+    # q/k/v projection bias (Qwen2; HF Llama's `attention_bias` key maps
+    # here too). o_proj stays bias-free in every supported family.
+    attention_bias: bool = False
+    # Sliding-window attention (Mistral): key positions more than `window`
+    # behind the query are masked out. None = full causal.
+    sliding_window: int | None = None
+    # MoE (Mixtral): 0 = dense MLP; >0 = routed SwiGLU experts per layer.
+    num_local_experts: int = 0
+    num_experts_per_tok: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -78,6 +94,34 @@ class LlamaConfig:
         if td and "dtype" not in overrides:
             kwargs["dtype"] = {"float16": "bfloat16", "bfloat16": "bfloat16",
                                "float32": "float32"}.get(td, "bfloat16")
+        # Family defaults not spelled out in the HF config dict: Qwen2's
+        # q/k/v bias is unconditional in its architecture (the HF config has
+        # no attention_bias key to read).
+        if d.get("model_type") == "qwen2" and "attention_bias" not in d:
+            kwargs["attention_bias"] = True
+        # Qwen2 configs ship a sliding_window VALUE with the feature gated
+        # off (`use_sliding_window: false`); honoring the value alone would
+        # force windowed masking (and forfeit the flash kernels) on a model
+        # that attends fully. When the gate is on, HF additionally windows
+        # only layers >= max_window_layers — full-depth (0) and no-depth
+        # (>= num layers) are uniform and supported; a partial depth would
+        # need per-layer masks the stacked scan doesn't carry, so it is
+        # rejected rather than silently diverging.
+        if "use_sliding_window" in d and d.get("sliding_window") is not None:
+            if not d["use_sliding_window"]:
+                kwargs["sliding_window"] = None
+            else:
+                mwl = d.get("max_window_layers", 0)
+                layers = kwargs.get("num_hidden_layers",
+                                    cls.num_hidden_layers)
+                if mwl >= layers:
+                    kwargs["sliding_window"] = None
+                elif mwl > 0:
+                    raise ValueError(
+                        f"partial-depth sliding window "
+                        f"(max_window_layers={mwl} of {layers}) is not "
+                        "supported; all-or-none windowing only"
+                    )
         kwargs.update(overrides)
         return cls(**kwargs)
 
@@ -92,7 +136,13 @@ class LlamaConfig:
         d.pop("dtype")
         if d["rope_scaling"] is None:
             d.pop("rope_scaling")
-        d["model_type"] = "llama"
+        if d["sliding_window"] is None:
+            d.pop("sliding_window")
+        if not d["num_local_experts"]:
+            d.pop("num_local_experts")
+            d.pop("num_experts_per_tok")
+        if not d["attention_bias"]:
+            d.pop("attention_bias")
         return d
 
 
@@ -133,6 +183,69 @@ def llama3_70b(**overrides) -> LlamaConfig:
     return LlamaConfig(**base)
 
 
+def mistral_7b(**overrides) -> LlamaConfig:
+    """Mistral-7B-v0.1: Llama geometry with a 4096-token sliding window and
+    32000 vocab — exercises the windowed-mask attention path."""
+    base = dict(
+        model_type="mistral",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        rope_theta=10000.0,
+        sliding_window=4096,
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def qwen2_7b(**overrides) -> LlamaConfig:
+    """Qwen2-7B: GQA with q/k/v projection bias, 152k vocab, tied-embedding
+    variants in the smaller sizes — exercises the biased-projection path."""
+    base = dict(
+        model_type="qwen2",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_hidden_layers=28,
+        num_attention_heads=28,
+        num_key_value_heads=4,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+        bos_token_id=151643,
+        eos_token_id=151643,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def mixtral_8x7b(**overrides) -> LlamaConfig:
+    """Mixtral-8x7B: Mistral geometry with 8 routed SwiGLU experts per
+    layer, top-2 — the MoE family (expert-parallel over the mesh's ep
+    axis, ops/moe.py)."""
+    base = dict(
+        model_type="mixtral",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        rope_theta=1000000.0,
+        num_local_experts=8,
+        num_experts_per_tok=2,
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
 def tiny(**overrides) -> LlamaConfig:
     """Tiny random-weight config for tests (SURVEY.md §4 test strategy)."""
     base = dict(
@@ -150,3 +263,11 @@ def tiny(**overrides) -> LlamaConfig:
     )
     base.update(overrides)
     return LlamaConfig(**base)
+
+
+def tiny_moe(**overrides) -> LlamaConfig:
+    """Tiny Mixtral-shaped fixture (4 experts, top-2)."""
+    base = dict(model_type="mixtral", num_local_experts=4,
+                num_experts_per_tok=2)
+    base.update(overrides)
+    return tiny(**base)

@@ -34,12 +34,15 @@ from cake_tpu.ops import quant
 from cake_tpu.ops.attention import self_attention_block
 from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.mlp import swiglu
+from cake_tpu.ops.moe import moe_swiglu
 from cake_tpu.ops.norms import rms_norm
 from cake_tpu.ops.rope import rope_tables
 
 Params = dict[str, Any]
 
-# Stacked per-layer weight names -> shape builders (L = num layers).
+# Stacked per-layer weight names -> shape builders (L = num layers), for the
+# dense-MLP, bias-free Llama base shape. :func:`layer_shapes` extends this
+# per model family (Qwen2 q/k/v bias, Mixtral routed experts).
 _LAYER_SHAPES = {
     "attn_norm": lambda c: (c.hidden_size,),
     "wq": lambda c: (c.hidden_size, c.num_attention_heads * c.head_dim),
@@ -52,25 +55,62 @@ _LAYER_SHAPES = {
     "w_down": lambda c: (c.intermediate_size, c.hidden_size),
 }
 
+_BIAS_SHAPES = {
+    "bq": lambda c: (c.num_attention_heads * c.head_dim,),
+    "bk": lambda c: (c.num_key_value_heads * c.head_dim,),
+    "bv": lambda c: (c.num_key_value_heads * c.head_dim,),
+}
+
+_MOE_SHAPES = {
+    "router": lambda c: (c.hidden_size, c.num_local_experts),
+    "w_gate": lambda c: (c.num_local_experts, c.hidden_size,
+                         c.intermediate_size),
+    "w_up": lambda c: (c.num_local_experts, c.hidden_size,
+                       c.intermediate_size),
+    "w_down": lambda c: (c.num_local_experts, c.intermediate_size,
+                         c.hidden_size),
+}
+
+
+def layer_shapes(config: LlamaConfig) -> dict:
+    """Per-layer weight name -> shape (without the leading ``[L]`` axis) for
+    the given model family: the Llama base, plus q/k/v biases when
+    ``attention_bias`` (Qwen2), with the dense MLP replaced by router +
+    stacked expert weights when ``num_local_experts > 0`` (Mixtral)."""
+    shapes = dict(_LAYER_SHAPES)
+    if config.attention_bias:
+        shapes.update(_BIAS_SHAPES)
+    if config.num_local_experts:
+        shapes.update(_MOE_SHAPES)
+    return shapes
+
 
 def init_params(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
     """Random-init params pytree (test fixtures / benchmarks; real weights
     come from :mod:`cake_tpu.utils.weights`)."""
     dt = dtype or config.jax_dtype
     L = config.num_hidden_layers
-    keys = iter(jax.random.split(key, len(_LAYER_SHAPES) + 3))
+    shapes = layer_shapes(config)
+    keys = iter(jax.random.split(key, len(shapes) + 3))
 
     def dense(k, shape, fan_in):
         return (jax.random.normal(k, shape, jnp.float32) / jnp.sqrt(fan_in)).astype(dt)
 
     layers = {}
-    for name, shape_fn in _LAYER_SHAPES.items():
+    for name, shape_fn in shapes.items():
         shape = shape_fn(config)
         k = next(keys)
         if name.endswith("norm"):
             layers[name] = jnp.ones((L,) + shape, dt)
+        elif name.startswith("b"):
+            # biases: small random so tests exercise a nonzero bias path
+            layers[name] = (0.02 * jax.random.normal(k, (L,) + shape,
+                                                     jnp.float32)).astype(dt)
         else:
-            layers[name] = dense(k, (L,) + shape, shape[0])
+            # fan_in is the next-to-last axis for 3D expert stacks
+            # ([E, in, out]) and the first axis for plain [in, out] linears
+            fan_in = shape[-2] if len(shape) == 3 else shape[0]
+            layers[name] = dense(k, (L,) + shape, fan_in)
     return {
         "embed": dense(next(keys), (config.vocab_size, config.hidden_size),
                        config.hidden_size),
@@ -104,6 +144,12 @@ def init_params_int4(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
 def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
     from functools import partial as _partial
 
+    if config.num_local_experts:
+        raise NotImplementedError(
+            "quantized random-init is not wired for MoE expert stacks; use "
+            "init_params (bf16 experts) for Mixtral-family fixtures"
+        )
+
     from cake_tpu.ops.quant import (
         LAYER_LINEARS,
         Quantized4Linear,
@@ -121,7 +167,8 @@ def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
 
     dt = dtype or config.jax_dtype
     L = config.num_hidden_layers
-    keys = iter(jax.random.split(key, len(_LAYER_SHAPES) + 3))
+    shapes = layer_shapes(config)
+    keys = iter(jax.random.split(key, len(shapes) + 3))
 
     @_partial(jax.jit, static_argnums=(1, 2, 3))
     def qdense(k, shape, fan_in, stacked):
@@ -135,12 +182,15 @@ def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
         return jax.lax.map(one, jax.random.split(k, L))
 
     layers = {}
-    for name, shape_fn in _LAYER_SHAPES.items():
+    for name, shape_fn in shapes.items():
         shape = shape_fn(config)
         k = next(keys)
         if name in LAYER_LINEARS:
             q, scale = qdense(k, shape, shape[0], True)
             layers[name] = cls(q, scale)
+        elif name.startswith("b"):  # q/k/v biases stay full precision
+            layers[name] = (0.02 * jax.random.normal(k, (L,) + shape,
+                                                     jnp.float32)).astype(dt)
         else:  # norms
             layers[name] = jnp.ones((L,) + shape, dt)
 
@@ -178,6 +228,8 @@ def block_forward(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    ep_axis: str | None = None,
+    ep_size: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One pre-norm decoder block (transformer.rs:48-64).
 
@@ -187,6 +239,14 @@ def block_forward(
     ``sp_axis``/``sp_size``: sequence-parallel attention (ring prefill /
     distributed flash decode, see :mod:`cake_tpu.ops.ring`); the MLP needs no
     communication — it is elementwise over the sharded sequence.
+    ``ep_axis``/``ep_size``: expert parallelism for MoE layers
+    (:mod:`cake_tpu.ops.moe`) — the expert stack is sharded over it and the
+    routed combine psums across it.
+
+    Model-family deltas dispatch on the layer pytree itself: q/k/v bias
+    arrays (``bq``/``bk``/``bv``, Qwen2) and a ``router`` + expert-stacked
+    MLP (Mixtral) are used iff present; ``config.sliding_window`` (Mistral)
+    narrows the causal mask.
     """
     h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     attn_out, k_cache, v_cache = self_attention_block(
@@ -199,11 +259,23 @@ def block_forward(
         sp_size=sp_size,
         write_gate=write_gate,
         sp_prefill=sp_prefill,
+        bq=layer.get("bq"),
+        bk=layer.get("bk"),
+        bv=layer.get("bv"),
+        bo=layer.get("bo"),
+        window=config.sliding_window,
     )
     x = x + attn_out
     h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
-    x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"],
-                   tp_axis=tp_axis)
+    if "router" in layer:
+        x = x + moe_swiglu(
+            h, layer["router"], layer["w_gate"], layer["w_up"],
+            layer["w_down"], top_k=config.num_experts_per_tok,
+            ep_axis=ep_axis, ep_size=ep_size, tp_axis=tp_axis,
+        )
+    else:
+        x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"],
+                       tp_axis=tp_axis)
     return x, k_cache, v_cache
 
 
@@ -222,6 +294,8 @@ def forward_layers(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    ep_axis: str | None = None,
+    ep_size: int | None = None,
 ) -> tuple[jax.Array, KVCache]:
     """Run a contiguous run of decoder blocks via ``lax.scan``.
 
@@ -237,7 +311,8 @@ def forward_layers(
                                   num_heads=num_heads, num_kv_heads=num_kv_heads,
                                   tp_axis=tp_axis, sp_axis=sp_axis,
                                   sp_size=sp_size, write_gate=write_gate,
-                                  sp_prefill=sp_prefill)
+                                  sp_prefill=sp_prefill, ep_axis=ep_axis,
+                                  ep_size=ep_size)
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))

@@ -109,16 +109,30 @@ def attend(
     v_all: jax.Array,  # [B, kv_heads, S, D]
     pos,  # scalar: absolute position of q[..., 0, :]
     impl: str = "auto",  # auto | xla | flash
+    window: int | None = None,  # sliding-window width (Mistral); None=full
 ) -> jax.Array:
     """Masked GQA attention over a fixed-size KV buffer. Returns [B,H,T,D].
 
     ``pos`` may be scalar or ``[B]`` (per-row causal frontiers — the
     multi-stream serving path; per-row is supported by the XLA path and the
     flash decode kernel, T>1 per-row routes to XLA).
+
+    ``window``: sliding-window attention — key positions more than
+    ``window`` behind the query are masked out. Served by the XLA path only
+    (the flash kernels don't fold the lower bound into their block sweep);
+    ``auto`` dispatches accordingly and an explicit ``impl="flash"`` raises
+    rather than silently attending over the full history.
     """
     t, d = q.shape[2], q.shape[3]
     s = k_all.shape[2]
     per_row = jnp.asarray(pos).ndim == 1
+    if window is not None:
+        if impl == "flash":
+            raise ValueError(
+                "flash kernels do not implement sliding-window masking; "
+                "use impl='auto'/'xla' with window="
+            )
+        impl = "xla"
     if per_row and t > 1 and impl != "xla":
         impl = "xla"  # per-row prefill: XLA only (not a served path)
     if impl == "auto":
@@ -143,7 +157,7 @@ def attend(
         if t == 1:
             return pk.flash_decode(q, k_all, v_all, pos)
         return pk.flash_attention(q, k_all, v_all, pos)
-    return _attend_xla(q, k_all, v_all, pos)
+    return _attend_xla(q, k_all, v_all, pos, window=window)
 
 
 def _attend_xla(
@@ -151,6 +165,7 @@ def _attend_xla(
     k_all: jax.Array,
     v_all: jax.Array,
     pos,
+    window: int | None = None,
 ) -> jax.Array:
     """Reference-math XLA path (full [T, S] scores, mask by iota compare).
     ``pos`` scalar or ``[B]`` (per-row causal frontier)."""
@@ -170,10 +185,18 @@ def _attend_xla(
     qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
     if pos.ndim == 0:
         mask = (kpos <= qpos + pos)[None, None, None]  # [1,1,1,T,S]
+        if window is not None:
+            # sliding window: keys more than `window` behind the query are
+            # out (key valid iff qpos+pos-window < kpos <= qpos+pos)
+            mask &= (kpos > qpos + pos - window)[None, None, None]
     else:
         mask = (kpos[None] <= qpos[None] + pos[:, None, None])[
             :, None, None
         ]  # [B,1,1,T,S]
+        if window is not None:
+            mask &= (kpos[None] > qpos[None] + pos[:, None, None] - window)[
+                :, None, None
+            ]
     scores = jnp.where(mask, scores, NEG_INF)
 
     probs = jax.nn.softmax(scores, axis=-1)
@@ -202,6 +225,11 @@ def self_attention_block(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    bq: jax.Array | None = None,  # q/k/v projection biases (Qwen2 family)
+    bk: jax.Array | None = None,
+    bv: jax.Array | None = None,
+    bo: jax.Array | None = None,  # o_proj bias (HF llama-arch attention_bias)
+    window: int | None = None,  # sliding-window width (Mistral family)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """One attention sublayer incl. cache update.
 
@@ -236,10 +264,25 @@ def self_attention_block(
     b, t, hidden = x.shape
     d = quant.out_features(wq) // num_heads
 
-    q = quant.dense(x, wq).reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
-    k = quant.dense(x, wk).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
-    v = quant.dense(x, wv).reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+    q = quant.dense(x, wq)
+    k = quant.dense(x, wk)
+    v = quant.dense(x, wv)
+    if bq is not None:
+        q = q + bq
+    if bk is not None:
+        k = k + bk
+    if bv is not None:
+        v = v + bv
+    q = q.reshape(b, t, num_heads, d).transpose(0, 2, 1, 3)
+    k = k.reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
+    v = v.reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
 
+    if window is not None and sp_axis is not None and sp_size > 1:
+        raise NotImplementedError(
+            "sliding-window attention does not compose with sequence "
+            "parallelism (the sp ring assumes a full causal window); run "
+            "Mistral-family models with sp=1"
+        )
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
@@ -320,6 +363,7 @@ def self_attention_block(
             s_len = k_cache.q.shape[2]
             use_q8_flash = (
                 t > 1
+                and window is None
                 and jnp.asarray(pos).ndim == 0
                 and _flash_prefill_choice(t, s_len, d) == "flash"
             )
@@ -331,12 +375,16 @@ def self_attention_block(
             else:
                 out = attend(q, kv.dequant_kv(k_cache, q.dtype),
                              kv.dequant_kv(v_cache, q.dtype), pos,
-                             impl="xla")
+                             impl="xla", window=window)
         else:
-            out = attend(q, k_cache, v_cache, pos)  # [B, H, T, D]
+            out = attend(q, k_cache, v_cache, pos, window=window)  # [B,H,T,D]
 
     out = out.transpose(0, 2, 1, 3).reshape(b, t, num_heads * d)
     out = quant.dense(out, wo)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
+    if bo is not None:
+        # after the tp reduction: the bias belongs to the full (summed)
+        # projection, not to each rank's partial
+        out = out + bo
     return out, k_cache, v_cache

@@ -1,0 +1,141 @@
+"""Mixture-of-Experts SwiGLU with expert parallelism.
+
+The reference has no MoE at all (SURVEY.md §2 "expert parallelism (no MoE)"
+under *Not present*) — this is a capability extension that completes the
+mesh's parallelism alphabet (dp / stage / sp / tp / **ep**) and serves the
+Mixtral model family (HF ``model_type: "mixtral"``: 8 experts, top-2
+routing, softmax over the selected gate logits).
+
+TPU-first design:
+
+- **Static shapes only.** Routing never gathers a data-dependent *number* of
+  tokens. Two fixed-shape strategies, picked at trace time:
+
+  * ``dense`` — every (local) expert runs over every token via batched
+    einsums (``[E, N, F]`` activations) and the per-token combine weights
+    zero out the non-selected experts. FLOPs are E/top_k× the routed
+    minimum, but every op is a large MXU matmul with no dynamic shapes; at
+    prefill the block is compute-bound and XLA keeps the expert axis as a
+    clean batch dimension.
+  * ``gather`` — decode-shaped inputs (tiny N): gather the top-k experts'
+    weight rows with ``jnp.take`` (static output shape ``[N, k, H, F]``)
+    and run only those. At N=1/k=2 this reads 2 experts' bytes instead of
+    E — the decode path is weights-bandwidth-bound, so the gather is the
+    difference between top-k and all-E HBM traffic per token.
+
+- **Expert parallelism** shards the expert axis over the mesh's ``ep`` axis
+  (:mod:`cake_tpu.parallel.mesh`): each rank holds ``E/ep`` experts' weights,
+  computes the dense path restricted to its local experts (tokens are
+  replicated over ep — at inference scale activations are tiny next to
+  expert weights), and the combine is a single ``psum`` over ``ep``. This
+  composes with tensor parallelism: the expert intermediate axis shards over
+  ``tp`` exactly like the dense MLP, and the down-projection partial sums
+  reduce over ``(ep, tp)`` in one fused psum.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Decode/prefill strategy crossover: gather materializes [N*k, H, F] weight
+# rows, so it only pays off while N*k is well under E (single-digit serving
+# batches at decode). Above it the dense path's E-batched einsum wins.
+GATHER_MAX_ROWS = 8
+
+
+def router_topk(
+    x2d: jax.Array,  # [N, H]
+    router_w: jax.Array,  # [H, E] (global expert count)
+    top_k: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k routing (Mixtral convention): softmax over the *selected*
+    logits, in f32. Returns ``(combine [N, E] f32, weights [N, k] f32,
+    idx [N, k] int32)`` where ``combine`` is zero off the top-k."""
+    logits = jnp.einsum(
+        "nh,he->ne", x2d, router_w, preferred_element_type=jnp.float32
+    )
+    vals, idx = jax.lax.top_k(logits, top_k)  # [N, k]
+    w = jax.nn.softmax(vals, axis=-1)
+    onehot = jax.nn.one_hot(idx, logits.shape[-1], dtype=w.dtype)  # [N,k,E]
+    combine = jnp.einsum("nk,nke->ne", w, onehot)
+    return combine, w, idx
+
+
+def _moe_dense(
+    x2d: jax.Array,  # [N, H]
+    combine: jax.Array,  # [N, E_local] f32 combine weights (zeros off top-k)
+    w_gate: jax.Array,  # [E_local, H, F]
+    w_up: jax.Array,
+    w_down: jax.Array,  # [E_local, F, H]
+) -> jax.Array:
+    g = jnp.einsum("nh,ehf->enf", x2d, w_gate)
+    u = jnp.einsum("nh,ehf->enf", x2d, w_up)
+    y = jnp.einsum("enf,efh->enh", jax.nn.silu(g) * u, w_down)
+    return jnp.einsum("ne,enh->nh", combine.astype(y.dtype), y)
+
+
+def _moe_gather(
+    x2d: jax.Array,  # [N, H]
+    w_topk: jax.Array,  # [N, k] f32
+    idx: jax.Array,  # [N, k] int32 (global expert ids)
+    w_gate: jax.Array,  # [E, H, F]
+    w_up: jax.Array,
+    w_down: jax.Array,  # [E, F, H]
+) -> jax.Array:
+    n, k = idx.shape
+    flat = idx.reshape(-1)
+    gg = jnp.take(w_gate, flat, axis=0)  # [N*k, H, F]
+    gu = jnp.take(w_up, flat, axis=0)
+    gd = jnp.take(w_down, flat, axis=0)  # [N*k, F, H]
+    xr = jnp.repeat(x2d, k, axis=0)  # [N*k, H]
+    g = jnp.einsum("nh,nhf->nf", xr, gg)
+    u = jnp.einsum("nh,nhf->nf", xr, gu)
+    y = jnp.einsum("nf,nfh->nh", jax.nn.silu(g) * u, gd)  # [N*k, H]
+    y = y.reshape(n, k, -1)
+    return jnp.einsum("nk,nkh->nh", w_topk.astype(y.dtype), y)
+
+
+def moe_swiglu(
+    x: jax.Array,  # [B, T, H]
+    router_w: jax.Array,  # [H, E_global]
+    w_gate: jax.Array,  # [E_local, H, F]
+    w_up: jax.Array,
+    w_down: jax.Array,  # [E_local, F, H]
+    top_k: int,
+    ep_axis: str | None = None,
+    ep_size: int | None = None,
+    tp_axis: str | None = None,
+) -> jax.Array:
+    """Routed SwiGLU MLP. Returns ``[B, T, H]`` (residual NOT added).
+
+    The router always scores the **global** expert set; under ep the weight
+    arrays hold this rank's contiguous expert slice (global experts
+    ``[ep_idx*E_local, (ep_idx+1)*E_local)``) and the combine is psum'd over
+    ``ep_axis`` (plus ``tp_axis`` for the row-parallel down projection — one
+    fused reduction when both are given). ``ep_size`` defaults to the mesh
+    axis size (callers inside shard_map just pass the axis name; a size-1
+    ep axis degrades to the unsharded strategies).
+    """
+    b, t, h = x.shape
+    x2d = x.reshape(b * t, h)
+    combine, w_topk, idx = router_topk(x2d, router_w, top_k)
+
+    if ep_axis is not None and ep_size is None:
+        ep_size = jax.lax.axis_size(ep_axis)
+    axes: tuple[str, ...] = ()
+    if ep_axis is not None and ep_size > 1:
+        e_local = w_gate.shape[0]
+        lo = jax.lax.axis_index(ep_axis) * e_local
+        combine_local = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
+        out = _moe_dense(x2d, combine_local, w_gate, w_up, w_down)
+        axes += (ep_axis,)
+    elif x2d.shape[0] * top_k <= GATHER_MAX_ROWS:
+        out = _moe_gather(x2d, w_topk, idx, w_gate, w_up, w_down)
+    else:
+        out = _moe_dense(x2d, combine, w_gate, w_up, w_down)
+    if tp_axis is not None:
+        axes += (tp_axis,)
+    if axes:
+        out = jax.lax.psum(out, axes)
+    return out.reshape(b, t, h)

@@ -38,7 +38,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cake_tpu.models.config import LlamaConfig
 
-DP, STAGE, SP, TP = "dp", "stage", "sp", "tp"
+DP, STAGE, SP, EP, TP = "dp", "stage", "sp", "ep", "tp"
 
 
 def make_mesh(
@@ -46,23 +46,29 @@ def make_mesh(
     tp: int = 1,
     dp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     devices=None,
 ) -> Mesh:
-    """Build a ``(dp, stage, sp, tp)`` mesh from the flat device list."""
+    """Build a ``(dp, stage, sp, ep, tp)`` mesh from the flat device list.
+
+    ``ep`` — expert parallelism (MoE families only): the expert axis of the
+    routed-MLP weight stacks shards here and the combine psums over it
+    (:mod:`cake_tpu.ops.moe`). Dense models leave it 1; every non-expert
+    tensor is replicated over ep, so the axis is invisible to them."""
     devices = list(devices if devices is not None else jax.devices())
-    need = num_stages * tp * dp * sp
+    need = num_stages * tp * dp * sp * ep
     if len(devices) < need:
         raise ValueError(
             f"need {need} devices for dp={dp} x stage={num_stages} x sp={sp} "
-            f"x tp={tp}, have {len(devices)}"
+            f"x ep={ep} x tp={tp}, have {len(devices)}"
         )
-    grid = np.array(devices[:need]).reshape(dp, num_stages, sp, tp)
-    return Mesh(grid, (DP, STAGE, SP, TP))
+    grid = np.array(devices[:need]).reshape(dp, num_stages, sp, ep, tp)
+    return Mesh(grid, (DP, STAGE, SP, EP, TP))
 
 
 def validate_shardable(config: LlamaConfig, num_stages: int, tp: int,
-                       sp: int = 1) -> None:
-    """Divisibility requirements for the (stage, sp, tp) sharding."""
+                       sp: int = 1, ep: int = 1) -> None:
+    """Divisibility requirements for the (stage, sp, ep, tp) sharding."""
     if sp > 1 and config.max_seq_len % sp:
         raise ValueError(
             f"max_seq_len {config.max_seq_len} not divisible by sp {sp}"
@@ -72,6 +78,16 @@ def validate_shardable(config: LlamaConfig, num_stages: int, tp: int,
             f"num_hidden_layers {config.num_hidden_layers} not divisible by "
             f"stage count {num_stages}"
         )
+    if ep > 1:
+        if not config.num_local_experts:
+            raise ValueError(
+                "ep > 1 requires an MoE config (num_local_experts > 0)"
+            )
+        if config.num_local_experts % ep:
+            raise ValueError(
+                f"num_local_experts {config.num_local_experts} not "
+                f"divisible by ep {ep}"
+            )
     for name, dim in [
         ("num_attention_heads", config.num_attention_heads),
         ("num_key_value_heads", config.num_key_value_heads),
@@ -86,7 +102,10 @@ def param_specs(params: dict | None = None) -> dict:
     """PartitionSpec pytree matching the params layout (models/llama.py):
     layer axis -> stage; head/intermediate out-features -> tp (column-
     parallel); wo/w_down in-features -> tp (row-parallel); norms and embed
-    replicated; lm_head vocab -> tp.
+    replicated; lm_head vocab -> tp. Family extensions: q/k/v biases shard
+    with their projection's out-features (tp); an MoE layer's expert stacks
+    ``[L, E, in, out]`` shard the expert axis over ep (router replicated —
+    it is tiny and every rank routes every token).
 
     Pass ``params`` to get specs matching its structure where linears may be
     int8-quantized (ops.quant.QuantizedLinear): the q tensor takes the
@@ -110,6 +129,19 @@ def param_specs(params: dict | None = None) -> dict:
     }
     if params is None:
         return base
+    layers = params.get("layers", {})
+    if "bq" in layers:
+        base["layers"]["bq"] = P(STAGE, TP)
+        base["layers"]["bk"] = P(STAGE, TP)
+        base["layers"]["bv"] = P(STAGE, TP)
+    if "bo" in layers:
+        # applied after the tp psum -> replicated over tp
+        base["layers"]["bo"] = P(STAGE, None)
+    if "router" in layers:
+        base["layers"]["router"] = P(STAGE, None, None)
+        base["layers"]["w_gate"] = P(STAGE, EP, None, TP)
+        base["layers"]["w_up"] = P(STAGE, EP, None, TP)
+        base["layers"]["w_down"] = P(STAGE, EP, TP, None)
     from cake_tpu.ops.quant import Quantized4Linear, QuantizedLinear
 
     def refine(p, s):
@@ -226,17 +258,20 @@ class MeshPlan:
     tp: int
     dp: int
     sp: int = 1
+    ep: int = 1
 
     @classmethod
     def build(cls, config: LlamaConfig, num_stages: int = 1, tp: int = 1,
-              dp: int = 1, sp: int = 1, devices=None) -> "MeshPlan":
-        validate_shardable(config, num_stages, tp, sp)
-        return cls(mesh=make_mesh(num_stages, tp, dp, sp, devices),
-                   num_stages=num_stages, tp=tp, dp=dp, sp=sp)
+              dp: int = 1, sp: int = 1, ep: int = 1,
+              devices=None) -> "MeshPlan":
+        validate_shardable(config, num_stages, tp, sp, ep)
+        return cls(mesh=make_mesh(num_stages, tp, dp, sp, ep, devices),
+                   num_stages=num_stages, tp=tp, dp=dp, sp=sp, ep=ep)
 
     @classmethod
     def from_topology(cls, config: LlamaConfig, topology, tp: int = 1,
-                      dp: int = 1, sp: int = 1, devices=None) -> "MeshPlan":
+                      dp: int = 1, sp: int = 1, ep: int = 1,
+                      devices=None) -> "MeshPlan":
         """Derive the stage layout from a topology whose nodes carry mesh
         ``device`` indices.
 
@@ -274,4 +309,4 @@ class MeshPlan:
                         "use the master/worker runtime for uneven ranges"
                     )
         return cls.build(config, num_stages=num_stages, tp=tp, dp=dp, sp=sp,
-                         devices=devices)
+                         ep=ep, devices=devices)

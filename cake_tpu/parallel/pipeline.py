@@ -47,6 +47,7 @@ from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.mesh import (
     DP,
+    EP,
     SP,
     STAGE,
     TP,
@@ -96,7 +97,7 @@ def _pipeline_layers(
         active = step == my_stage
         h, new_cache = llama.forward_layers(
             layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
-            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
             sp_axis=SP, sp_size=sp, write_gate=active, sp_prefill=sp_prefill,
         )
         x = jnp.where(active, h, x)
@@ -165,7 +166,7 @@ def _pipelined_prefill_layers(
         pos = jnp.clip(j, 0, m_chunks - 1) * c
         h, new_cache = llama.forward_layers(
             layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
-            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+            num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
             write_gate=valid,
         )
         x = jnp.where(valid, h, x)
@@ -525,7 +526,7 @@ def build_interleaved_decode(
             )
             h, rows = llama.forward_layers(
                 params["layers"], x, rows, cos, sin, pos_res, config,
-                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
                 write_gate=valid,
             )
             x = jnp.where(valid, h, x)
@@ -790,7 +791,7 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
             )
             h, rows = llama.forward_layers(
                 params["layers"], x, rows, cos, sin, pos_rows, config,
-                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP,
+                num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
                 write_gate=valid,
             )
             x = jnp.where(valid, h, x)

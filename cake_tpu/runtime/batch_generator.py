@@ -113,6 +113,7 @@ class BatchGenerator:
         num_stages: int = 1,
         tp: int = 1,
         dp: int = 1,
+        ep: int = 1,
         devices=None,
         block_size: int = 1,
         kv_quant: str | None = None,
@@ -128,7 +129,7 @@ class BatchGenerator:
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
-                                  dp=dp, sp=1, devices=devices)
+                                  dp=dp, sp=1, ep=ep, devices=devices)
         # sp > 1 (r4): multi-stream serving over a sequence-sharded window —
         # per-row frontiers flow through the sp owner-masked KV write and
         # per-row-masked distributed flash decode. The admission /

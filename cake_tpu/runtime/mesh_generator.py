@@ -49,6 +49,7 @@ class MeshGenerator(GeneratorBase):
         num_stages: int = 1,
         tp: int = 1,
         sp: int = 1,
+        ep: int = 1,
         devices=None,
         block_size: int = 1,
         prefill_chunks: int = 1,
@@ -68,7 +69,7 @@ class MeshGenerator(GeneratorBase):
         super().__init__(config, tokenizer, settings, max_seq)
         if plan is None:
             plan = MeshPlan.build(
-                config, num_stages=num_stages, tp=tp, dp=1, sp=sp,
+                config, num_stages=num_stages, tp=tp, dp=1, sp=sp, ep=ep,
                 devices=devices,
             )
         if plan.dp != 1:

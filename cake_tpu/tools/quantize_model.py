@@ -81,12 +81,22 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
     model_path, output = Path(model_path), Path(output)
     output.mkdir(parents=True, exist_ok=True)
     name_to_file = load_safetensors_index(model_path)
-    from cake_tpu.utils.weights import is_prequantized
+    from cake_tpu.utils.weights import detect_family, is_prequantized
 
     if is_prequantized(name_to_file):
         raise ValueError(
             f"{model_path} is already pre-quantized (.q8/.scale tensors); "
             "re-quantizing it would only copy bytes"
+        )
+    if detect_family(name_to_file)[0]:
+        # Quantizing only the attention linears while the expert stacks
+        # (the bulk of an MoE checkpoint) pass through raw would burn the
+        # offline pass to produce an artifact the loaders reject
+        # (quantized-MoE is not wired) — fail up front instead.
+        raise NotImplementedError(
+            f"{model_path} is an MoE checkpoint (block_sparse_moe experts); "
+            "quantized MoE expert stacks are not wired — serve this family "
+            "unquantized"
         )
 
     handles: dict[Path, object] = {}

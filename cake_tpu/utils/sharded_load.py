@@ -30,8 +30,16 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cake_tpu.models.config import LlamaConfig
-from cake_tpu.parallel.mesh import STAGE, TP
-from cake_tpu.utils.weights import _LAYER_MAP, load_safetensors_index
+from cake_tpu.parallel.mesh import EP, STAGE, TP
+from cake_tpu.utils.weights import (
+    _BIAS_MAP,
+    _LAYER_MAP,
+    _MOE_EXPERT_MAP,
+    _MOE_ROUTER,
+    detect_family,
+    hf_layer_map,
+    load_safetensors_index,
+)
 
 # column-parallel: out-features shard over tp, in-axis full per shard
 _COL_PARALLEL = ("wq", "wk", "wv", "w_gate", "w_up")
@@ -143,6 +151,12 @@ def load_llama_params_on_mesh(
     krows = 2 if int4 else 1  # original rows per stored quantized row
 
     reader = CheckpointReader(model_dir)
+    num_experts, attention_bias, o_bias = detect_family(reader.name_to_file)
+    if num_experts and tier is not None:
+        raise NotImplementedError(
+            "quantized MoE expert stacks are not wired on the direct-to-mesh "
+            "path; load Mixtral-family checkpoints without quantize="
+        )
     prequantized = check_prequantized(reader.name_to_file, quantize)
     # Grouped int4 (the accuracy tier): the direct-to-mesh path supports it
     # for PRE-QUANTIZED checkpoints (stored [ngroups, out] scales slice
@@ -296,7 +310,34 @@ def load_llama_params_on_mesh(
 
     try:
         layers: dict = {}
-        for ours, (suffix, transpose) in _LAYER_MAP.items():
+        for ours, (suffix, transpose) in hf_layer_map(
+            num_experts, attention_bias, o_bias
+        ).items():
+            if ours == "bo":
+                # o_proj bias [L, hidden]: applied after the tp psum, so
+                # replicated like the norms
+                layers[ours] = _assemble((L, h), mesh, P(STAGE, None),
+                                         norm_cb(suffix))
+                continue
+            if ours in _BIAS_MAP:
+                # q/k/v bias [L, out]: shards with the projection's
+                # out-features (column-parallel tp)
+                out_dim = shapes[ours.replace("b", "w", 1)][2]
+
+                def bias_cb(sfx):
+                    def cb(index):
+                        lsl, csl = index
+                        lo, hi, _ = lsl.indices(L)
+                        return np.stack([
+                            reader.read1d(f"model.layers.{i}.{sfx}", csl)
+                            for i in range(lo, hi)
+                        ]).astype(dt)
+
+                    return cb
+
+                layers[ours] = _assemble((L, out_dim), mesh, P(STAGE, TP),
+                                         bias_cb(suffix))
+                continue
             shape = shapes[ours]
             if len(shape) == 2:
                 layers[ours] = _assemble(shape, mesh, P(STAGE, None),
@@ -326,6 +367,50 @@ def load_llama_params_on_mesh(
             else:
                 layers[ours] = _assemble(shape, mesh, spec,
                                          linear_cb(suffix, transpose))
+
+        if num_experts:
+            # router [L, H, E]: tiny, replicated (every rank routes every
+            # token); expert stacks [L, E, in, out]: expert axis over ep,
+            # features over tp like the dense MLP
+            def router_cb(index):
+                lsl, rsl, csl = index
+                lo, hi, _ = lsl.indices(L)
+                return np.stack([
+                    reader.read2d(f"model.layers.{i}.{_MOE_ROUTER}",
+                                  rsl, csl, True)
+                    for i in range(lo, hi)
+                ]).astype(dt)
+
+            layers["router"] = _assemble((L, h, num_experts), mesh,
+                                         P(STAGE, None, None), router_cb)
+
+            def expert_cb(pattern):
+                def cb(index):
+                    lsl, esl, rsl, csl = index
+                    lo, hi, _ = lsl.indices(L)
+                    e_lo, e_hi, _ = esl.indices(num_experts)
+                    return np.stack([
+                        np.stack([
+                            reader.read2d(
+                                f"model.layers.{i}."
+                                f"{pattern.format(e=e)}", rsl, csl, True)
+                            for e in range(e_lo, e_hi)
+                        ])
+                        for i in range(lo, hi)
+                    ]).astype(dt)
+
+                return cb
+
+            fdim = config.intermediate_size
+            for ours, (din, dout, spec) in {
+                "w_gate": (h, fdim, P(STAGE, EP, None, TP)),
+                "w_up": (h, fdim, P(STAGE, EP, None, TP)),
+                "w_down": (fdim, h, P(STAGE, EP, TP, None)),
+            }.items():
+                layers[ours] = _assemble(
+                    (L, num_experts, din, dout), mesh, spec,
+                    expert_cb(_MOE_EXPERT_MAP[ours]),
+                )
 
         embed_name = "model.embed_tokens.weight"
         head_name = embed_name if tie_word_embeddings else "lm_head.weight"

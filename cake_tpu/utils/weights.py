@@ -38,6 +38,44 @@ _LAYER_MAP = {
     "w_down": ("mlp.down_proj.weight", True),
 }
 
+# q/k/v projection biases (Qwen2 family; HF llama-arch `attention_bias`)
+_BIAS_MAP = {
+    "bq": ("self_attn.q_proj.bias", False),
+    "bk": ("self_attn.k_proj.bias", False),
+    "bv": ("self_attn.v_proj.bias", False),
+}
+# o_proj bias: HF llama-arch `attention_bias: true` biases o_proj too
+# (Qwen2 does not) — tracked separately so each checkpoint loads exactly
+# the tensors it stores.
+_O_BIAS = ("bo", ("self_attn.o_proj.bias", False))
+
+# Mixtral MoE naming: w1 = gate proj, w3 = up proj, w2 = down proj; the
+# router is `block_sparse_moe.gate`. Expert tensors are stacked over a new
+# leading E axis per layer ([L, E, in, out] in the pytree).
+_MOE_EXPERT_MAP = {
+    "w_gate": "block_sparse_moe.experts.{e}.w1.weight",
+    "w_up": "block_sparse_moe.experts.{e}.w3.weight",
+    "w_down": "block_sparse_moe.experts.{e}.w2.weight",
+}
+_MOE_ROUTER = "block_sparse_moe.gate.weight"
+
+
+def hf_layer_map(num_experts: int = 0, attention_bias: bool = False,
+                 o_bias: bool = False) -> dict:
+    """The per-layer name map for a model family (the dense/bias-free base
+    plus q/k/v biases and, for HF llama-arch ``attention_bias`` checkpoints,
+    the o_proj bias; Mixtral expert tensors are handled separately because
+    they stack over an expert axis)."""
+    m = dict(_LAYER_MAP)
+    if attention_bias:
+        m.update(_BIAS_MAP)
+    if o_bias:
+        m[_O_BIAS[0]] = _O_BIAS[1]
+    if num_experts:
+        for k in ("w_gate", "w_up", "w_down"):
+            del m[k]
+    return m
+
 
 def params_from_hf_tensors(
     get: Callable[[str], np.ndarray],
@@ -49,8 +87,16 @@ def params_from_hf_tensors(
     include_head: bool = True,
     quantize: str | None = None,
     prequantized: bool = False,
+    num_experts: int = 0,
+    attention_bias: bool = False,
+    o_bias: bool = False,
 ) -> dict:
     """Build the params pytree from a tensor lookup ``get(hf_name)``.
+
+    ``num_experts``/``attention_bias`` select the model family's extra
+    tensors (Mixtral routed experts / Qwen2 q-k-v biases — see
+    ``hf_layer_map``); pass them from
+    ``config.num_local_experts``/``config.attention_bias``.
 
     ``layer_range=(lo, hi)`` loads only blocks ``lo..hi-1`` (still stacked,
     dense from 0) — the worker/stage path.
@@ -135,10 +181,18 @@ def params_from_hf_tensors(
 
     qcls = QuantizedLinear if tier == "int8" else Quantized4Linear
 
+    if num_experts and tier is not None:
+        raise NotImplementedError(
+            "quantized MoE expert stacks are not wired yet; load "
+            "Mixtral-family checkpoints without quantize="
+        )
+
     params: dict = {}
     if hi > lo:
         layers = {}
-        for ours, (suffix, transpose) in _LAYER_MAP.items():
+        for ours, (suffix, transpose) in hf_layer_map(
+            num_experts, attention_bias, o_bias
+        ).items():
             do_quant = tier is not None and ours in LAYER_LINEARS
             per, scales = [], []
             for i in range(lo, hi):
@@ -156,6 +210,23 @@ def params_from_hf_tensors(
                     jnp.asarray(np.stack(scales)),
                 )
             else:
+                layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
+        if num_experts:
+            per_r = [
+                np.asarray(get(f"model.layers.{i}.{_MOE_ROUTER}")).T
+                for i in range(lo, hi)
+            ]
+            layers["router"] = jnp.asarray(np.stack(per_r)).astype(dt)
+            for ours, pattern in _MOE_EXPERT_MAP.items():
+                per = [
+                    np.stack([
+                        np.asarray(
+                            get(f"model.layers.{i}.{pattern.format(e=e)}")
+                        ).T
+                        for e in range(num_experts)
+                    ])
+                    for i in range(lo, hi)
+                ]  # [L, E, in, out]
                 layers[ours] = jnp.asarray(np.stack(per)).astype(dt)
         params["layers"] = layers
     if include_embed:
@@ -190,6 +261,24 @@ def load_safetensors_index(model_dir: str | Path) -> dict[str, Path]:
             with safe_open(f, framework="np") as sf:
                 return {name: f for name in sf.keys()}
     raise FileNotFoundError(f"no safetensors index or file under {model_dir}")
+
+
+def detect_family(name_to_file: dict) -> tuple[int, bool, bool]:
+    """Detect a checkpoint's family tensors from its name index:
+    ``(num_experts, attention_bias, o_bias)``. Zero/False for the Llama
+    base. Keyed off the stored names themselves so no call site can
+    silently drop a family's tensors by forgetting a flag."""
+    import re
+
+    bias = any(n.endswith("self_attn.q_proj.bias") for n in name_to_file)
+    o_bias = any(n.endswith("self_attn.o_proj.bias") for n in name_to_file)
+    experts = set()
+    pat = re.compile(r"block_sparse_moe\.experts\.(\d+)\.")
+    for n in name_to_file:
+        m = pat.search(n)
+        if m:
+            experts.add(int(m.group(1)))
+    return len(experts), bias, o_bias
 
 
 def is_prequantized(name_to_file: dict) -> str | None:
@@ -227,18 +316,31 @@ def load_llama_params(
     include_embed: bool = True,
     include_head: bool = True,
     quantize: str | None = None,
+    num_experts: int | None = None,
+    attention_bias: bool | None = None,
+    o_bias: bool | None = None,
 ) -> dict:
-    """Load a Llama checkpoint directory into the params pytree.
+    """Load a Llama-family checkpoint directory into the params pytree.
 
     Shards are opened lazily with ``safetensors.safe_open`` (zero-copy mmap,
     the equivalent of VarBuilder::from_mmaped_safetensors, cake/mod.rs:100-101)
     and only requested tensors are materialized — a worker loading 4 of 32
     layers reads only those bytes. Pre-quantized checkpoints
-    (tools/quantize_model) are detected automatically.
+    (tools/quantize_model) are detected automatically, and so are the model
+    family's extra tensors (Qwen2 q/k/v biases, Mixtral experts) via
+    :func:`detect_family` — pass ``num_experts``/``attention_bias`` only to
+    override the detection.
     """
     from safetensors import safe_open
 
     name_to_file = load_safetensors_index(model_dir)
+    det_experts, det_bias, det_o = detect_family(name_to_file)
+    if num_experts is None:
+        num_experts = det_experts
+    if attention_bias is None:
+        attention_bias = det_bias
+    if o_bias is None:
+        o_bias = det_o
     handles: dict[Path, object] = {}
 
     def get(name: str) -> np.ndarray:
@@ -258,6 +360,9 @@ def load_llama_params(
             include_head=include_head,
             quantize=quantize,
             prequantized=check_prequantized(name_to_file, quantize),
+            num_experts=num_experts,
+            attention_bias=attention_bias,
+            o_bias=o_bias,
         )
     finally:
         for h in handles.values():
@@ -281,11 +386,36 @@ def save_llama_params(params: dict, model_dir: str | Path, num_layers: int | Non
         tensors["model.norm.weight"] = np.asarray(params["norm_f"])
         tensors["lm_head.weight"] = np.asarray(params["lm_head"]).T
     L = params["layers"]["wq"].shape[0] if num_layers is None else num_layers
-    for ours, (suffix, transpose) in _LAYER_MAP.items():
-        stacked = np.asarray(params["layers"][ours])
+    layers = params["layers"]
+    moe = "router" in layers
+    fam_map = hf_layer_map(
+        num_experts=layers["w_gate"].shape[1] if moe else 0,
+        attention_bias="bq" in layers,
+        o_bias="bo" in layers,
+    )
+    for ours, (suffix, transpose) in fam_map.items():
+        stacked = np.asarray(layers[ours])
         for i in range(L):
             w = stacked[i]
             tensors[f"model.layers.{i}.{suffix}"] = w.T if transpose else np.ascontiguousarray(w)
+    if moe:
+        router = np.asarray(layers["router"])  # [L, H, E]
+        E = router.shape[-1]
+        for i in range(L):
+            tensors[f"model.layers.{i}.{_MOE_ROUTER}"] = router[i].T
+        # materialize ONE expert stack to host at a time (for a
+        # Mixtral-scale pytree each [L, E, in, out] leaf is tens of GB;
+        # holding all three at once would triple peak host RAM)
+        for ours, pattern in _MOE_EXPERT_MAP.items():
+            stacked = np.asarray(layers[ours])
+            for i in range(L):
+                for e in range(E):
+                    # real copy (not a .T view) so `del stacked` frees the
+                    # stack before the next one materializes
+                    tensors[
+                        f"model.layers.{i}.{pattern.format(e=e)}"
+                    ] = np.ascontiguousarray(stacked[i, e].T)
+            del stacked
 
     out = model_dir / "model.safetensors"
     # bf16 numpy isn't universally supported by safetensors.numpy; store f32

@@ -127,8 +127,8 @@ assert len(devs) == 4
 # all_gather.
 order = [devs[0], devs[2], devs[1], devs[3]]
 plan = MeshPlan.build(cfg, num_stages=2, tp=2, devices=order)
-grid = plan.mesh.devices  # [dp, stage, sp, tp]
-spans = {{tuple(sorted(d.process_index for d in grid[0, s, 0, :]))
+grid = plan.mesh.devices  # [dp, stage, sp, ep, tp]
+spans = {{tuple(sorted(d.process_index for d in grid[0, s, 0, 0, :]))
           for s in range(2)}}
 assert spans == {{(0, 1)}}, spans  # every tp pair spans both processes
 params = sharded_load.load_llama_params_on_mesh(
@@ -155,7 +155,7 @@ from cake_tpu.utils import sharded_load
 cfg = tiny()
 plan = MeshPlan.build(cfg, sp=2, devices=jax.devices())
 grid = plan.mesh.devices
-span = tuple(sorted(d.process_index for d in grid[0, 0, :, 0]))
+span = tuple(sorted(d.process_index for d in grid[0, 0, :, 0, 0]))
 assert span == (0, 1), span  # the sp ring crosses the process boundary
 params = sharded_load.load_llama_params_on_mesh(
     {model_dir!r}, cfg, plan.mesh)
@@ -182,7 +182,7 @@ from cake_tpu.utils import sharded_load
 cfg = tiny()
 plan = MeshPlan.build(cfg, dp=2, devices=jax.devices())
 grid = plan.mesh.devices
-span = tuple(sorted(d.process_index for d in grid[:, 0, 0, 0]))
+span = tuple(sorted(d.process_index for d in grid[:, 0, 0, 0, 0]))
 assert span == (0, 1), span  # the dp batch axis spans both processes
 params = sharded_load.load_llama_params_on_mesh(
     {model_dir!r}, cfg, plan.mesh)
