@@ -1,0 +1,41 @@
+#!/bin/bash
+# Second-stage wait-then-measure queue (r4 window 3): the rows the tunnel
+# drop at ~07:55Z interrupted — the int8-KV long-window serving rerun,
+# kernel_check (fixed grouped-int4 + int8-KV-prefill scale specs), and
+# flash_sweep's early-frontier decode rows. Same gentle cadence as
+# tools_bench_queue.sh; nothing kills an in-flight compile.
+set -u
+LOG=${LOG:-/tmp/bench_queue2.log}
+cd /root/repo
+
+probe() {
+  timeout -k 10 240 python -c \
+    "import jax; d = jax.devices()[0]; assert d.platform == 'tpu', d; print('healthy:', d.device_kind)" \
+    >>"$LOG" 2>&1
+}
+
+run_row() {
+  echo "=== $(date -u +%FT%TZ) row: $* ===" >>"$LOG"
+  env "$@" CAKE_BENCH_PROBE_BUDGET=120 python -u bench.py >>"$LOG" 2>&1
+  echo "--- exit $? $(date -u +%FT%TZ)" >>"$LOG"
+}
+
+echo "monitor2 start $(date -u +%FT%TZ)" >>"$LOG"
+for i in $(seq 1 40); do
+  if probe; then
+    echo "grant healthy at probe $i $(date -u +%FT%TZ)" >>"$LOG"
+    run_row CAKE_BENCH_BATCH=8 CAKE_BENCH_SEQ=4096 CAKE_BENCH_KV=int8
+    echo "=== $(date -u +%FT%TZ) kernel_check ===" >>"$LOG"
+    timeout -k 30 2400 python -u -m cake_tpu.tools.kernel_check --json-out KERNELS_TPU_r4.json >>"$LOG" 2>&1
+    echo "--- kernel_check exit $? $(date -u +%FT%TZ)" >>"$LOG"
+    echo "=== $(date -u +%FT%TZ) flash_sweep ===" >>"$LOG"
+    timeout -k 30 2400 python -u -m cake_tpu.tools.flash_sweep --json-out FLASH_SWEEP_r4.json >>"$LOG" 2>&1
+    echo "--- flash_sweep exit $? $(date -u +%FT%TZ)" >>"$LOG"
+    echo "queue2 done $(date -u +%FT%TZ)" >>"$LOG"
+    exit 0
+  fi
+  echo "probe $i wedged $(date -u +%FT%TZ); sleeping 20m" >>"$LOG"
+  sleep 1200
+done
+echo "gave up after 40 probes $(date -u +%FT%TZ)" >>"$LOG"
+exit 1
