@@ -167,6 +167,28 @@ def _load_config(args):
     return config
 
 
+def _mesh_params(args, config, plan):
+    """Load checkpoint params onto the mesh. Direct-to-mesh (each shard's
+    bytes only, worker.rs:85-98 parity) except for quantized MoE, which
+    that loader doesn't cover yet — there the host path quantizes the
+    expert stacks and shards the pytree (full-model host copy; acceptable
+    below pod scale, and the only way --quantize int8 serves Mixtral)."""
+    from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
+
+    if config.num_local_experts and args.quantize:
+        from cake_tpu.parallel.mesh import shard_params
+        from cake_tpu.utils.weights import load_llama_params
+
+        params = load_llama_params(
+            args.model, config.num_hidden_layers, dtype=config.dtype,
+            quantize=args.quantize,
+            tie_word_embeddings=config.tie_word_embeddings)
+        return shard_params(params, plan.mesh)
+    return load_llama_params_on_mesh(
+        args.model, config, plan.mesh, quantize=args.quantize,
+        tie_word_embeddings=config.tie_word_embeddings)
+
+
 def _load_tokenizer(model_dir: str):
     tok_path = Path(model_dir) / "tokenizer.json"
     if tok_path.exists():
@@ -273,18 +295,13 @@ def run_serve(args) -> int:
 
     t0 = time.perf_counter()
     from cake_tpu.parallel.mesh import MeshPlan
-    from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
 
     try:
         plan = MeshPlan.build(config, num_stages=args.stages, tp=args.tp,
                               dp=args.dp, sp=args.sp, ep=args.ep)
     except ValueError as e:
         sys.exit(f"error: {e}")
-    # direct-to-mesh load: each shard's bytes only, no full-model host copy
-    # (the reference worker loads only its own blocks, worker.rs:85-98)
-    params = load_llama_params_on_mesh(
-        args.model, config, plan.mesh, quantize=args.quantize,
-        tie_word_embeddings=config.tie_word_embeddings)
+    params = _mesh_params(args, config, plan)
     # --decode-block composes with --speculate here: spec rounds replace
     # block dispatches while proposals/window allow, and the fused block
     # remains the fallback (e.g. a stream at its window edge)
@@ -393,7 +410,6 @@ def run_master(args) -> int:
         from cake_tpu.runtime.mesh_generator import MeshGenerator
 
         from cake_tpu.parallel.mesh import MeshPlan
-        from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
 
         try:
             if topo_mesh:
@@ -408,13 +424,7 @@ def run_master(args) -> int:
                                       ep=args.ep)
         except ValueError as e:
             sys.exit(f"error: {e}")
-        # direct-to-mesh load: each shard's bytes only, no full-model host
-        # copy (the reference worker loads only its own blocks,
-        # worker.rs:85-98); on a multi-host pod each host reads only its
-        # stages' layer ranges
-        params = load_llama_params_on_mesh(
-            args.model, config, plan.mesh, quantize=args.quantize,
-            tie_word_embeddings=config.tie_word_embeddings)
+        params = _mesh_params(args, config, plan)
         try:
             if args.speculate:
                 from cake_tpu.runtime.speculative import (

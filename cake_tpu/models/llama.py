@@ -144,10 +144,10 @@ def init_params_int4(config: LlamaConfig, key: jax.Array, dtype=None) -> Params:
 def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
     from functools import partial as _partial
 
-    if config.num_local_experts:
+    if config.num_local_experts and bits == 4:
         raise NotImplementedError(
-            "quantized random-init is not wired for MoE expert stacks; use "
-            "init_params (bf16 experts) for Mixtral-family fixtures"
+            "int4 MoE expert stacks are not wired (packing is 2D); use "
+            "int8 for Mixtral-family quantization"
         )
 
     from cake_tpu.ops.quant import (
@@ -186,8 +186,14 @@ def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
         shape = shape_fn(config)
         k = next(keys)
         if name in LAYER_LINEARS:
-            q, scale = qdense(k, shape, shape[0], True)
+            fan_in = shape[-2] if len(shape) == 3 else shape[0]
+            q, scale = qdense(k, shape, fan_in, True)
             layers[name] = cls(q, scale)
+        elif name == "router":  # tiny, stays full precision
+            layers[name] = (
+                jax.random.normal(k, (L,) + shape, jnp.float32)
+                / jnp.sqrt(shape[0])
+            ).astype(dt)
         elif name.startswith("b"):  # q/k/v biases stay full precision
             layers[name] = (0.02 * jax.random.normal(k, (L,) + shape,
                                                      jnp.float32)).astype(dt)

@@ -38,10 +38,31 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from cake_tpu.ops.quant import QuantizedLinear, dequantize_linear
+
 # Decode/prefill strategy crossover: gather materializes [N*k, H, F] weight
 # rows, so it only pays off while N*k is well under E (single-digit serving
 # batches at decode). Above it the dense path's E-batched einsum wins.
 GATHER_MAX_ROWS = 8
+
+
+def _deq(w, dt):
+    """Trace-level dequant of an int8 expert stack ``[E, in, out]``
+    (scale ``[E, out]``): XLA fuses the convert+mul into the downstream
+    einsum's operand read, so HBM streams the int8 bytes — the same
+    contract as the int8 KV cache's XLA path (ops/attention.py)."""
+    if isinstance(w, QuantizedLinear):
+        return dequantize_linear(w, dt)
+    return w
+
+
+def _take(w, flat):
+    """Expert-row gather that works for plain and int8 stacks (gathering
+    q and scale separately keeps the gathered bytes int8-sized)."""
+    if isinstance(w, QuantizedLinear):
+        return QuantizedLinear(q=jnp.take(w.q, flat, axis=0),
+                               scale=jnp.take(w.scale, flat, axis=0))
+    return jnp.take(w, flat, axis=0)
 
 
 def router_topk(
@@ -65,13 +86,14 @@ def router_topk(
 def _moe_dense(
     x2d: jax.Array,  # [N, H]
     combine: jax.Array,  # [N, E_local] f32 combine weights (zeros off top-k)
-    w_gate: jax.Array,  # [E_local, H, F]
-    w_up: jax.Array,
-    w_down: jax.Array,  # [E_local, F, H]
+    w_gate,  # [E_local, H, F] array or int8 QuantizedLinear
+    w_up,
+    w_down,  # [E_local, F, H]
 ) -> jax.Array:
-    g = jnp.einsum("nh,ehf->enf", x2d, w_gate)
-    u = jnp.einsum("nh,ehf->enf", x2d, w_up)
-    y = jnp.einsum("enf,efh->enh", jax.nn.silu(g) * u, w_down)
+    dt = x2d.dtype
+    g = jnp.einsum("nh,ehf->enf", x2d, _deq(w_gate, dt))
+    u = jnp.einsum("nh,ehf->enf", x2d, _deq(w_up, dt))
+    y = jnp.einsum("enf,efh->enh", jax.nn.silu(g) * u, _deq(w_down, dt))
     return jnp.einsum("ne,enh->nh", combine.astype(y.dtype), y)
 
 
@@ -79,15 +101,16 @@ def _moe_gather(
     x2d: jax.Array,  # [N, H]
     w_topk: jax.Array,  # [N, k] f32
     idx: jax.Array,  # [N, k] int32 (global expert ids)
-    w_gate: jax.Array,  # [E, H, F]
-    w_up: jax.Array,
-    w_down: jax.Array,  # [E, F, H]
+    w_gate,  # [E, H, F] array or int8 QuantizedLinear
+    w_up,
+    w_down,  # [E, F, H]
 ) -> jax.Array:
     n, k = idx.shape
+    dt = x2d.dtype
     flat = idx.reshape(-1)
-    gg = jnp.take(w_gate, flat, axis=0)  # [N*k, H, F]
-    gu = jnp.take(w_up, flat, axis=0)
-    gd = jnp.take(w_down, flat, axis=0)  # [N*k, F, H]
+    gg = _deq(_take(w_gate, flat), dt)  # [N*k, H, F]
+    gu = _deq(_take(w_up, flat), dt)
+    gd = _deq(_take(w_down, flat), dt)  # [N*k, F, H]
     xr = jnp.repeat(x2d, k, axis=0)  # [N*k, H]
     g = jnp.einsum("nh,nhf->nf", xr, gg)
     u = jnp.einsum("nh,nhf->nf", xr, gu)
@@ -125,7 +148,8 @@ def moe_swiglu(
         ep_size = jax.lax.axis_size(ep_axis)
     axes: tuple[str, ...] = ()
     if ep_axis is not None and ep_size > 1:
-        e_local = w_gate.shape[0]
+        e_local = (w_gate.q if isinstance(w_gate, QuantizedLinear)
+                   else w_gate).shape[0]
         lo = jax.lax.axis_index(ep_axis) * e_local
         combine_local = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
         out = _moe_dense(x2d, combine_local, w_gate, w_up, w_down)

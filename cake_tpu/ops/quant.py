@@ -89,6 +89,12 @@ def quantize_params(
         raise ValueError(f"bits must be 4 or 8, got {bits}")
     if group_size is not None and bits != 4:
         raise ValueError("group_size applies to bits=4 only")
+    layer_tree = params.get("layers", params) if isinstance(params, dict) else {}
+    if bits == 4 and isinstance(layer_tree, dict) and "router" in layer_tree:
+        raise NotImplementedError(
+            "int4 MoE expert stacks are not wired (packing is 2D); use "
+            "bits=8 for Mixtral-family pytrees"
+        )
     if bits == 8:
         qfn = quantize_linear
     else:

@@ -35,14 +35,20 @@ import numpy as np
 
 from cake_tpu.ops.quant import (LAYER_LINEARS, quantize_linear4_np,
                                 quantize_linear_np)
-from cake_tpu.utils.weights import _LAYER_MAP, load_safetensors_index
+from cake_tpu.utils.weights import (_LAYER_MAP, _MOE_EXPERT_MAP,
+                                    load_safetensors_index)
 
 # HF names of quantizable linears (torch [out, in] orientation), DERIVED
 # from the single source of truth (weights._LAYER_MAP filtered by
 # quant.LAYER_LINEARS) so a future linear cannot drift out of sync between
 # this tool and the loaders; everything else (norms, embedding) passes
 # through unchanged
-_LINEAR_SUFFIXES = tuple(_LAYER_MAP[k][0] for k in LAYER_LINEARS)
+# Mixtral expert linears are int8-quantizable like any [out, in] linear
+# (router/norms pass through); their suffixes are DERIVED from
+# weights._MOE_EXPERT_MAP, same single-source rule as the dense list.
+_LINEAR_SUFFIXES = tuple(_LAYER_MAP[k][0] for k in LAYER_LINEARS) + tuple(
+    p.split("{e}.")[-1] for p in _MOE_EXPERT_MAP.values()
+)
 
 
 def _is_linear(name: str) -> bool:
@@ -88,15 +94,12 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
             f"{model_path} is already pre-quantized (.q8/.scale tensors); "
             "re-quantizing it would only copy bytes"
         )
-    if detect_family(name_to_file)[0]:
-        # Quantizing only the attention linears while the expert stacks
-        # (the bulk of an MoE checkpoint) pass through raw would burn the
-        # offline pass to produce an artifact the loaders reject
-        # (quantized-MoE is not wired) — fail up front instead.
+    if detect_family(name_to_file)[0] and bits == 4:
+        # int4 MoE expert stacks are not wired (the loaders reject them);
+        # don't burn the offline pass producing an unloadable artifact.
         raise NotImplementedError(
             f"{model_path} is an MoE checkpoint (block_sparse_moe experts); "
-            "quantized MoE expert stacks are not wired — serve this family "
-            "unquantized"
+            "int4 expert stacks are not wired — use --bits 8"
         )
 
     handles: dict[Path, object] = {}

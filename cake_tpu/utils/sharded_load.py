@@ -155,7 +155,8 @@ def load_llama_params_on_mesh(
     if num_experts and tier is not None:
         raise NotImplementedError(
             "quantized MoE expert stacks are not wired on the direct-to-mesh "
-            "path; load Mixtral-family checkpoints without quantize="
+            "path yet; int8 MoE loads via the host path "
+            "(utils.weights.load_llama_params + mesh.shard_params)"
         )
     prequantized = check_prequantized(reader.name_to_file, quantize)
     # Grouped int4 (the accuracy tier): the direct-to-mesh path supports it

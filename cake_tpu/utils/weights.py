@@ -181,10 +181,10 @@ def params_from_hf_tensors(
 
     qcls = QuantizedLinear if tier == "int8" else Quantized4Linear
 
-    if num_experts and tier is not None:
+    if num_experts and tier == "int4":
         raise NotImplementedError(
-            "quantized MoE expert stacks are not wired yet; load "
-            "Mixtral-family checkpoints without quantize="
+            "int4 MoE expert stacks are not wired (packing is 2D); load "
+            "Mixtral-family checkpoints with quantize='int8' or unquantized"
         )
 
     params: dict = {}
@@ -218,6 +218,23 @@ def params_from_hf_tensors(
             ]
             layers["router"] = jnp.asarray(np.stack(per_r)).astype(dt)
             for ours, pattern in _MOE_EXPERT_MAP.items():
+                if tier == "int8":
+                    # per-expert per-output-channel int8 (through get_quant
+                    # so pre-quantized .q8 expert tensors load identically)
+                    per_q, per_s = [], []
+                    for i in range(lo, hi):
+                        qs = [
+                            get_quant(
+                                f"model.layers.{i}.{pattern.format(e=e)}")
+                            for e in range(num_experts)
+                        ]
+                        per_q.append(np.stack([q for q, _ in qs]))
+                        per_s.append(np.stack([s for _, s in qs]))
+                    layers[ours] = qcls(
+                        jnp.asarray(np.stack(per_q)),  # [L, E, in, out]
+                        jnp.asarray(np.stack(per_s)),  # [L, E, out]
+                    )
+                    continue
                 per = [
                     np.stack([
                         np.asarray(

@@ -159,13 +159,25 @@ def test_family_checkpoint_round_trip(tmp_path):
     )
 
 
-def test_moe_quantized_load_rejected(tmp_path):
+def test_moe_int4_load_rejected_int8_loads(tmp_path):
+    """int4 expert packing is not wired (rejected loudly); int8 expert
+    stacks load and match quantize_params applied to the host pytree."""
+    from cake_tpu.ops.quant import quantize_params
+
     cfg = tiny_moe()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     save_llama_params(params, tmp_path, cfg.num_hidden_layers)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        load_llama_params(tmp_path, cfg.num_hidden_layers, quantize="int8",
+    with pytest.raises(NotImplementedError, match="int4"):
+        load_llama_params(tmp_path, cfg.num_hidden_layers, quantize="int4",
                           num_experts=cfg.num_local_experts)
+    loaded = load_llama_params(tmp_path, cfg.num_hidden_layers,
+                               dtype="float32", quantize="int8")
+    want = quantize_params(params, bits=8)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        loaded, want,
+    )
 
 
 def test_config_family_round_trip():
@@ -264,11 +276,24 @@ def test_qwen2_partial_window_rejected():
     assert LlamaConfig.from_hf_dict(d).sliding_window == 4
 
 
-def test_quantize_model_rejects_moe(tmp_path):
+def test_quantize_model_moe_int8_round_trip(tmp_path):
+    """Offline int8 pre-quantization of an MoE checkpoint: expert tensors
+    get .q8/.scale, the pre-quantized load is bit-equal to quantize-on-load,
+    and int4 is rejected up front."""
     from cake_tpu.tools.quantize_model import quantize_checkpoint
 
     cfg = tiny_moe()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    save_llama_params(params, tmp_path, cfg.num_hidden_layers)
-    with pytest.raises(NotImplementedError, match="MoE"):
-        quantize_checkpoint(tmp_path, tmp_path / "q8")
+    save_llama_params(params, tmp_path / "src", cfg.num_hidden_layers)
+    with pytest.raises(NotImplementedError, match="int4"):
+        quantize_checkpoint(tmp_path / "src", tmp_path / "q4", bits=4)
+    out = quantize_checkpoint(tmp_path / "src", tmp_path / "q8", bits=8)
+    pre = load_llama_params(out, cfg.num_hidden_layers, dtype="float32",
+                            quantize="int8")
+    onfly = load_llama_params(tmp_path / "src", cfg.num_hidden_layers,
+                              dtype="float32", quantize="int8")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        pre, onfly,
+    )

@@ -109,14 +109,14 @@ def test_quantize_writes_bounded_shards(tmp_path):
 
 def test_linear_suffixes_derived_from_layer_map():
     """The tool's linear list is DERIVED from weights._LAYER_MAP +
-    quant.LAYER_LINEARS — the three sites cannot drift."""
+    quant.LAYER_LINEARS (+ the MoE expert map) — the sites cannot drift."""
     from cake_tpu.ops.quant import LAYER_LINEARS
     from cake_tpu.tools.quantize_model import _LINEAR_SUFFIXES
-    from cake_tpu.utils.weights import _LAYER_MAP
+    from cake_tpu.utils.weights import _LAYER_MAP, _MOE_EXPERT_MAP
 
     assert set(_LINEAR_SUFFIXES) == {
         _LAYER_MAP[k][0] for k in LAYER_LINEARS
-    }
+    } | {p.split("{e}.")[-1] for p in _MOE_EXPERT_MAP.values()}
 
 
 def test_prequantized_requires_int8_flag(dirs):
