@@ -179,10 +179,13 @@ def _mesh_params(args, config, plan):
         from cake_tpu.parallel.mesh import shard_params
         from cake_tpu.utils.weights import load_llama_params
 
-        params = load_llama_params(
-            args.model, config.num_hidden_layers, dtype=config.dtype,
-            quantize=args.quantize,
-            tie_word_embeddings=config.tie_word_embeddings)
+        try:
+            params = load_llama_params(
+                args.model, config.num_hidden_layers, dtype=config.dtype,
+                quantize=args.quantize,
+                tie_word_embeddings=config.tie_word_embeddings)
+        except NotImplementedError as e:  # int4 MoE: clean exit, no trace
+            sys.exit(f"error: {e}")
         return shard_params(params, plan.mesh)
     return load_llama_params_on_mesh(
         args.model, config, plan.mesh, quantize=args.quantize,

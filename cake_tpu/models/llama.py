@@ -145,10 +145,9 @@ def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
     from functools import partial as _partial
 
     if config.num_local_experts and bits == 4:
-        raise NotImplementedError(
-            "int4 MoE expert stacks are not wired (packing is 2D); use "
-            "int8 for Mixtral-family quantization"
-        )
+        from cake_tpu.ops.quant import reject_int4_moe
+
+        reject_int4_moe()
 
     from cake_tpu.ops.quant import (
         LAYER_LINEARS,

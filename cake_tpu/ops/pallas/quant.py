@@ -91,6 +91,7 @@ def quant_matmul_pallas(
 def _kernel4(
     xlo_ref, xhi_ref, qp_ref, s_ref, o_ref, acc_ref, *,
     num_k_blocks: int, grouped: bool, blocks_per_group: int,
+    unpack: str = "int32",
 ):
     """Packed-int4 matmul kernel. ``grouped`` is a Python static: per-channel
     applies the scale once in the epilogue; grouped multiplies each K
@@ -113,11 +114,17 @@ def _kernel4(
     x_lo = xlo_ref[:]  # [BM, BK2] activation dtype (even K rows)
     x_hi = xhi_ref[:]  # [BM, BK2] (odd K rows)
     # Unpack both nibbles of the SAME packed block (adjacent-pair layout,
-    # ops/quant.py:pack_int4). Shifts run in int32 on the VPU — the int8
-    # bytes are what streamed from HBM, which is all that matters for the
-    # bandwidth-bound regime.
-    p = qp_ref[:].astype(jnp.int32)  # [BK2, BN]
-    w_lo = ((p << 28) >> 28).astype(x_lo.dtype)
+    # ops/quant.py:pack_int4). The shift width is a tunable (`unpack`):
+    # int32 is the VPU's native lane width; int16 halves the unpacked
+    # temporary's VMEM footprint at skinny M where the [BK2, BN] weight
+    # temporaries dominate VMEM — tools/int4_sweep.py measures which wins
+    # per shape. The int8 bytes are what streamed from HBM either way.
+    if unpack == "int16":
+        p = qp_ref[:].astype(jnp.int16)  # [BK2, BN]
+        w_lo = ((p << 12) >> 12).astype(x_lo.dtype)
+    else:
+        p = qp_ref[:].astype(jnp.int32)  # [BK2, BN]
+        w_lo = ((p << 28) >> 28).astype(x_lo.dtype)
     w_hi = (p >> 4).astype(x_lo.dtype)
     partial = jax.lax.dot_general(
         x_lo, w_lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -151,6 +158,7 @@ def quant4_matmul_pallas(
     block_m: int = 256,
     block_n: int = 512,
     block_k: int = 512,
+    unpack: str = "int32",
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused packed-int4 matmul: quarter the bf16 weight bytes from HBM.
@@ -173,6 +181,8 @@ def quant4_matmul_pallas(
     k2, n = qp.shape
     if k != 2 * k2:
         raise ValueError(f"x in-dim {k} != 2 * packed rows {k2}")
+    if unpack not in ("int32", "int16"):
+        raise ValueError(f"unpack must be 'int32' or 'int16', got {unpack!r}")
     grouped = scale.ndim == 2
     pad_m = 0
     sub = _sublane(x.dtype)
@@ -209,6 +219,7 @@ def quant4_matmul_pallas(
             num_k_blocks=k2 // bk2,
             grouped=grouped,
             blocks_per_group=g2 // bk2,
+            unpack=unpack,
         ),
         out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
         grid=(m // bm, n // bn, k2 // bk2),

@@ -75,6 +75,18 @@ def quantize_linear_np(w) -> tuple:
 LAYER_LINEARS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def reject_int4_moe() -> None:
+    """The ONE int4+MoE rejection, raised by every entry point (pytree
+    quantize, random-init, both checkpoint loaders, the offline tool) so
+    that wiring int4 expert packing later means deleting exactly one
+    guard per site and this helper — no independently-worded copies to
+    drift (the same single-source rule as tools' _LINEAR_SUFFIXES)."""
+    raise NotImplementedError(
+        "int4 MoE expert stacks are not wired (the nibble packing is 2D); "
+        "use int8 for Mixtral-family quantization"
+    )
+
+
 def quantize_params(
     params: dict, bits: int = 8, group_size: int | None = None
 ) -> dict:
@@ -91,10 +103,7 @@ def quantize_params(
         raise ValueError("group_size applies to bits=4 only")
     layer_tree = params.get("layers", params) if isinstance(params, dict) else {}
     if bits == 4 and isinstance(layer_tree, dict) and "router" in layer_tree:
-        raise NotImplementedError(
-            "int4 MoE expert stacks are not wired (packing is 2D); use "
-            "bits=8 for Mixtral-family pytrees"
-        )
+        reject_int4_moe()
     if bits == 8:
         qfn = quantize_linear
     else:

@@ -1,0 +1,138 @@
+"""Int4 decode-gemv sweep: find why (and fix how) m=1 int4 runs under its
+roofline.
+
+The r4 on-chip record: single-stream int4 decode measured 51 tok/s against
+a 170 tok/s weights-bound roofline, while int8 (twice the bytes) hits 84.8
+— so the m=1 int4 kernel is the bottleneck, not HBM. Working hypothesis
+(ops/pallas/quant.py:_kernel4): the per-byte nibble unpack (widen + shifts
++ converts over a [BK2, BN] block) is VPU-bound and its widened
+temporaries pressure VMEM; both effects are block-size- and
+width-dependent. This tool measures, per decode-critical 8B shape, the
+kernel across {block_n} x {block_k} x {int32, int16} unpack variants plus
+the XLA fallback and the int8 kernel (the byte-rate ceiling to beat),
+reporting achieved packed-GB/s so the gap to the ~819 GB/s v5e HBM peak is
+explicit.
+
+Usage:  python -m cake_tpu.tools.int4_sweep [--json-out PATH] [--m M]
+
+One JSON line per row:
+  {"k", "n", "variant", "block_n", "block_k", "ms", "gbps", "speedup_vs_xla"}
+
+The winning (block, unpack) per shape is the measured config the kernel's
+defaults should adopt (the same measured-crossover discipline as
+quant_matmul's m>=16 gate and flash's PREFILL_FLASH_MIN_S).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from cake_tpu.tools.kernel_check import _time_ms
+
+
+# Llama-3-8B decode linears (in, out): the per-token weight sweep.
+SHAPES_8B = [
+    (4096, 4096),    # wq / wo
+    (4096, 14336),   # w_gate / w_up (the big pair)
+    (14336, 4096),   # w_down
+]
+
+
+def sweep(json_out: str | None = None, m: int = 1) -> list:
+    from cake_tpu.ops.pallas import interpret_default
+    from cake_tpu.ops.pallas.quant import (
+        quant4_matmul_pallas,
+        quant_matmul_pallas,
+    )
+    from cake_tpu.ops.quant import (
+        quant4_matmul_xla,
+        quantize_linear,
+        quantize_linear4,
+    )
+
+    compiled = not interpret_default()
+    dev = jax.devices()[0]
+    sys.stderr.write(f"device={dev.device_kind} compiled={compiled} m={m}\n")
+    key = jax.random.PRNGKey(0)
+    results = []
+
+    for k, n in SHAPES_8B:
+        kx, kw = jax.random.split(jax.random.fold_in(key, k * n))
+        x = jax.random.normal(kx, (m, k), jnp.bfloat16)
+        w = jax.random.normal(kw, (k, n), jnp.float32) / jnp.sqrt(k)
+        q4 = quantize_linear4(w)
+        q8 = quantize_linear(w)
+        packed_mb = q4.qp.size / 1e6  # int8 bytes holding two nibbles each
+
+        # baselines: the XLA unpack fallback and the int8 kernel byte rate
+        xla_ms = _time_ms(
+            jax.jit(quant4_matmul_xla), x, q4.qp, q4.scale
+        )
+        results.append(dict(k=k, n=n, variant="xla", block_n=0, block_k=0,
+                            ms=xla_ms, gbps=packed_mb / xla_ms,
+                            speedup_vs_xla=1.0))
+        int8_ms = _time_ms(
+            jax.jit(partial(quant_matmul_pallas, interpret=not compiled)),
+            x, q8.q, q8.scale,
+        )
+        results.append(dict(k=k, n=n, variant="int8_kernel", block_n=0,
+                            block_k=0, ms=int8_ms,
+                            gbps=2 * packed_mb / int8_ms,  # int8 bytes
+                            speedup_vs_xla=xla_ms / int8_ms))
+
+        for unpack in ("int32", "int16"):
+            for bn in (512, 1024, 2048):
+                for bk in (512, 1024, 2048):
+                    if bn > n or bk > k // 2:
+                        continue
+                    fn = jax.jit(partial(
+                        quant4_matmul_pallas, block_n=bn, block_k=bk,
+                        unpack=unpack, interpret=not compiled,
+                    ))
+                    try:
+                        ms = _time_ms(fn, x, q4.qp, q4.scale)
+                    except Exception as e:  # Mosaic lowering edge: record
+                        sys.stderr.write(
+                            f"  k={k} n={n} {unpack} bn={bn} bk={bk}: "
+                            f"{type(e).__name__}: {str(e)[:120]}\n")
+                        continue
+                    rec = dict(k=k, n=n, variant=unpack, block_n=bn,
+                               block_k=bk, ms=ms, gbps=packed_mb / ms,
+                               speedup_vs_xla=xla_ms / ms)
+                    results.append(rec)
+                    print(json.dumps(rec), flush=True)
+
+        best = max((r for r in results if r["k"] == k and r["n"] == n
+                    and r["variant"] in ("int32", "int16")),
+                   key=lambda r: r["gbps"], default=None)
+        if best:
+            sys.stderr.write(
+                f"shape {k}x{n}: best {best['variant']} "
+                f"bn={best['block_n']} bk={best['block_k']} "
+                f"{best['gbps']:.0f} GB/s (xla {packed_mb / xla_ms:.0f}, "
+                f"int8 kernel {2 * packed_mb / int8_ms:.0f} int8-GB/s)\n")
+
+    if json_out:
+        with open(json_out, "w") as f:
+            for r in results:
+                f.write(json.dumps(r) + "\n")
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json-out", default=None)
+    ap.add_argument("--m", type=int, default=1)
+    args = ap.parse_args()
+    sweep(args.json_out, m=args.m)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

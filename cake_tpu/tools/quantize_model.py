@@ -95,12 +95,11 @@ def quantize_checkpoint(model_path: str | Path, output: str | Path,
             "re-quantizing it would only copy bytes"
         )
     if detect_family(name_to_file)[0] and bits == 4:
-        # int4 MoE expert stacks are not wired (the loaders reject them);
-        # don't burn the offline pass producing an unloadable artifact.
-        raise NotImplementedError(
-            f"{model_path} is an MoE checkpoint (block_sparse_moe experts); "
-            "int4 expert stacks are not wired — use --bits 8"
-        )
+        # don't burn the offline pass producing an artifact the loaders
+        # would reject
+        from cake_tpu.ops.quant import reject_int4_moe
+
+        reject_int4_moe()
 
     handles: dict[Path, object] = {}
 

@@ -28,12 +28,13 @@ def hbm_budget(
     num_stages: int = 1,
     tp: int = 1,
     sp: int = 1,
+    ep: int = 1,
     max_seq: int | None = None,
     batch: int = 1,
     quant: str | None = None,
     cache_bytes_per_el: int = 2,
 ) -> dict:
-    """Per-chip HBM budget (bytes) for a (stage, tp, sp) mesh layout.
+    """Per-chip HBM budget (bytes) for a (stage, tp, sp, ep) mesh layout.
 
     Mirrors the sharding actually used (parallel/mesh.py param_specs +
     CACHE_SPEC): stacked layers shard over stage, linear in/out features over
@@ -62,13 +63,27 @@ def hbm_budget(
     S = max_seq or c.max_seq_len
     d = c.head_dim
 
-    # per-layer linear params (full, unsharded)
+    # per-layer linear params (full, unsharded). MoE (Mixtral families):
+    # the MLP triplet multiplies by num_local_experts and its expert axis
+    # shards over ep (mesh.param_specs P(STAGE, EP, ., TP)); the router is
+    # tiny and replicated. ep divides ONLY the expert stacks — attention
+    # and norms are replicated across ep ranks.
+    n_exp = getattr(c, "num_local_experts", 0) or 0
     qkv_out = (c.num_attention_heads + 2 * c.num_key_value_heads) * d
     lin = c.hidden_size * qkv_out  # wq+wk+wv
     lin += c.num_attention_heads * d * c.hidden_size  # wo
-    lin += 3 * c.hidden_size * c.intermediate_size  # gate/up/down
-    lin_out = qkv_out + c.hidden_size + 2 * c.intermediate_size + c.hidden_size
+    mlp = 3 * c.hidden_size * c.intermediate_size  # gate/up/down
+    mlp_out = 2 * c.intermediate_size + c.hidden_size
+    if n_exp:
+        mlp = mlp * n_exp / ep
+        mlp_out = mlp_out * n_exp / ep
+    lin += mlp
+    lin_out = qkv_out + c.hidden_size + mlp_out
     norms = 2 * c.hidden_size
+    if n_exp:
+        # per-layer router [H, E], replicated, full precision — priced with
+        # the norms (both ride the `* el` term below)
+        norms += c.hidden_size * n_exp
 
     layers_per_chip = c.num_hidden_layers / num_stages
     # scale elements: one per output channel (per-channel), or one per

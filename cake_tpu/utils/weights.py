@@ -182,10 +182,9 @@ def params_from_hf_tensors(
     qcls = QuantizedLinear if tier == "int8" else Quantized4Linear
 
     if num_experts and tier == "int4":
-        raise NotImplementedError(
-            "int4 MoE expert stacks are not wired (packing is 2D); load "
-            "Mixtral-family checkpoints with quantize='int8' or unquantized"
-        )
+        from cake_tpu.ops.quant import reject_int4_moe
+
+        reject_int4_moe()
 
     params: dict = {}
     if hi > lo:

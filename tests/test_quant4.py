@@ -722,3 +722,27 @@ def test_int4_serving_batch_generator(cfg):
         row = gen.step()
         out.append([None if t is None else int(t.id) for t in row])
     assert all(len(r) == 2 for r in out)
+
+
+def test_int16_unpack_variant_matches_int32():
+    """The kernel's `unpack` width knob (tools/int4_sweep.py's variant
+    axis) must not change the math — int16 sign-extension of a nibble is
+    exact."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cake_tpu.ops.pallas.quant import quant4_matmul_pallas
+    from cake_tpu.ops.quant import quantize_linear4
+
+    key = jax.random.PRNGKey(3)
+    x = jax.random.normal(key, (4, 256), jnp.float32)
+    w = jax.random.normal(jax.random.fold_in(key, 1), (256, 512),
+                          jnp.float32)
+    for gs in (None, 64):
+        q4 = quantize_linear4(w, group_size=gs)
+        a = quant4_matmul_pallas(x, q4.qp, q4.scale, unpack="int32",
+                                 interpret=True)
+        b = quant4_matmul_pallas(x, q4.qp, q4.scale, unpack="int16",
+                                 interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -31,6 +31,9 @@ for i in $(seq 1 40); do
     echo "=== $(date -u +%FT%TZ) flash_sweep ===" >>"$LOG"
     timeout -k 30 2400 python -u -m cake_tpu.tools.flash_sweep --json-out FLASH_SWEEP_r4.json >>"$LOG" 2>&1
     echo "--- flash_sweep exit $? $(date -u +%FT%TZ)" >>"$LOG"
+    echo "=== $(date -u +%FT%TZ) int4_sweep ===" >>"$LOG"
+    timeout -k 30 2400 python -u -m cake_tpu.tools.int4_sweep --json-out INT4_SWEEP_r4.json >>"$LOG" 2>&1
+    echo "--- int4_sweep exit $? $(date -u +%FT%TZ)" >>"$LOG"
     echo "queue2 done $(date -u +%FT%TZ)" >>"$LOG"
     exit 0
   fi
