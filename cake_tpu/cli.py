@@ -168,28 +168,17 @@ def _load_config(args):
 
 
 def _mesh_params(args, config, plan):
-    """Load checkpoint params onto the mesh. Direct-to-mesh (each shard's
-    bytes only, worker.rs:85-98 parity) except for quantized MoE, which
-    that loader doesn't cover yet — there the host path quantizes the
-    expert stacks and shards the pytree (full-model host copy; acceptable
-    below pod scale, and the only way --quantize int8 serves Mixtral)."""
+    """Load checkpoint params onto the mesh, direct-to-mesh (each shard's
+    bytes only — the reference worker's own-blocks-only contract,
+    worker.rs:85-98 — including int8 MoE expert stacks)."""
     from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
 
-    if config.num_local_experts and args.quantize:
-        from cake_tpu.parallel.mesh import shard_params
-        from cake_tpu.utils.weights import load_llama_params
-
-        try:
-            params = load_llama_params(
-                args.model, config.num_hidden_layers, dtype=config.dtype,
-                quantize=args.quantize,
-                tie_word_embeddings=config.tie_word_embeddings)
-        except NotImplementedError as e:  # int4 MoE: clean exit, no trace
-            sys.exit(f"error: {e}")
-        return shard_params(params, plan.mesh)
-    return load_llama_params_on_mesh(
-        args.model, config, plan.mesh, quantize=args.quantize,
-        tie_word_embeddings=config.tie_word_embeddings)
+    try:
+        return load_llama_params_on_mesh(
+            args.model, config, plan.mesh, quantize=args.quantize,
+            tie_word_embeddings=config.tie_word_embeddings)
+    except NotImplementedError as e:  # e.g. int4 MoE: clean exit, no trace
+        sys.exit(f"error: {e}")
 
 
 def _load_tokenizer(model_dir: str):

@@ -159,9 +159,15 @@ def quant4_matmul_pallas(
     block_n: int = 512,
     block_k: int = 512,
     unpack: str = "int32",
+    skinny_widen: bool = True,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Fused packed-int4 matmul: quarter the bf16 weight bytes from HBM.
+
+    ``skinny_widen=False`` disables the skinny-M block widening so an
+    explicit ``block_n``/``block_k`` is honored verbatim (modulo divisor
+    clamping) — tools/int4_sweep.py uses it to measure the sub-1024
+    configs the default policy would silently override.
 
     ``y = (x[:, 0::2] @ lo(qp) + x[:, 1::2] @ hi(qp)) * scale`` with the
     even/odd activation slices materialized OUTSIDE the kernel (M x K/2
@@ -190,7 +196,7 @@ def quant4_matmul_pallas(
         pad_m = sub - m
         x = jnp.pad(x, ((0, pad_m), (0, 0)))
         m = sub
-    if m <= 32:
+    if m <= 32 and skinny_widen:
         # skinny regime: fewer, larger grid steps (weights dominate VMEM
         # and HBM; the activation block is tiny either way)
         block_n = max(block_n, 1024)

@@ -635,6 +635,11 @@ class BatchGenerator:
         # emission rows already recorded (admit() flushing the block buffer)
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
+        if getattr(self, "_splice_warm_pending", False):
+            # warm_admission ran before this set_prompts; the splice warm
+            # needs the batch state that only now exists
+            self._splice_warm_pending = False
+            self._warm_splice()
 
     def _free_slot(self) -> int | None:
         return next(
@@ -727,22 +732,39 @@ class BatchGenerator:
         )
         # warm the rest of the admission-completion path too: the first
         # token's sampler and the slot-traced state splice (compiled once,
-        # outputs discarded — no donation, the live state is untouched)
+        # outputs discarded — no donation, the live state is untouched).
+        # Before set_prompts the batch state (and its B dimension) doesn't
+        # exist yet, so the splice warm is deferred to the next set_prompts
+        # — never silently dropped (the compile would otherwise land inside
+        # the serving window, the exact stall _splice_fn exists to kill).
         n_hist = self.settings.repeat_last_n
         tok = sampling.sample_token(
             logits[0], jax.random.fold_in(self._base_key, 0),
             jnp.full((n_hist,), -1, jnp.int32), self.settings,
         )
         if getattr(self, "cache", None) is not None:
-            out = self._splice_fn()(
-                self.cache, staging, self._keys, self._history,
-                self._hist_slot, self._last_tokens,
-                jax.random.fold_in(self._base_key, 0),
-                jnp.full((n_hist,), -1, jnp.int32), jnp.int32(0),
-                jnp.int32(0), jnp.int32(0),
-            )
-            jax.block_until_ready(out)
+            self._warm_splice(staging)
+        else:
+            self._splice_warm_pending = True
         np.asarray(np.asarray(tok).ravel()[:1])  # synchronize
+
+    def _warm_splice(self, staging=None) -> None:
+        """Compile the slot-traced admission splice against the live batch
+        state's shapes (outputs discarded; nothing is donated)."""
+        if staging is None:
+            staging = init_cache_on_mesh(
+                self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
+                quant=self.kv_quant, batch_replicated=True,
+            )
+        n_hist = self.settings.repeat_last_n
+        out = self._splice_fn()(
+            self.cache, staging, self._keys, self._history,
+            self._hist_slot, self._last_tokens,
+            jax.random.fold_in(self._base_key, 0),
+            jnp.full((n_hist,), -1, jnp.int32), jnp.int32(0),
+            jnp.int32(0), jnp.int32(0),
+        )
+        jax.block_until_ready(out)
 
     def _admission_tick(self) -> None:
         """Advance the in-flight admission by one chunk dispatch (or start

@@ -56,57 +56,98 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
         quantize_linear4,
     )
 
+    from cake_tpu.ops.pallas.quant import _pick_block
+
     compiled = not interpret_default()
     dev = jax.devices()[0]
     sys.stderr.write(f"device={dev.device_kind} compiled={compiled} m={m}\n")
     key = jax.random.PRNGKey(0)
     results = []
+    out_f = open(json_out, "w") if json_out else None
 
+    def emit(rec):
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+        if out_f:
+            out_f.write(json.dumps(rec) + "\n")
+            out_f.flush()
+
+    # The timed loop's data-dependence fold must be shape-agnostic: the
+    # default chain adds the (m, n) output into the (m, k) activation,
+    # which only broadcasts when n == k — a scalar fold works everywhere.
+    def chain(out, a0):
+        return a0 + (out.ravel()[0] * 1e-30).astype(a0.dtype)
+
+    # bf16 is the decode activation dtype of record; interpret mode (the
+    # CPU smoke path) hits an interpreter bf16-in-scan limitation, so it
+    # smokes in f32 — the real measurement is compiled-on-TPU either way.
+    act_dt = jnp.bfloat16 if compiled else jnp.float32
     for k, n in SHAPES_8B:
         kx, kw = jax.random.split(jax.random.fold_in(key, k * n))
-        x = jax.random.normal(kx, (m, k), jnp.bfloat16)
+        x = jax.random.normal(kx, (m, k), act_dt)
         w = jax.random.normal(kw, (k, n), jnp.float32) / jnp.sqrt(k)
         q4 = quantize_linear4(w)
         q8 = quantize_linear(w)
         packed_mb = q4.qp.size / 1e6  # int8 bytes holding two nibbles each
 
         # baselines: the XLA unpack fallback and the int8 kernel byte rate
-        xla_ms = _time_ms(
-            jax.jit(quant4_matmul_xla), x, q4.qp, q4.scale
-        )
-        results.append(dict(k=k, n=n, variant="xla", block_n=0, block_k=0,
-                            ms=xla_ms, gbps=packed_mb / xla_ms,
-                            speedup_vs_xla=1.0))
-        int8_ms = _time_ms(
-            jax.jit(partial(quant_matmul_pallas, interpret=not compiled)),
-            x, q8.q, q8.scale,
-        )
-        results.append(dict(k=k, n=n, variant="int8_kernel", block_n=0,
-                            block_k=0, ms=int8_ms,
-                            gbps=2 * packed_mb / int8_ms,  # int8 bytes
-                            speedup_vs_xla=xla_ms / int8_ms))
+        try:
+            xla_ms = _time_ms(jax.jit(quant4_matmul_xla), x, q4.qp,
+                              q4.scale, chain=chain)
+            emit(dict(k=k, n=n, variant="xla", block_n=0, block_k=0,
+                      ms=xla_ms, gbps=packed_mb / xla_ms,
+                      speedup_vs_xla=1.0))
+        except Exception as e:
+            sys.stderr.write(f"  k={k} n={n} xla baseline: "
+                             f"{type(e).__name__}: {str(e)[:120]}\n")
+            xla_ms = None
+        try:
+            int8_ms = _time_ms(
+                jax.jit(partial(quant_matmul_pallas,
+                                interpret=not compiled)),
+                x, q8.q, q8.scale, chain=chain,
+            )
+            emit(dict(k=k, n=n, variant="int8_kernel", block_n=0,
+                      block_k=0, ms=int8_ms,
+                      gbps=2 * packed_mb / int8_ms,  # int8 bytes
+                      speedup_vs_xla=(xla_ms / int8_ms) if xla_ms else 0.0))
+        except Exception as e:
+            sys.stderr.write(f"  k={k} n={n} int8 baseline: "
+                             f"{type(e).__name__}: {str(e)[:120]}\n")
+            int8_ms = None
 
+        # report configs by the blocks that actually EXECUTE: the grid
+        # clamps to power-of-2 divisors (_pick_block), so distinct
+        # requests can collapse; dedupe on the effective pair and disable
+        # the skinny-M widening that would override sub-1024 requests.
+        seen = set()
         for unpack in ("int32", "int16"):
             for bn in (512, 1024, 2048):
                 for bk in (512, 1024, 2048):
                     if bn > n or bk > k // 2:
                         continue
+                    bn_eff = _pick_block(n, bn)
+                    bk_eff = _pick_block(k // 2, bk)
+                    if (unpack, bn_eff, bk_eff) in seen:
+                        continue
+                    seen.add((unpack, bn_eff, bk_eff))
                     fn = jax.jit(partial(
-                        quant4_matmul_pallas, block_n=bn, block_k=bk,
-                        unpack=unpack, interpret=not compiled,
+                        quant4_matmul_pallas, block_n=bn_eff,
+                        block_k=bk_eff, unpack=unpack, skinny_widen=False,
+                        interpret=not compiled,
                     ))
                     try:
-                        ms = _time_ms(fn, x, q4.qp, q4.scale)
+                        ms = _time_ms(fn, x, q4.qp, q4.scale, chain=chain)
                     except Exception as e:  # Mosaic lowering edge: record
                         sys.stderr.write(
-                            f"  k={k} n={n} {unpack} bn={bn} bk={bk}: "
+                            f"  k={k} n={n} {unpack} bn={bn_eff} "
+                            f"bk={bk_eff}: "
                             f"{type(e).__name__}: {str(e)[:120]}\n")
                         continue
-                    rec = dict(k=k, n=n, variant=unpack, block_n=bn,
-                               block_k=bk, ms=ms, gbps=packed_mb / ms,
-                               speedup_vs_xla=xla_ms / ms)
-                    results.append(rec)
-                    print(json.dumps(rec), flush=True)
+                    emit(dict(k=k, n=n, variant=unpack, block_n=bn_eff,
+                              block_k=bk_eff, ms=ms, gbps=packed_mb / ms,
+                              speedup_vs_xla=(xla_ms / ms) if xla_ms
+                              else 0.0))
 
         best = max((r for r in results if r["k"] == k and r["n"] == n
                     and r["variant"] in ("int32", "int16")),
@@ -115,13 +156,14 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
             sys.stderr.write(
                 f"shape {k}x{n}: best {best['variant']} "
                 f"bn={best['block_n']} bk={best['block_k']} "
-                f"{best['gbps']:.0f} GB/s (xla {packed_mb / xla_ms:.0f}, "
-                f"int8 kernel {2 * packed_mb / int8_ms:.0f} int8-GB/s)\n")
+                f"{best['gbps']:.0f} GB/s"
+                + (f" (xla {packed_mb / xla_ms:.0f}" if xla_ms else " (")
+                + (f", int8 kernel {2 * packed_mb / int8_ms:.0f} int8-GB/s)"
+                   if int8_ms else ")")
+                + "\n")
 
-    if json_out:
-        with open(json_out, "w") as f:
-            for r in results:
-                f.write(json.dumps(r) + "\n")
+    if out_f:
+        out_f.close()
     return results
 
 

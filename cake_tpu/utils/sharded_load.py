@@ -152,12 +152,10 @@ def load_llama_params_on_mesh(
 
     reader = CheckpointReader(model_dir)
     num_experts, attention_bias, o_bias = detect_family(reader.name_to_file)
-    if num_experts and tier is not None:
-        raise NotImplementedError(
-            "quantized MoE expert stacks are not wired on the direct-to-mesh "
-            "path yet; int8 MoE loads via the host path "
-            "(utils.weights.load_llama_params + mesh.shard_params)"
-        )
+    if num_experts and int4:
+        from cake_tpu.ops.quant import reject_int4_moe
+
+        reject_int4_moe()
     prequantized = check_prequantized(reader.name_to_file, quantize)
     # Grouped int4 (the accuracy tier): the direct-to-mesh path supports it
     # for PRE-QUANTIZED checkpoints (stored [ngroups, out] scales slice
@@ -402,16 +400,85 @@ def load_llama_params_on_mesh(
 
                 return cb
 
+            def expert_quant_q_cb(pattern, row_parallel):
+                """Expert int8 bytes [L', E', rows, cols] — same
+                shard-local-exactness rules as the dense linears: column-
+                parallel quantizes the column slice directly (scale needs
+                only the full in-axis, present per shard); row-parallel
+                reads the full in-axis once per (layer, expert) for the
+                memoized scale, then only its own rows."""
+                def cb(index):
+                    lsl, esl, rsl, csl = index
+                    lo, hi, _ = lsl.indices(L)
+                    e_lo, e_hi, _ = esl.indices(num_experts)
+                    per = []
+                    for i in range(lo, hi):
+                        rows_e = []
+                        for e in range(e_lo, e_hi):
+                            name = (f"model.layers.{i}."
+                                    f"{pattern.format(e=e)}")
+                            if prequantized:
+                                rows_e.append(reader.read2d(
+                                    f"{name}{qsuffix}", rsl, csl, True))
+                            elif row_parallel:
+                                s = _scale(name, True, csl)
+                                w = reader.read2d(name, rsl, csl, True)
+                                rows_e.append(np.clip(
+                                    np.round(np.asarray(w, np.float32) / s),
+                                    -qmax, qmax).astype(np.int8))
+                            else:
+                                q, s = np_qfn(
+                                    reader.read2d(name, rsl, csl, True))
+                                scale_memo.setdefault(_key(name, csl), s)
+                                rows_e.append(q)
+                        per.append(np.stack(rows_e))
+                    return np.stack(per)
+
+                return cb
+
+            def expert_scale_cb(pattern):
+                def cb(index):
+                    lsl, esl, csl = index
+                    lo, hi, _ = lsl.indices(L)
+                    e_lo, e_hi, _ = esl.indices(num_experts)
+                    per = []
+                    for i in range(lo, hi):
+                        rows_e = []
+                        for e in range(e_lo, e_hi):
+                            name = (f"model.layers.{i}."
+                                    f"{pattern.format(e=e)}")
+                            if prequantized:
+                                rows_e.append(
+                                    reader.read1d(f"{name}.scale", csl))
+                            else:
+                                rows_e.append(_scale(name, True, csl))
+                        per.append(np.stack(rows_e))
+                    return np.stack(per)
+
+                return cb
+
             fdim = config.intermediate_size
             for ours, (din, dout, spec) in {
                 "w_gate": (h, fdim, P(STAGE, EP, None, TP)),
                 "w_up": (h, fdim, P(STAGE, EP, None, TP)),
                 "w_down": (fdim, h, P(STAGE, EP, TP, None)),
             }.items():
-                layers[ours] = _assemble(
-                    (L, num_experts, din, dout), mesh, spec,
-                    expert_cb(_MOE_EXPERT_MAP[ours]),
-                )
+                pattern = _MOE_EXPERT_MAP[ours]
+                if tier == "int8":
+                    row_par = ours == "w_down"
+                    scale_spec = (P(STAGE, EP, None) if row_par
+                                  else P(STAGE, EP, TP))
+                    layers[ours] = qcls(
+                        _assemble((L, num_experts, din, dout), mesh, spec,
+                                  expert_quant_q_cb(pattern, row_par)),
+                        _assemble((L, num_experts, dout), mesh, scale_spec,
+                                  expert_scale_cb(pattern)),
+                    )
+                else:
+                    layers[ours] = _assemble(
+                        (L, num_experts, din, dout), mesh, spec,
+                        expert_cb(pattern),
+                    )
 
         embed_name = "model.embed_tokens.weight"
         head_name = embed_name if tie_word_embeddings else "lm_head.weight"

@@ -297,3 +297,41 @@ def test_quantize_model_moe_int8_round_trip(tmp_path):
                                                    np.asarray(b)),
         pre, onfly,
     )
+
+
+def test_family_sharded_load_int8_moe_matches_host(tmp_path):
+    """Direct-to-mesh int8 MoE: quantize-on-load expert stacks (and a
+    pre-quantized .q8 checkpoint) equal host-load + shard_params bit for
+    bit, with the expert q/scale leaves genuinely ep-sharded."""
+    from cake_tpu.parallel.mesh import EP, MeshPlan, shard_params
+    from cake_tpu.tools.quantize_model import quantize_checkpoint
+    from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
+
+    cfg = tiny_moe()
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    save_llama_params(params, tmp_path / "src", cfg.num_hidden_layers)
+    plan = MeshPlan.build(cfg, num_stages=2, ep=2)
+
+    want = shard_params(
+        load_llama_params(tmp_path / "src", cfg.num_hidden_layers,
+                          dtype="float32", quantize="int8"),
+        plan.mesh,
+    )
+    got = load_llama_params_on_mesh(tmp_path / "src", cfg, plan.mesh,
+                                    quantize="int8")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        got, want,
+    )
+    assert EP in got["layers"]["w_gate"].q.sharding.spec
+    assert EP in got["layers"]["w_down"].scale.sharding.spec
+
+    # pre-quantized .q8 checkpoint through the same path
+    out = quantize_checkpoint(tmp_path / "src", tmp_path / "q8", bits=8)
+    pre = load_llama_params_on_mesh(out, cfg, plan.mesh, quantize="int8")
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        pre, want,
+    )
