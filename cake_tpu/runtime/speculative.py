@@ -556,6 +556,7 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         max_seq: int | None = None,
         num_stages: int = 1,
         tp: int = 1,
+        ep: int = 1,
         devices=None,
         kv_quant: str | None = None,
         spec_k: int = 8,
@@ -567,7 +568,7 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         settings = settings or SamplerSettings(temperature=0.0)
         super().__init__(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=max_seq,
-                         num_stages=num_stages, tp=tp, sp=1,
+                         num_stages=num_stages, tp=tp, sp=1, ep=ep,
                          devices=devices, block_size=1, kv_quant=kv_quant,
                          prefill_chunks=prefill_chunks)
         self._spec_init(spec_k, spec_ngram)

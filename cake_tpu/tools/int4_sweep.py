@@ -110,7 +110,7 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
             emit(dict(k=k, n=n, variant="int8_kernel", block_n=0,
                       block_k=0, ms=int8_ms,
                       gbps=2 * packed_mb / int8_ms,  # int8 bytes
-                      speedup_vs_xla=(xla_ms / int8_ms) if xla_ms else 0.0))
+                      speedup_vs_xla=(xla_ms / int8_ms) if xla_ms else None))
         except Exception as e:
             sys.stderr.write(f"  k={k} n={n} int8 baseline: "
                              f"{type(e).__name__}: {str(e)[:120]}\n")
@@ -147,7 +147,7 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
                     emit(dict(k=k, n=n, variant=unpack, block_n=bn_eff,
                               block_k=bk_eff, ms=ms, gbps=packed_mb / ms,
                               speedup_vs_xla=(xla_ms / ms) if xla_ms
-                              else 0.0))
+                              else None))
 
         best = max((r for r in results if r["k"] == k and r["n"] == n
                     and r["variant"] in ("int32", "int16")),

@@ -310,7 +310,10 @@ def test_family_sharded_load_int8_moe_matches_host(tmp_path):
     cfg = tiny_moe()
     params = llama.init_params(cfg, jax.random.PRNGKey(7))
     save_llama_params(params, tmp_path / "src", cfg.num_hidden_layers)
-    plan = MeshPlan.build(cfg, num_stages=2, ep=2)
+    # tp=2 exercises the expert callbacks' SLICED reads: column-parallel
+    # w_gate/w_up quantize a column slice, row-parallel w_down reads its
+    # row shard against the memoized full-in-axis scale
+    plan = MeshPlan.build(cfg, num_stages=2, ep=2, tp=2)
 
     want = shard_params(
         load_llama_params(tmp_path / "src", cfg.num_hidden_layers,

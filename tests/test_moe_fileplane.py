@@ -1,0 +1,136 @@
+"""Mixtral weight-plane rehearsal at FILE scale.
+
+The MoE twin of tests/test_70b_fileplane.py (the reference's offline
+weight plane is `cake-split-model`, main.rs:144-223): a pre-quantized
+multi-shard int8 MoE checkpoint loads direct-to-mesh over a
+stage=2 x ep=2 mesh, and byte accounting proves
+
+- each ep rank's expert bytes are exactly half the expert payload of its
+  stage (a rank reads ITS experts' bytes, nothing else — the property
+  that makes Mixtral-8x7B's 45 GB of int8 experts splittable 16 ways),
+- the loader reads the checkpoint once (total attributed bytes ~= the
+  stored payload; router/embed/norms memoized to one read despite the
+  4-way mesh).
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+INNER = r"""
+import json, re, time
+from pathlib import Path
+
+import jax
+assert len(jax.devices()) >= 4, jax.devices()
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny_moe
+from cake_tpu.parallel.mesh import MeshPlan
+from cake_tpu.tools.quantize_model import quantize_checkpoint
+from cake_tpu.utils import sharded_load
+from cake_tpu.utils.weights import save_llama_params
+
+E = 4
+cfg = tiny_moe(num_hidden_layers=8, num_local_experts=E, max_seq_len=32)
+root = Path(r"{tmp}")
+bf = root / "bf16"
+params = llama.init_params(cfg, jax.random.PRNGKey(0))
+save_llama_params(params, bf, cfg.num_hidden_layers)
+
+q8 = root / "q8"
+quantize_checkpoint(bf, q8, shard_bytes=1 << 18)  # several shard files
+index = json.loads((q8 / "model.safetensors.index.json").read_text())
+shard_files = sorted(set(index["weight_map"].values()))
+assert len(shard_files) >= 2, shard_files
+payload = index["metadata"]["total_size"]
+
+# attribute reads: expert tensors bucket by (stage, ep-rank); everything
+# else by stage / other
+expert_re = re.compile(
+    r"model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.")
+layer_re = re.compile(r"model\.layers\.(\d+)\.")
+S, EPD = 2, 2
+layers_per = cfg.num_hidden_layers // S
+experts_per = E // EPD
+expert_bytes = [[0] * EPD for _ in range(S)]
+other = [0]
+
+def account(name, nbytes):
+    m = expert_re.match(name)
+    if m:
+        expert_bytes[int(m.group(1)) // layers_per][
+            int(m.group(2)) // experts_per] += nbytes
+        return
+    other[0] += nbytes
+
+orig1, orig2 = (sharded_load.CheckpointReader.read1d,
+                sharded_load.CheckpointReader.read2d)
+
+def read1d(self, name, sl=slice(None)):
+    out = orig1(self, name, sl)
+    account(name, out.nbytes)
+    return out
+
+def read2d(self, name, rows, cols, transpose):
+    out = orig2(self, name, rows, cols, transpose)
+    account(name, out.nbytes)
+    return out
+
+sharded_load.CheckpointReader.read1d = read1d
+sharded_load.CheckpointReader.read2d = read2d
+
+plan = MeshPlan.build(cfg, num_stages=S, ep=EPD,
+                      devices=jax.devices()[: S * EPD])
+t0 = time.perf_counter()
+loaded = sharded_load.load_llama_params_on_mesh(
+    q8, cfg, plan.mesh, quantize="int8")
+for leaf in jax.tree.leaves(loaded):
+    leaf.block_until_ready()
+dt = time.perf_counter() - t0
+
+tot = sum(sum(row) for row in expert_bytes)
+# every (stage, ep-rank) cell reads exactly its 1/(S*EPD) of expert bytes
+for s in range(S):
+    for e in range(EPD):
+        assert expert_bytes[s][e] == tot // (S * EPD), (
+            s, e, expert_bytes, tot)
+# read-once: attributed total ~= stored payload
+grand = tot + other[0]
+assert abs(grand - payload) / payload < 0.05, (grand, payload)
+
+q = loaded["layers"]["w_gate"].q
+assert q.shape[:2] == (cfg.num_hidden_layers, E) and str(q.dtype) == "int8"
+print(json.dumps({
+    "shards": len(shard_files),
+    "payload_bytes": payload,
+    "per_rank_expert_bytes": expert_bytes[0][0],
+    "load_s": round(dt, 3),
+}))
+print("moe fileplane ok")
+"""
+
+
+def test_moe_multishard_q8_load_stage2_ep2(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    env["XLA_FLAGS"] = " ".join(
+        flags + ["--xla_force_host_platform_device_count=4"]
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", INNER.replace("{tmp}", str(tmp_path))],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "moe fileplane ok" in r.stdout
+    stats = json.loads(r.stdout.strip().splitlines()[-2])
+    assert stats["shards"] >= 2
