@@ -116,6 +116,30 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
                              f"{type(e).__name__}: {str(e)[:120]}\n")
             int8_ms = None
 
+        # XLA-native s4: store the quantized values as a jnp.int4 array and
+        # let XLA's own int4 support handle the unpack (TPU XLA carries
+        # hardware-assisted s4 conversion; if it streams packed bytes this
+        # beats any hand-written unpack). Same math as the kernel:
+        # y = (x @ w4) * scale with the convert fused into the dot operand.
+        try:
+            from cake_tpu.ops.quant import unpack_int4
+
+            w4 = jnp.asarray(unpack_int4(q4.qp), jnp.int8).astype(jnp.int4)
+
+            def s4_matmul(x, w4, scale):
+                y = jnp.einsum("mk,kn->mn", x, w4.astype(x.dtype),
+                               preferred_element_type=jnp.float32)
+                return (y * scale).astype(x.dtype)
+
+            s4_ms = _time_ms(jax.jit(s4_matmul), x, w4, q4.scale,
+                             chain=chain)
+            emit(dict(k=k, n=n, variant="xla_s4", block_n=0, block_k=0,
+                      ms=s4_ms, gbps=packed_mb / s4_ms,
+                      speedup_vs_xla=(xla_ms / s4_ms) if xla_ms else None))
+        except Exception as e:
+            sys.stderr.write(f"  k={k} n={n} xla_s4: "
+                             f"{type(e).__name__}: {str(e)[:160]}\n")
+
         # report configs by the blocks that actually EXECUTE: the grid
         # clamps to power-of-2 divisors (_pick_block), so distinct
         # requests can collapse; dedupe on the effective pair and disable
