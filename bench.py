@@ -579,10 +579,16 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     from cake_tpu.runtime.speculative import SpeculativeGenerator
 
     k = int(os.environ.get("CAKE_BENCH_SPEC", "8"))
+    # Fused rounds per host sync (default 8). The w3 on-chip row measured
+    # ~94 ms of math against ~170 ms of tunnel sync RTT per dispatch —
+    # more rounds amortize the RTT further (the knob exists to measure
+    # that curve; on a local chip RTT is ~1 ms and 8 is already enough).
+    rounds = int(os.environ.get("CAKE_BENCH_SPEC_ROUNDS", "8"))
     kv_quant = _kv_quant()
     settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
     gen = SpeculativeGenerator(config, params, settings=settings,
-                               spec_k=k, kv_quant=kv_quant)
+                               spec_k=k, spec_rounds=rounds,
+                               kv_quant=kv_quant)
     prompt = [5, 9, 2, 5, 9, 2, 5, 9]
     gen.set_prompt(prompt)
     gen.next_token(0)  # prefill + compile
