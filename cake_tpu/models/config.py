@@ -62,10 +62,24 @@ class LlamaConfig:
     # MoE (Mixtral): 0 = dense MLP; >0 = routed SwiGLU experts per layer.
     num_local_experts: int = 0
     num_experts_per_tok: int = 2
+    # Explicit per-head width (Gemma: heads * head_dim != hidden_size).
+    # None resolves to hidden_size // num_attention_heads in __post_init__,
+    # so every consumer reads a concrete int.
+    head_dim: int | None = None
+    # Gated-MLP activation: "silu" (SwiGLU — every Llama-family model) or
+    # "gelu_tanh" (GeGLU — Gemma; HF spells it gelu_pytorch_tanh).
+    hidden_act: str = "silu"
+    # Gemma normalization deltas: RMSNorm scales by (1 + w), and the
+    # embedding output is multiplied by sqrt(hidden_size).
+    rms_norm_offset: bool = False
+    embed_scale: bool = False
 
-    @property
-    def head_dim(self) -> int:
-        return self.hidden_size // self.num_attention_heads
+    def __post_init__(self):
+        if self.head_dim is None:
+            object.__setattr__(
+                self, "head_dim",
+                self.hidden_size // self.num_attention_heads,
+            )
 
     @property
     def num_kv_groups(self) -> int:
@@ -96,9 +110,25 @@ class LlamaConfig:
                                "float32": "float32"}.get(td, "bfloat16")
         # Family defaults not spelled out in the HF config dict: Qwen2's
         # q/k/v bias is unconditional in its architecture (the HF config has
-        # no attention_bias key to read).
+        # no attention_bias key to read); Gemma's (1+w) RMSNorm, GeGLU, and
+        # sqrt(hidden) embedding scaling are likewise architectural.
         if d.get("model_type") == "qwen2" and "attention_bias" not in d:
             kwargs["attention_bias"] = True
+        if d.get("model_type") == "gemma":
+            kwargs.setdefault("rms_norm_offset", True)
+            kwargs.setdefault("embed_scale", True)
+            # HF Gemma spells the activation in `hidden_activation` (newer
+            # configs) or `hidden_act`; both default to the tanh gelu
+            act = d.get("hidden_activation") or d.get("hidden_act")
+            if act in (None, "gelu", "gelu_pytorch_tanh"):
+                kwargs["hidden_act"] = "gelu_tanh"
+            else:
+                raise ValueError(f"unsupported gemma activation {act!r}")
+        elif d.get("hidden_act") not in (None, "silu"):
+            raise ValueError(
+                f"unsupported hidden_act {d['hidden_act']!r} for "
+                f"model_type {d.get('model_type')!r}"
+            )
         # Qwen2 configs ship a sliding_window VALUE with the feature gated
         # off (`use_sliding_window: false`); honoring the value alone would
         # force windowed masking (and forfeit the flash kernels) on a model
@@ -143,6 +173,14 @@ class LlamaConfig:
             d.pop("num_experts_per_tok")
         if not d["attention_bias"]:
             d.pop("attention_bias")
+        if d["hidden_act"] == "silu":
+            d.pop("hidden_act")
+        else:  # HF spelling
+            d["hidden_act"] = "gelu_pytorch_tanh"
+        if not d["rms_norm_offset"]:
+            d.pop("rms_norm_offset")
+        if not d["embed_scale"]:
+            d.pop("embed_scale")
         return d
 
 
@@ -241,6 +279,32 @@ def mixtral_8x7b(**overrides) -> LlamaConfig:
         num_experts_per_tok=2,
         bos_token_id=1,
         eos_token_id=2,
+    )
+    base.update(overrides)
+    return LlamaConfig(**base)
+
+
+def gemma_7b(**overrides) -> LlamaConfig:
+    """Gemma-7B: MHA with explicit head_dim 256 (16 x 256 != hidden 3072),
+    GeGLU MLP, (1+w) RMSNorm, sqrt(hidden)-scaled embeddings, tied head —
+    the structurally-different fifth family."""
+    base = dict(
+        model_type="gemma",
+        vocab_size=256000,
+        hidden_size=3072,
+        intermediate_size=24576,
+        num_hidden_layers=28,
+        num_attention_heads=16,
+        num_key_value_heads=16,
+        head_dim=256,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        hidden_act="gelu_tanh",
+        rms_norm_offset=True,
+        embed_scale=True,
+        tie_word_embeddings=True,
+        bos_token_id=2,
+        eos_token_id=1,
     )
     base.update(overrides)
     return LlamaConfig(**base)

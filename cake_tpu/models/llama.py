@@ -217,6 +217,18 @@ def _init_params_quantized(config, key, dtype, *, bits: int) -> Params:
     }
 
 
+def embed_tokens(params: Params, tokens, config: LlamaConfig) -> jax.Array:
+    """Token embedding lookup — THE embedding entry point for every
+    execution path (local, pipeline builders, admission, speculation).
+    Gemma multiplies the embedding output by sqrt(hidden) (``embed_scale``),
+    with the normalizer rounded to the activation dtype exactly as HF does,
+    so family deltas cannot drift between paths."""
+    x = params["embed"][tokens].astype(config.jax_dtype)
+    if config.embed_scale:
+        x = x * jnp.asarray(config.hidden_size ** 0.5, config.jax_dtype)
+    return x
+
+
 def block_forward(
     layer: Params,  # one layer's weights (no leading L axis)
     x: jax.Array,  # [B, T, hidden]
@@ -253,7 +265,8 @@ def block_forward(
     MLP (Mixtral) are used iff present; ``config.sliding_window`` (Mistral)
     narrows the causal mask.
     """
-    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    h = rms_norm(x, layer["attn_norm"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     attn_out, k_cache, v_cache = self_attention_block(
         h, layer["wq"], layer["wk"], layer["wv"], layer["wo"],
         k_cache, v_cache, cos, sin, pos,
@@ -271,7 +284,8 @@ def block_forward(
         window=config.sliding_window,
     )
     x = x + attn_out
-    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    h = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     if "router" in layer:
         x = x + moe_swiglu(
             h, layer["router"], layer["w_gate"], layer["w_up"],
@@ -280,7 +294,7 @@ def block_forward(
         )
     else:
         x = x + swiglu(h, layer["w_gate"], layer["w_up"], layer["w_down"],
-                       tp_axis=tp_axis)
+                       tp_axis=tp_axis, act=config.hidden_act)
     return x, k_cache, v_cache
 
 
@@ -338,9 +352,10 @@ def forward(
     """
     cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
                            scaling=config.rope_scaling)
-    x = params["embed"][tokens].astype(config.jax_dtype)
+    x = embed_tokens(params, tokens, config)
     x, cache = forward_layers(params["layers"], x, cache, cos, sin, pos, config)
-    x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
+    x = rms_norm(x, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     x_last = x[:, -1, :]
     logits = quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
     return logits, cache

@@ -14,17 +14,28 @@ import jax.numpy as jnp
 from cake_tpu.ops.quant import dense
 
 
+def _gelu_tanh(x: jax.Array) -> jax.Array:
+    """torch's ``gelu(approximate='tanh')`` — the GeGLU gate (Gemma)."""
+    return jax.nn.gelu(x, approximate=True)
+
+
+_ACTS = {"silu": jax.nn.silu, "gelu_tanh": _gelu_tanh}
+
+
 def swiglu(
     x: jax.Array,
     w_gate: jax.Array,
     w_up: jax.Array,
     w_down: jax.Array,
     tp_axis: str | None = None,
+    act: str = "silu",
 ) -> jax.Array:
     """``tp_axis``: inside shard_map with the intermediate dim sharded over a
     tensor-parallel axis (column-parallel gate/up, row-parallel down), the
-    down-proj partial sums are psum-reduced over that axis."""
-    out = dense(jax.nn.silu(dense(x, w_gate)) * dense(x, w_up), w_down)
+    down-proj partial sums are psum-reduced over that axis. ``act`` selects
+    the gate activation (``config.hidden_act``): silu = SwiGLU (every
+    Llama-family model), gelu_tanh = GeGLU (Gemma)."""
+    out = dense(_ACTS[act](dense(x, w_gate)) * dense(x, w_up), w_down)
     if tp_axis is not None:
         out = jax.lax.psum(out, tp_axis)
     return out

@@ -12,9 +12,16 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """``x * rsqrt(mean(x^2) + eps) * weight`` over the last axis."""
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             offset: bool = False) -> jax.Array:
+    """``x * rsqrt(mean(x^2) + eps) * weight`` over the last axis.
+
+    ``offset=True`` scales by ``(1 + weight)`` instead — the Gemma-family
+    convention (its checkpoints store the scale centered at zero)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if offset:
+        w = 1.0 + w
+    return (normed * w).astype(x.dtype)

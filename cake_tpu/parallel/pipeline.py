@@ -205,7 +205,8 @@ def _select_last_sp(x: jax.Array, last_index: jax.Array, sp: int) -> jax.Array:
 
 def _head_logits(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
     """ln_f + vocab-sharded lm_head; full logits gathered over tp."""
-    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
+    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     logits_local = quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
     return jax.lax.all_gather(logits_local, TP, axis=-1, tiled=True)
 
@@ -264,7 +265,7 @@ def build_sharded_decode(
             config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
-        x = params["embed"][token[:, None]].astype(config.jax_dtype)
+        x = llama.embed_tokens(params, token[:, None], config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
             plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
@@ -478,7 +479,8 @@ def build_interleaved_decode(
 
             # ---- head + sample (uniform on every device) ----
             x_fin = _select_stage0(x[:, -1, :])  # [bm, H]
-            x_n = rms_norm(x_fin, params["norm_f"], config.rms_norm_eps)
+            x_n = rms_norm(x_fin, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
             logits = head_logits(x_n)            # [bm, V] f32
             key_rows = jax.lax.dynamic_slice_in_dim(keys, base_fin, bm, 0)
             idx_rows = jax.lax.dynamic_slice_in_dim(index0, base_fin, bm, 0)
@@ -512,7 +514,7 @@ def build_interleaved_decode(
             # the just-sampled token thereafter
             tok_rows = jax.lax.dynamic_slice_in_dim(token, base_fin, bm, 0)
             tok_inj = jnp.where(arriving, sampled, tok_rows)
-            x_inj = params["embed"][tok_inj[:, None]].astype(config.jax_dtype)
+            x_inj = llama.embed_tokens(params, tok_inj[:, None], config)
             x = jnp.where((my_stage == 0) & injecting, x_inj, x)
 
             # ---- layer pass on this stage's resident microbatch ----
@@ -602,7 +604,7 @@ def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
             config.head_dim, cache.max_seq, config.rope_theta,
             scaling=config.rope_scaling,
         )
-        x = params["embed"][tokens].astype(config.jax_dtype)
+        x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos0, config,
             plan.num_stages, heads_l, kv_heads_l,
@@ -653,7 +655,7 @@ def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
             config.head_dim, cache.max_seq, config.rope_theta,
             scaling=config.rope_scaling,
         )
-        x = params["embed"][tokens].astype(config.jax_dtype)
+        x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
             plan.num_stages, heads_l, kv_heads_l,
@@ -703,13 +705,14 @@ def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
             config.head_dim, cache.max_seq, config.rope_theta,
             scaling=config.rope_scaling,
         )
-        x = params["embed"][tokens].astype(config.jax_dtype)
+        x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
             plan.num_stages, heads_l, kv_heads_l,
         )
         x = _select_stage0(x)  # [B, T, hidden], valid on stage 0
-        x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
+        x = rms_norm(x, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
         logits = quant.dense(x, params["lm_head"]).astype(jnp.float32)
         logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
         return logits, KVCache(k=ck, v=cv)
@@ -772,7 +775,7 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
         )
         my_stage = jax.lax.axis_index(STAGE)
         perm = [(i, (i + 1) % S) for i in range(S)]
-        x_all = params["embed"][tokens].astype(config.jax_dtype)  # [B,T,H]
+        x_all = llama.embed_tokens(params, tokens, config)  # [B,T,H]
 
         def body(c_t, carry):
             x, ck, cv, y = carry
@@ -821,7 +824,8 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
         # each stage reads V/S of the lm_head instead of all of it
         y = jax.lax.psum(
             jnp.where(my_stage == S - 1, y, jnp.zeros_like(y)), STAGE)
-        y = rms_norm(y, params["norm_f"], config.rms_norm_eps)
+        y = rms_norm(y, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
         hw = params["lm_head"]
         if S > 1 and _head_split_safe(hw, S):
             logits = quant.dense(y, _head_chunk(hw, my_stage, S)).astype(
@@ -899,7 +903,7 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
-        x = params["embed"][tokens].astype(config.jax_dtype)
+        x = llama.embed_tokens(params, tokens, config)
         if microbatch > 1:
             b, t = tokens.shape
             if t % microbatch:

@@ -59,7 +59,8 @@ def _bucket(n: int, max_seq: int, floor: int = 16) -> int:
 
 
 def _lm_head(params, x_last: jax.Array, config: LlamaConfig) -> jax.Array:
-    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps)
+    x_last = rms_norm(x_last, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     return quant.dense(x_last, params["lm_head"]).astype(jnp.float32)
 
 
@@ -68,7 +69,7 @@ def prefill_fn(params, tokens, cache: KVCache, last_index, config: LlamaConfig):
     (the last *real* prompt position). Returns (logits [B, vocab], cache)."""
     cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
                            scaling=config.rope_scaling)
-    x = params["embed"][tokens].astype(config.jax_dtype)
+    x = llama.embed_tokens(params, tokens, config)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, 0, config)
     x_last = jnp.take_along_axis(
         x, last_index.reshape(-1, 1, 1).astype(jnp.int32), axis=1
@@ -90,7 +91,7 @@ def decode_step_fn(
     """One fused decode step: forward one token + sample the next."""
     cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
                            scaling=config.rope_scaling)
-    x = params["embed"][token[:, None]].astype(config.jax_dtype)
+    x = llama.embed_tokens(params, token[:, None], config)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, pos, config)
     logits = _lm_head(params, x[:, -1, :], config)
     next_tok = sampling.sample_token(logits[0], key, history, settings)

@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops import sampling
 from cake_tpu.ops.sampling import SamplerSettings
@@ -119,10 +120,11 @@ class DistributedGenerator(GeneratorBase):
 
     # -- forward across runners --------------------------------------------
     def _forward(self, tokens: list[int], pos: int, last_index: int) -> jax.Array:
+        # through the shared embedding entry point so family deltas (Gemma's
+        # sqrt(hidden) embed scaling) hold on the distributed path too
         x = np.asarray(
-            self.embed[jnp.asarray([tokens], jnp.int32)].astype(
-                self.config.jax_dtype
-            )
+            llama.embed_tokens({"embed": self.embed},
+                               jnp.asarray([tokens], jnp.int32), self.config)
         )
         for i, runner in enumerate(self.runners):
             t0 = time.perf_counter()

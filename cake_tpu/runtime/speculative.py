@@ -69,10 +69,11 @@ def _verify_forward(params, tokens, cache: KVCache, pos, cos, sin,
     """The verification forward shared by :func:`verify_fn` (host loop) and
     :func:`spec_rounds_fn` (fused) — ONE definition so the fused path can
     never drift from the host-loop oracle the bit-identity tests pin."""
-    x = params["embed"][tokens].astype(config.jax_dtype)
+    x = llama.embed_tokens(params, tokens, config)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin,
                                     pos, config)
-    x = rms_norm(x, params["norm_f"], config.rms_norm_eps)
+    x = rms_norm(x, params["norm_f"], config.rms_norm_eps,
+                   offset=config.rms_norm_offset)
     logits = quant.dense(x[0], params["lm_head"]).astype(jnp.float32)
     return logits, cache
 

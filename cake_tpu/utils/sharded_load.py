@@ -152,6 +152,14 @@ def load_llama_params_on_mesh(
 
     reader = CheckpointReader(model_dir)
     num_experts, attention_bias, o_bias = detect_family(reader.name_to_file)
+    # tied-head auto-detection, same rule as load_llama_params: no stored
+    # lm_head.weight (plain OR pre-quantized .q8/.q4) -> the head can only
+    # be the embedding
+    if (not tie_word_embeddings
+            and not any(n in reader.name_to_file for n in (
+                "lm_head.weight", "lm_head.weight.q8",
+                "lm_head.weight.q4"))):
+        tie_word_embeddings = True
     if num_experts and int4:
         from cake_tpu.ops.quant import reject_int4_moe
 
@@ -186,7 +194,7 @@ def load_llama_params_on_mesh(
     dt = _np_dtype(config.dtype)
     L = config.num_hidden_layers
     h = config.hidden_size
-    d = h // config.num_attention_heads
+    d = config.head_dim  # explicit per-head width (Gemma: heads*d != h)
     shapes = {
         "attn_norm": (L, h),
         "wq": (L, h, config.num_attention_heads * d),

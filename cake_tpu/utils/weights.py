@@ -357,6 +357,16 @@ def load_llama_params(
         attention_bias = det_bias
     if o_bias is None:
         o_bias = det_o
+    # Tied-head auto-detection: a checkpoint with no stored lm_head.weight
+    # (Gemma, Llama-3.2-1B, Qwen2-small) can ONLY be tied — honoring the
+    # flag alone lets a call site that forgot it crash on the missing key.
+    # Pre-quantized checkpoints store the head as lm_head.weight.q8/.q4
+    # (+ .scale), so those names count as a stored head too.
+    if (include_head and not tie_word_embeddings
+            and not any(n in name_to_file for n in (
+                "lm_head.weight", "lm_head.weight.q8",
+                "lm_head.weight.q4"))):
+        tie_word_embeddings = True
     handles: dict[Path, object] = {}
 
     def get(name: str) -> np.ndarray:

@@ -338,3 +338,162 @@ def test_family_sharded_load_int8_moe_matches_host(tmp_path):
                                                    np.asarray(b)),
         pre, want,
     )
+
+
+def test_gemma_parity():
+    """Gemma: explicit head_dim (heads x head_dim != hidden), GeGLU,
+    (1+w) RMSNorm, sqrt(hidden)-scaled embeddings, tied head — the
+    structurally different family, held to the same HF golden bar."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=256, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16,  # 4 x 16 = 64 != hidden 48
+        max_position_embeddings=128, rms_norm_eps=1e-6, rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh", tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = transformers.GemmaForCausalLM(hf_cfg).eval()
+    cfg = LlamaConfig.from_hf_dict(hf_cfg.to_dict(), dtype="float32",
+                                   max_seq_len=128)
+    assert cfg.model_type == "gemma"
+    assert cfg.head_dim == 16 and cfg.hidden_act == "gelu_tanh"
+    assert cfg.rms_norm_offset and cfg.embed_scale and cfg.tie_word_embeddings
+    _parity_prefill_then_decode(model, cfg)
+
+
+def test_gemma_config_round_trip():
+    from cake_tpu.models.config import gemma_7b
+
+    cfg = gemma_7b(max_seq_len=64)
+    again = LlamaConfig.from_hf_dict(cfg.to_hf_dict(), dtype=cfg.dtype,
+                                     max_seq_len=64)
+    assert again == cfg
+    # a non-default head_dim survives the round trip explicitly
+    assert again.head_dim == 256
+
+
+def test_gemma_mesh_parity():
+    """Gemma over the mesh pipeline (stage x tp): token-identical to the
+    all-local stream — the embed scaling / norm offset / GeGLU deltas ride
+    the one shared code path, so sharding cannot diverge from local."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+    from cake_tpu.runtime.mesh_generator import MeshGenerator
+
+    cfg = tiny(model_type="gemma", hidden_act="gelu_tanh",
+               rms_norm_offset=True, embed_scale=True, head_dim=8,
+               max_seq_len=64)
+    assert cfg.head_dim == 8  # explicit, != hidden/heads = 16
+    params = llama.init_params(cfg, jax.random.PRNGKey(11))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    ref = LlamaGenerator(cfg, params, settings=settings)
+    ref.set_prompt([5, 9, 2, 11])
+    want = [ref.next_token(i).id for i in range(6)]
+
+    g = MeshGenerator(cfg, params, settings=settings, num_stages=2, tp=2)
+    g.set_prompt([5, 9, 2, 11])
+    assert [g.next_token(i).id for i in range(6)] == want
+
+
+def test_tied_head_auto_detected(tmp_path):
+    """A checkpoint with no stored lm_head.weight (Gemma/Llama-3.2-1B
+    style) can only be tied — both loaders must detect that instead of
+    KeyError-ing when a call site forgets the flag (CLI repro)."""
+    from safetensors.numpy import save_file
+
+    from cake_tpu.parallel.mesh import MeshPlan
+    from cake_tpu.utils.sharded_load import load_llama_params_on_mesh
+    from cake_tpu.utils.weights import _LAYER_MAP
+
+    cfg = tiny(model_type="gemma", hidden_act="gelu_tanh",
+               rms_norm_offset=True, embed_scale=True, head_dim=8,
+               max_seq_len=64, tie_word_embeddings=True)
+    p = llama.init_params(cfg, jax.random.PRNGKey(2))
+    tensors = {
+        "model.embed_tokens.weight": np.asarray(p["embed"], np.float32),
+        "model.norm.weight": np.asarray(p["norm_f"], np.float32),
+    }
+    for ours, (suffix, transpose) in _LAYER_MAP.items():
+        st = np.asarray(p["layers"][ours], np.float32)
+        for i in range(cfg.num_hidden_layers):
+            w = st[i]
+            tensors[f"model.layers.{i}.{suffix}"] = np.ascontiguousarray(
+                w.T if transpose else w)
+    save_file(tensors, tmp_path / "model.safetensors")
+    (tmp_path / "model.safetensors.index.json").write_text(
+        __import__("json").dumps({"metadata": {"total_size": 0},
+                                  "weight_map": {k: "model.safetensors"
+                                                 for k in tensors}}))
+
+    # the flag is NOT passed: detection must kick in on both loaders
+    host = load_llama_params(tmp_path, cfg.num_hidden_layers,
+                             dtype="float32")
+    np.testing.assert_array_equal(np.asarray(host["lm_head"]),
+                                  np.asarray(host["embed"]).T)
+    plan = MeshPlan.build(cfg, num_stages=2, tp=2)
+    mesh_p = load_llama_params_on_mesh(tmp_path, cfg, plan.mesh)
+    # head_dim != hidden//heads flows through the mesh loader's shapes
+    assert mesh_p["layers"]["wq"].shape == (
+        cfg.num_hidden_layers, cfg.hidden_size,
+        cfg.num_attention_heads * 8)
+    np.testing.assert_array_equal(np.asarray(mesh_p["lm_head"]),
+                                  np.asarray(host["lm_head"]))
+
+
+def test_gemma_distributed_worker_parity():
+    """The TCP master/worker path must apply the Gemma embed scaling too
+    (review repro: the master's raw embed lookup skipped it)."""
+    from cake_tpu.parallel.topology import Topology
+    from cake_tpu.runtime.master import DistributedGenerator, build_runners
+    from cake_tpu.runtime.worker import Worker
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    cfg = tiny(model_type="gemma", hidden_act="gelu_tanh",
+               rms_norm_offset=True, embed_scale=True, head_dim=8,
+               max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(4))
+
+    def loader(lo, hi):
+        return jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+    w = Worker("w", cfg,
+               Topology.from_dict({"w": {"layers": ["model.layers.2-3"]}}),
+               loader, address="127.0.0.1:0", max_seq=cfg.max_seq_len)
+    w.serve_in_background()
+    try:
+        topo = Topology.from_dict({
+            "w": {"host": f"127.0.0.1:{w.port}",
+                  "layers": ["model.layers.2-3"]},
+        })
+        settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+        runners = build_runners(cfg, topo, loader)
+        head = {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+        g = DistributedGenerator(cfg, head, runners, settings=settings)
+        g.set_prompt([5, 9, 2])
+        got = [g.next_token(i).id for i in range(6)]
+        ref = LlamaGenerator(cfg, params, settings=settings)
+        ref.set_prompt([5, 9, 2])
+        assert got == [ref.next_token(i).id for i in range(6)]
+        g.close()
+    finally:
+        w.shutdown()
+
+
+def test_prequantized_untied_head_not_falsely_tied(tmp_path):
+    """Pre-quantized untied checkpoints store the head as
+    lm_head.weight.q8 — the tied-head probe must count that as a stored
+    head (review repro: it falsely tied and served embedding logits)."""
+    from cake_tpu.ops.quant import quantize_params
+    from cake_tpu.tools.quantize_model import quantize_checkpoint
+
+    cfg = tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(6))
+    save_llama_params(params, tmp_path / "src", cfg.num_hidden_layers)
+    out = quantize_checkpoint(tmp_path / "src", tmp_path / "q8", bits=8)
+    loaded = load_llama_params(out, cfg.num_hidden_layers, dtype="float32",
+                               quantize="int8")
+    want = quantize_params(params, bits=8)
+    np.testing.assert_array_equal(np.asarray(loaded["lm_head"].q),
+                                  np.asarray(want["lm_head"].q))
