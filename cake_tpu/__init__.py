@@ -11,7 +11,11 @@ __version__ = "0.1.0"
 
 from cake_tpu.models.config import (  # noqa: F401
     LlamaConfig,
+    gemma_7b,
     llama2_7b,
     llama3_8b,
     llama3_70b,
+    mistral_7b,
+    mixtral_8x7b,
+    qwen2_7b,
 )

@@ -80,6 +80,19 @@ class LlamaConfig:
                 self, "head_dim",
                 self.hidden_size // self.num_attention_heads,
             )
+        # validate at construction, not as a KeyError deep in a jit trace
+        if self.hidden_act not in ("silu", "gelu_tanh"):
+            raise ValueError(
+                f"hidden_act must be 'silu' or 'gelu_tanh', got "
+                f"{self.hidden_act!r} (HF's 'gelu_pytorch_tanh' maps to "
+                "'gelu_tanh' via from_hf_dict)"
+            )
+        if self.num_local_experts and self.hidden_act != "silu":
+            raise ValueError(
+                "MoE expert MLPs are SwiGLU-only (ops/moe.py has no "
+                "activation plumbing); hidden_act must be 'silu' when "
+                "num_local_experts > 0"
+            )
 
     @property
     def num_kv_groups(self) -> int:

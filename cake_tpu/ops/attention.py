@@ -118,21 +118,33 @@ def attend(
     flash decode kernel, T>1 per-row routes to XLA).
 
     ``window``: sliding-window attention — key positions more than
-    ``window`` behind the query are masked out. Served by the XLA path only
-    (the flash kernels don't fold the lower bound into their block sweep);
-    ``auto`` dispatches accordingly and an explicit ``impl="flash"`` raises
-    rather than silently attending over the full history.
+    ``window`` behind the query are masked out. Prefill rides the flash
+    kernel at the measured crossover (the lower bound is folded into its
+    block sweep: out-of-window KV blocks are neither fetched nor
+    computed); decode and per-row stay on XLA (the decode kernel's
+    frontier sweep has no lower bound), and an explicit ``impl="flash"``
+    there raises rather than silently attending over the full history.
     """
     t, d = q.shape[2], q.shape[3]
     s = k_all.shape[2]
     per_row = jnp.asarray(pos).ndim == 1
     if window is not None:
+        # Windowed PREFILL rides the flash kernel (the lower bound is
+        # folded into its block sweep — KV blocks outside the window are
+        # never fetched); windowed decode and per-row stay on XLA (the
+        # decode kernel's frontier sweep has no lower bound).
+        if t == 1 or per_row:
+            if impl == "flash":
+                raise ValueError(
+                    "flash decode does not implement sliding-window "
+                    "masking; use impl='auto'/'xla' with window="
+                )
+            impl = "xla"
+        elif impl == "auto":
+            impl = _flash_prefill_choice(t, s, d)
         if impl == "flash":
-            raise ValueError(
-                "flash kernels do not implement sliding-window masking; "
-                "use impl='auto'/'xla' with window="
-            )
-        impl = "xla"
+            return pk.flash_attention(q, k_all, v_all, pos, window=window)
+        return _attend_xla(q, k_all, v_all, pos, window=window)
     if per_row and t > 1 and impl != "xla":
         impl = "xla"  # per-row prefill: XLA only (not a served path)
     if impl == "auto":

@@ -65,6 +65,7 @@ def _prefill_kernel(
     block_k: int,
     scale: float,
     num_kv_blocks: int,
+    window: int | None = None,
 ):
     qb = pl.program_id(2)
     kb = pl.program_id(3)
@@ -78,8 +79,17 @@ def _prefill_kernel(
 
     # Last kv block index visible to any row of this q block.
     max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
+    if window is None:
+        live = kb <= max_kb
+    else:
+        # Sliding window (Mistral): blocks entirely below the q block's
+        # lowest valid key position are skipped — the block sweep is
+        # window-proportional, not history-proportional.
+        lo = jnp.maximum(0, pos + qb * block_q - window + 1)
+        min_kb = jax.lax.div(lo, block_k)
+        live = (kb >= min_kb) & (kb <= max_kb)
 
-    @pl.when(kb <= max_kb)
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0]  # [BQ, D]
         k = k_ref[0, 0]  # [BK, D]
@@ -97,7 +107,10 @@ def _prefill_kernel(
         kpos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= kpos > qpos - window
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]  # [BQ, LANES]
         l_prev = l_ref[:]
@@ -125,6 +138,7 @@ def flash_attention(
     *,
     block_q: int = 512,
     block_k: int | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal flash attention over a fixed KV buffer. Returns [B, H, T, D].
@@ -133,6 +147,11 @@ def flash_attention(
     throughout; bk=1024 once the KV buffer is long enough to amortize the
     bigger fetch (S >= 4096 — 1.5x faster there than bk=512), bk=512 below
     (where bk=1024 loses ~35%).
+
+    ``window``: sliding-window attention (Mistral) — the lower mask bound
+    is folded into the block sweep, so KV blocks entirely outside the
+    window are neither fetched nor computed (the XLA fallback sweeps and
+    masks the whole history instead).
     """
     b, h, t, d = q.shape
     kvh, s = k_all.shape[1], k_all.shape[2]
@@ -153,10 +172,15 @@ def flash_attention(
         return (bi, hi, qb, 0)
 
     def kv_map(bi, hi, qb, kb, pos_ref):
-        # Clamp to the causal frontier: fully-masked blocks re-use the
-        # previous block index, so the pipeline skips their HBM fetch.
+        # Clamp to the causal frontier (and, windowed, to the window's
+        # lower bound): fully-masked blocks re-use a live block index, so
+        # the pipeline skips their HBM fetch.
         max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
-        return (bi, hi // group, jnp.minimum(kb, max_kb), 0)
+        idx = jnp.minimum(kb, max_kb)
+        if window is not None:
+            lo = jnp.maximum(0, pos_ref[0] + qb * bq - window + 1)
+            idx = jnp.maximum(idx, jax.lax.div(lo, bk))
+        return (bi, hi // group, idx, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -174,7 +198,8 @@ def flash_attention(
         ],
     )
     kernel = functools.partial(
-        _prefill_kernel, block_q=bq, block_k=bk, scale=scale, num_kv_blocks=nk
+        _prefill_kernel, block_q=bq, block_k=bk, scale=scale,
+        num_kv_blocks=nk, window=window,
     )
     return pl.pallas_call(
         kernel,

@@ -711,10 +711,7 @@ def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
             plan.num_stages, heads_l, kv_heads_l,
         )
         x = _select_stage0(x)  # [B, T, hidden], valid on stage 0
-        x = rms_norm(x, params["norm_f"], config.rms_norm_eps,
-                   offset=config.rms_norm_offset)
-        logits = quant.dense(x, params["lm_head"]).astype(jnp.float32)
-        logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
+        logits = _head_logits(params, x, config)
         return logits, KVCache(k=ck, v=cv)
 
     sharded = jax.shard_map(

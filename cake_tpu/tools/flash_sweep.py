@@ -113,6 +113,36 @@ def sweep(json_out: str | None = None) -> list:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
+    # Windowed prefill (Mistral sliding window): the kernel's block sweep
+    # is window-proportional (out-of-window KV blocks never fetched) vs
+    # the XLA path's full-history sweep+mask. Window 4096 at an 8K/16K
+    # frontier is the Mistral-7B geometry of record.
+    @jax.jit
+    def f_pal_w(q, kk_, vv_, pos):
+        return flash_attention(q, kk_, vv_, pos, window=4096,
+                               interpret=not compiled)
+
+    @jax.jit
+    def f_xla_w(q, kk_, vv_, pos):
+        return _attend_xla(q, kk_, vv_, pos, window=4096)
+
+    for t, s in ((2048, 8192), (512, 8192), (2048, 16384)):
+        kv_k = jax.random.normal(ks[0], (b, kvh, s, d), jnp.bfloat16)
+        kv_v = jax.random.normal(ks[1], (b, kvh, s, d), jnp.bfloat16)
+        q = jax.random.normal(ks[2], (b, h, t, d), jnp.bfloat16)
+        pos = jnp.int32(s - t - 8)
+        inner = max(2, min(32, (2048 * 4096) // (t * s) * 4))
+        p_ms = _time_ms(f_pal_w, q, kv_k, kv_v, pos, inner=inner)
+        x_ms = _time_ms(f_xla_w, q, kv_k, kv_v, pos, inner=inner)
+        full_ms = _time_ms(f_pal, q, kv_k, kv_v, pos, inner=inner)
+        rec = {"path": "prefill_win4096", "t": t, "s": s,
+               "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+               "full_flash_ms": round(full_ms, 4),
+               "speedup": round(x_ms / p_ms, 3),
+               "auto_impl": "flash", "auto_speedup": round(x_ms / p_ms, 3)}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
     # Int8-KV prefill: the quantization-aware flash kernel vs the XLA path
     # over trace-level-dequantized buffers (what the dispatch uses below
     # the crossover) — the long-context plane of the quantized cache.

@@ -159,6 +159,13 @@ def load_llama_params_on_mesh(
             and not any(n in reader.name_to_file for n in (
                 "lm_head.weight", "lm_head.weight.q8",
                 "lm_head.weight.q4"))):
+        import logging
+
+        logging.getLogger("cake_tpu.sharded_load").info(
+            "no stored lm_head.weight in %s — loading a tied head (the "
+            "embedding); if this checkpoint is supposed to be untied, its "
+            "index is incomplete", model_dir,
+        )
         tie_word_embeddings = True
     if num_experts and int4:
         from cake_tpu.ops.quant import reject_int4_moe

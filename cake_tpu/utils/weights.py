@@ -366,6 +366,13 @@ def load_llama_params(
             and not any(n in name_to_file for n in (
                 "lm_head.weight", "lm_head.weight.q8",
                 "lm_head.weight.q4"))):
+        import logging
+
+        logging.getLogger("cake_tpu.weights").info(
+            "no stored lm_head.weight in %s — loading a tied head (the "
+            "embedding); if this checkpoint is supposed to be untied, its "
+            "index is incomplete", model_dir,
+        )
         tie_word_embeddings = True
     handles: dict[Path, object] = {}
 

@@ -277,3 +277,74 @@ def test_auto_dispatch_measured_crossover(monkeypatch):
     monkeypatch.setattr(pk, "force_kernels", lambda: True)
     run(1, 512)  # forced -> flash decode regardless of the crossover
     assert calls == ["decode"]
+
+
+@pytest.mark.parametrize("pos", [0, 5])
+@pytest.mark.parametrize("window", [3, 8, 17, 1000])
+def test_flash_prefill_windowed_matches_xla(pos, window):
+    """Sliding-window flash prefill vs the windowed XLA oracle — windows
+    smaller than / spanning / exceeding the block size, and far larger
+    than the history (degenerates to full causal)."""
+    from cake_tpu.ops.attention import _attend_xla
+
+    b, kvh, group, t, s, d = 2, 2, 4, 8, 32, 16
+    h = kvh * group
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(2), b, h, kvh, t, s, d,
+                           pos=pos)
+    ref = _attend_xla(q, k_all, v_all, pos, window=window)
+    out = flash_attention(q, k_all, v_all, pos, block_q=4, block_k=8,
+                          window=window, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_windowed_skips_out_of_window_blocks():
+    """A KV block entirely below the window must not influence the
+    output: poison it with NaNs and require a finite, oracle-exact
+    result (proves the block skip is real, not just masking)."""
+    from cake_tpu.ops.attention import _attend_xla
+
+    b, h, kvh, t, s, d = 1, 2, 2, 4, 32, 16
+    pos, window = 20, 4
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(3), b, h, kvh, t, s, d)
+    # rows [0, 8) are >= window behind every query (frontier 20..23):
+    # two full 8-wide blocks below the lower bound
+    k_all = k_all.at[:, :, :8, :].set(jnp.nan)
+    v_all = v_all.at[:, :, :8, :].set(jnp.nan)
+    out = flash_attention(q, k_all, v_all, pos, block_q=4, block_k=8,
+                          window=window, interpret=True)
+    assert bool(jnp.isfinite(out).all())
+    ref = _attend_xla(
+        q, jnp.nan_to_num(k_all), jnp.nan_to_num(v_all), pos, window=window
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_windowed_prefill_dispatch(monkeypatch):
+    """attend() with a window routes long prefill to the flash kernel at
+    the measured crossover and decode/per-row to XLA."""
+    import cake_tpu.ops.attention as A
+
+    calls = []
+    real = A.pk.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("window"))
+        return real(*a, interpret=True, **kw)
+
+    monkeypatch.setattr(A.pk, "flash_attention", spy)
+    monkeypatch.setattr(A.pk, "kernels_enabled", lambda: True)
+    monkeypatch.setattr(A, "PREFILL_FLASH_MIN_S", 32)
+    monkeypatch.setattr(A, "PREFILL_FLASH_MIN_T", 8)
+    monkeypatch.setattr(A, "_flash_ok", lambda t, s, d: True)
+    b, h, kvh, t, s, d = 1, 2, 2, 8, 32, 16
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(4), b, h, kvh, t, s, d)
+    A.attend(q, k_all, v_all, 0, window=8)
+    assert calls == [8]
+    # decode with window: XLA (no kernel call)
+    q1 = q[:, :, :1, :]
+    A.attend(q1, k_all, v_all, 20, window=8)
+    assert calls == [8]
+    with pytest.raises(ValueError, match="sliding-window"):
+        A.attend(q1, k_all, v_all, 20, window=8, impl="flash")
