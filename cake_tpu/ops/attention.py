@@ -129,20 +129,26 @@ def attend(
     s = k_all.shape[2]
     per_row = jnp.asarray(pos).ndim == 1
     if window is not None:
-        # Windowed PREFILL rides the flash kernel (the lower bound is
-        # folded into its block sweep — KV blocks outside the window are
-        # never fetched); windowed decode and per-row stay on XLA (the
-        # decode kernel's frontier sweep has no lower bound).
-        if t == 1 or per_row:
-            if impl == "flash":
-                raise ValueError(
-                    "flash decode does not implement sliding-window "
-                    "masking; use impl='auto'/'xla' with window="
-                )
+        # Windowed PREFILL rides the flash kernel at the measured
+        # crossover (the lower bound is folded into its block sweep — KV
+        # blocks outside the window are never fetched). Windowed DECODE
+        # supports the kernel too (same lower-bound skip: ~W KV bytes vs
+        # XLA's full-buffer sweep) but auto stays XLA until a measured
+        # win lands (flash_sweep decode_win4096 rows); CAKE_PALLAS=1 or
+        # impl='flash' forces it. Per-row prefill stays XLA (not a
+        # kernel-served shape, windowed or not).
+        if per_row and t > 1:
             impl = "xla"
+        elif t == 1:
+            if impl == "auto":
+                force = pk.kernels_enabled() and pk.force_kernels()
+                ok = pk.interpret_default() or _flash_ok(t, s, d)
+                impl = "flash" if force and ok else "xla"
         elif impl == "auto":
             impl = _flash_prefill_choice(t, s, d)
         if impl == "flash":
+            if t == 1:
+                return pk.flash_decode(q, k_all, v_all, pos, window=window)
             return pk.flash_attention(q, k_all, v_all, pos, window=window)
         return _attend_xla(q, k_all, v_all, pos, window=window)
     if per_row and t > 1 and impl != "xla":

@@ -410,6 +410,7 @@ def _decode_kernel(
     block_k: int,
     scale: float,
     num_kv_blocks: int,
+    window: int | None = None,
 ):
     kb = pl.program_id(2)
     pos = pos_ref[pl.program_id(0)]
@@ -421,8 +422,16 @@ def _decode_kernel(
         acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
 
     max_kb = jax.lax.div(pos, block_k)
+    if window is None:
+        live = kb <= max_kb
+    else:
+        # sliding window: this row attends keys in (pos-window, pos] only —
+        # at long S the block sweep is window-proportional where the XLA
+        # path sweeps and masks the whole buffer
+        min_kb = jax.lax.div(jnp.maximum(0, pos - window + 1), block_k)
+        live = (kb >= min_kb) & (kb <= max_kb)
 
-    @pl.when(kb <= max_kb)
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0]  # [G, D]
         k = k_ref[0, 0]  # [BK, D]
@@ -434,7 +443,10 @@ def _decode_kernel(
         kpos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (group, block_k), 1
         )
-        s = jnp.where(kpos <= pos, s, NEG_INF)
+        mask = kpos <= pos
+        if window is not None:
+            mask &= kpos > pos - window
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]
         l_prev = l_ref[:]
@@ -461,6 +473,7 @@ def flash_decode(
     pos,  # scalar int
     *,
     block_k: int = 512,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Single-position flash attention. Returns [B, H, 1, D].
@@ -470,6 +483,10 @@ def flash_decode(
     nor computed. ``pos`` may be scalar (shared frontier) or ``[B]``
     (per-row frontiers — multi-stream serving): it is broadcast to a [B]
     prefetch and each batch grid row clamps its own KV fetch window.
+
+    ``window``: sliding-window attention — blocks below the window's lower
+    bound are likewise neither fetched nor computed, so a W-window decode
+    against a long buffer reads ~W of KV bytes instead of ~pos.
     """
     b, h, t, d = q.shape
     assert t == 1, "flash_decode requires T == 1"
@@ -489,7 +506,11 @@ def flash_decode(
         return (bi, khi, 0, 0)
 
     def kv_map(bi, khi, kb, pos_ref):
-        return (bi, khi, jnp.minimum(kb, jax.lax.div(pos_ref[bi], bk)), 0)
+        idx = jnp.minimum(kb, jax.lax.div(pos_ref[bi], bk))
+        if window is not None:
+            lo = jnp.maximum(0, pos_ref[bi] - window + 1)
+            idx = jnp.maximum(idx, jax.lax.div(lo, bk))
+        return (bi, khi, idx, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -507,7 +528,8 @@ def flash_decode(
         ],
     )
     kernel = functools.partial(
-        _decode_kernel, group=group, block_k=bk, scale=scale, num_kv_blocks=nk
+        _decode_kernel, group=group, block_k=bk, scale=scale,
+        num_kv_blocks=nk, window=window,
     )
     out = pl.pallas_call(
         kernel,

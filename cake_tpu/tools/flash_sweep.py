@@ -113,6 +113,33 @@ def sweep(json_out: str | None = None) -> list:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
+    # Windowed decode (Mistral sliding window): the kernel reads ~W of KV
+    # bytes where XLA sweeps+masks the whole buffer — the structural case
+    # grows with S/W. auto currently stays XLA (measured-crossover rule);
+    # a winning row here is what flips it.
+    @jax.jit
+    def fd_pal_w(q, kk_, vv_, pos):
+        return flash_decode(q, kk_, vv_, pos, window=4096,
+                            interpret=not compiled)
+
+    @jax.jit
+    def fd_xla_w(q, kk_, vv_, pos):
+        return _attend_xla(q, kk_, vv_, pos, window=4096)
+
+    for s in (8192, 16384):
+        kv_k = jax.random.normal(ks[0], (b, kvh, s, d), jnp.bfloat16)
+        kv_v = jax.random.normal(ks[1], (b, kvh, s, d), jnp.bfloat16)
+        q1 = jax.random.normal(ks[2], (b, h, 1, d), jnp.bfloat16)
+        pos = jnp.int32(s - 8)
+        p_ms = _time_ms(fd_pal_w, q1, kv_k, kv_v, pos)
+        x_ms = _time_ms(fd_xla_w, q1, kv_k, kv_v, pos)
+        rec = {"path": "decode_win4096", "t": 1, "s": s,
+               "pallas_ms": round(p_ms, 4), "xla_ms": round(x_ms, 4),
+               "speedup": round(x_ms / p_ms, 3),
+               "auto_impl": "xla", "auto_speedup": 1.0}
+        results.append(rec)
+        print(json.dumps(rec), flush=True)
+
     # Windowed prefill (Mistral sliding window): the kernel's block sweep
     # is window-proportional (out-of-window KV blocks never fetched) vs
     # the XLA path's full-history sweep+mask. Window 4096 at an 8K/16K

@@ -37,6 +37,7 @@ from cake_tpu.utils.weights import (
     _MOE_EXPERT_MAP,
     _MOE_ROUTER,
     detect_family,
+    detect_tied_head,
     hf_layer_map,
     load_safetensors_index,
 )
@@ -152,20 +153,8 @@ def load_llama_params_on_mesh(
 
     reader = CheckpointReader(model_dir)
     num_experts, attention_bias, o_bias = detect_family(reader.name_to_file)
-    # tied-head auto-detection, same rule as load_llama_params: no stored
-    # lm_head.weight (plain OR pre-quantized .q8/.q4) -> the head can only
-    # be the embedding
-    if (not tie_word_embeddings
-            and not any(n in reader.name_to_file for n in (
-                "lm_head.weight", "lm_head.weight.q8",
-                "lm_head.weight.q4"))):
-        import logging
-
-        logging.getLogger("cake_tpu.sharded_load").info(
-            "no stored lm_head.weight in %s — loading a tied head (the "
-            "embedding); if this checkpoint is supposed to be untied, its "
-            "index is incomplete", model_dir,
-        )
+    if not tie_word_embeddings and detect_tied_head(
+            reader.name_to_file, model_dir, "cake_tpu.sharded_load"):
         tie_word_embeddings = True
     if num_experts and int4:
         from cake_tpu.ops.quant import reject_int4_moe

@@ -279,6 +279,25 @@ def load_safetensors_index(model_dir: str | Path) -> dict[str, Path]:
     raise FileNotFoundError(f"no safetensors index or file under {model_dir}")
 
 
+def detect_tied_head(name_to_file: dict, model_dir, logger_name: str) -> bool:
+    """True when the checkpoint stores NO lm_head.weight (plain or
+    pre-quantized ``.q8``/``.q4``) — such a checkpoint can only be tied
+    (Gemma, Llama-3.2-1B, Qwen2-small). Shared by both loaders; logs when
+    it fires so an untied checkpoint with a broken index stays
+    diagnosable."""
+    import logging
+
+    if any(n in name_to_file for n in (
+            "lm_head.weight", "lm_head.weight.q8", "lm_head.weight.q4")):
+        return False
+    logging.getLogger(logger_name).info(
+        "no stored lm_head.weight in %s — loading a tied head (the "
+        "embedding); if this checkpoint is supposed to be untied, its "
+        "index is incomplete", model_dir,
+    )
+    return True
+
+
 def detect_family(name_to_file: dict) -> tuple[int, bool, bool]:
     """Detect a checkpoint's family tensors from its name index:
     ``(num_experts, attention_bias, o_bias)``. Zero/False for the Llama
@@ -357,22 +376,9 @@ def load_llama_params(
         attention_bias = det_bias
     if o_bias is None:
         o_bias = det_o
-    # Tied-head auto-detection: a checkpoint with no stored lm_head.weight
-    # (Gemma, Llama-3.2-1B, Qwen2-small) can ONLY be tied — honoring the
-    # flag alone lets a call site that forgot it crash on the missing key.
-    # Pre-quantized checkpoints store the head as lm_head.weight.q8/.q4
-    # (+ .scale), so those names count as a stored head too.
     if (include_head and not tie_word_embeddings
-            and not any(n in name_to_file for n in (
-                "lm_head.weight", "lm_head.weight.q8",
-                "lm_head.weight.q4"))):
-        import logging
-
-        logging.getLogger("cake_tpu.weights").info(
-            "no stored lm_head.weight in %s — loading a tied head (the "
-            "embedding); if this checkpoint is supposed to be untied, its "
-            "index is incomplete", model_dir,
-        )
+            and detect_tied_head(name_to_file, model_dir,
+                                 "cake_tpu.weights")):
         tie_word_embeddings = True
     handles: dict[Path, object] = {}
 

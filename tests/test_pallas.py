@@ -342,9 +342,49 @@ def test_windowed_prefill_dispatch(monkeypatch):
     q, k_all, v_all = _qkv(jax.random.PRNGKey(4), b, h, kvh, t, s, d)
     A.attend(q, k_all, v_all, 0, window=8)
     assert calls == [8]
-    # decode with window: XLA (no kernel call)
+    # decode with window: auto stays XLA (no prefill-kernel call) until a
+    # measured win flips it...
     q1 = q[:, :, :1, :]
-    A.attend(q1, k_all, v_all, 20, window=8)
+    xla_out = A.attend(q1, k_all, v_all, 20, window=8)
     assert calls == [8]
-    with pytest.raises(ValueError, match="sliding-window"):
-        A.attend(q1, k_all, v_all, 20, window=8, impl="flash")
+    # ...but an explicit impl='flash' reaches the windowed decode kernel
+    flash_out = A.attend(q1, k_all, v_all, 20, window=8, impl="flash")
+    np.testing.assert_allclose(np.asarray(flash_out), np.asarray(xla_out),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pos", [6, 20, 31])
+@pytest.mark.parametrize("window", [3, 8, 17, 1000])
+def test_flash_decode_windowed_matches_xla(pos, window):
+    from cake_tpu.ops.attention import _attend_xla
+
+    b, kvh, group, s, d = 2, 2, 4, 32, 16
+    h = kvh * group
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(5), b, h, kvh, 1, s, d)
+    ref = _attend_xla(q, k_all, v_all, pos, window=window)
+    out = flash_decode(q, k_all, v_all, pos, block_k=8, window=window,
+                       interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_decode_windowed_per_row_and_block_skip():
+    """Per-row frontiers with a window: each row's lower bound is its own;
+    NaN-poisoned out-of-window blocks must not leak (real skip)."""
+    from cake_tpu.ops.attention import _attend_xla
+
+    b, kvh, group, s, d = 2, 2, 2, 32, 16
+    h = kvh * group
+    window = 4
+    pos = jnp.asarray([20, 29], jnp.int32)
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(6), b, h, kvh, 1, s, d)
+    # rows far below both windows: blocks [0, 16) dead for both rows
+    k_all = k_all.at[:, :, :16, :].set(jnp.nan)
+    v_all = v_all.at[:, :, :16, :].set(jnp.nan)
+    out = flash_decode(q, k_all, v_all, pos, block_k=8, window=window,
+                       interpret=True)
+    assert bool(jnp.isfinite(out).all())
+    ref = _attend_xla(q, jnp.nan_to_num(k_all), jnp.nan_to_num(v_all), pos,
+                      window=window)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Third-stage queue (r4): the sync-amortization curve rows the w3 records
+# motivated — spec with more fused rounds per host sync (94 ms math vs
+# ~170 ms tunnel RTT per dispatch at rounds=8) and churn with a larger
+# fused block between admission checks. Run AFTER tools_bench_queue2.sh.
+set -u
+LOG=${LOG:-/tmp/bench_queue3.log}
+cd /root/repo
+
+probe() {
+  timeout -k 10 240 python -c \
+    "import jax; d = jax.devices()[0]; assert d.platform == 'tpu', d; print('healthy:', d.device_kind)" \
+    >>"$LOG" 2>&1
+}
+
+run_row() {
+  echo "=== $(date -u +%FT%TZ) row: $* ===" >>"$LOG"
+  env "$@" CAKE_BENCH_PROBE_BUDGET=120 python -u bench.py >>"$LOG" 2>&1
+  echo "--- exit $? $(date -u +%FT%TZ)" >>"$LOG"
+}
+
+echo "monitor3 start $(date -u +%FT%TZ)" >>"$LOG"
+for i in $(seq 1 30); do
+  if probe; then
+    echo "grant healthy at probe $i $(date -u +%FT%TZ)" >>"$LOG"
+    run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_ROUNDS=16 CAKE_BENCH_SEQ=1024
+    run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_ROUNDS=32 CAKE_BENCH_SEQ=2048
+    run_row CAKE_BENCH_CHURN=1 CAKE_BENCH_MULTISTEP=32
+    echo "queue3 done $(date -u +%FT%TZ)" >>"$LOG"
+    exit 0
+  fi
+  echo "probe $i wedged $(date -u +%FT%TZ); sleeping 20m" >>"$LOG"
+  sleep 1200
+done
+echo "gave up after 30 probes $(date -u +%FT%TZ)" >>"$LOG"
+exit 1
