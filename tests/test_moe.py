@@ -335,3 +335,28 @@ def test_moe_mesh_speculation_parity(moe_params):
     g.set_prompt(prompt)
     assert [g.next_token(i).id for i in range(8)] == want
     assert g.dispatches < 8  # speculation actually engaged
+
+
+def test_moe_serving_int8kv_interleaved_parity(moe_params):
+    """MoE x int8 KV cache x interleaved-microbatch decode (batch divides
+    stages, so BatchGenerator auto-selects the GPipe-streamed schedule):
+    every stream still reproduces its solo bf16-KV-free run... rather,
+    its solo int8-KV oracle, token for token."""
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+
+    settings = SamplerSettings(**GREEDY)
+    prompts = [[5, 9, 2, 11], [3, 1, 4, 1], [7, 7, 2], [9, 8, 7, 6]]
+
+    solo = []
+    for p in prompts:
+        g = LlamaGenerator(MOE_CFG, moe_params, settings=settings,
+                          kv_quant="int8")
+        g.set_prompt(p)
+        solo.append([g.next_token(i).id for i in range(6)])
+
+    bg = BatchGenerator(MOE_CFG, moe_params, settings=settings,
+                        num_stages=2, ep=2, block_size=2, kv_quant="int8")
+    bg.set_prompts(prompts)
+    assert bg._interleave  # 4 streams over 2 stages: GPipe schedule on
+    outs = bg.generate(6)
+    assert [list(o) for o in outs] == solo
