@@ -86,6 +86,14 @@ def _hbm_gbps(device) -> float:
     return _device_spec(device, _HBM_GBPS, 819.0)
 
 
+def _mtag(preset: str) -> str:
+    """Metric model tag: family_preset ("llama_8b" by default; a
+    CAKE_BENCH_FAMILY run tags its own family so family rows can never be
+    mistaken for the llama numbers of record)."""
+    fam = os.environ.get("CAKE_BENCH_FAMILY", "llama")
+    return f"{fam}_{preset}"
+
+
 def _wtag(quant: str, kv_quant: str | None) -> str:
     """Metric tag for the weight/KV dtype combination."""
     tag = quant if quant in ("int8", "int4") else "bf16"
@@ -113,10 +121,28 @@ def _kv_quant() -> str | None:
 
 
 def _config(preset: str):
-    from cake_tpu.models.config import LlamaConfig, llama3_8b, tiny
+    """CAKE_BENCH_FAMILY=mistral|qwen2 swaps the 8b rung's architecture
+    for that family's 7B geometry (random weights — tok/s only): mistral
+    prices the sliding-window mask + windowed flash plane on-chip; qwen2
+    prices the biased-GQA 3584/28-layer geometry. Default family: llama."""
+    from cake_tpu.models.config import (LlamaConfig, llama3_8b, mistral_7b,
+                                        qwen2_7b, tiny)
 
     seq = int(os.environ.get("CAKE_BENCH_SEQ", "512"))
+    fam = os.environ.get("CAKE_BENCH_FAMILY", "llama")
+    if fam != "llama" and preset != "8b":
+        # the fallback rungs are llama geometry — benching them under a
+        # family tag would mislabel the row
+        sys.exit(f"error: CAKE_BENCH_FAMILY={fam} requires the 8b rung "
+                 "(the fallback presets are llama geometry)")
     if preset == "8b":
+        if fam == "mistral":
+            return mistral_7b(max_seq_len=seq)
+        if fam == "qwen2":
+            return qwen2_7b(max_seq_len=seq)
+        if fam != "llama":
+            sys.exit(f"error: CAKE_BENCH_FAMILY must be llama|mistral|"
+                     f"qwen2, got {fam!r}")
         return llama3_8b(max_seq_len=seq)
     if preset == "small":
         return LlamaConfig(
@@ -284,7 +310,7 @@ def _run_prefill(config, params, preset, quant, dev) -> int:
     flops = _matmul_flops(params, config, t)
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
     _emit({
-        "metric": f"prefill_tokens_per_sec_llama_{preset}_{wtag}_1chip_t{t}",
+        "metric": f"prefill_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_t{t}",
         "value": round(t / dt, 3),
         "unit": "tokens/s",
         "vs_baseline": round(flops / dt / peak, 4),
@@ -386,7 +412,7 @@ def _run_batched(config, params, preset, quant, settings, dev,
     roofline = _hbm_gbps(dev) / model_gb  # single-stream weights-bound ideal
     wtag = _wtag(quant, kv_quant)
     _emit({
-        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_b{batch}",
+        "metric": f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_b{batch}",
         "value": round(agg_tok_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(agg_tok_s / roofline, 4),
@@ -435,7 +461,7 @@ def _run_ttft(config, params, preset, quant, dev) -> int:
     flops = _matmul_flops(params, config, t)
     peak = _device_spec(dev, _PEAK_TFLOPS, 197.0) * 1e12
     _emit({
-        "metric": f"ttft_p50_ms_llama_{preset}_{wtag}_1chip_t{t}",
+        "metric": f"ttft_p50_ms_{_mtag(preset)}_{wtag}_1chip_t{t}",
         "value": round(p50 * 1e3, 2),
         "unit": "ms",
         "vs_baseline": round(flops / p50 / peak, 4),
@@ -502,7 +528,7 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
     _emit({
-        "metric": (f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_"
+        "metric": (f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_"
                    f"b{batch}_churn"),
         "value": round(agg, 3),
         "unit": "tokens/s",
@@ -553,7 +579,7 @@ def _run_spec_serving(config, params, preset, quant, dev, batch, steps,
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
     _emit({
-        "metric": (f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_"
+        "metric": (f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_"
                    f"b{batch}_spec{k}"),
         "value": round(agg, 3),
         "unit": "tokens/s",
@@ -611,7 +637,7 @@ def _run_speculative(config, params, preset, quant, dev, steps) -> int:
     roofline = _hbm_gbps(dev) / model_gb
     wtag = _wtag(quant, kv_quant)
     _emit({
-        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip_spec{k}",
+        "metric": f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_spec{k}",
         "value": round(tok_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(tok_s / roofline, 4),
@@ -879,7 +905,7 @@ def main() -> int:
 
     wtag = _wtag(quant, kv_quant)
     _emit({
-        "metric": f"decode_tokens_per_sec_llama_{preset}_{wtag}_1chip",
+        "metric": f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip",
         "value": round(toks_per_s, 3),
         "unit": "tokens/s",
         "vs_baseline": round(toks_per_s / roofline, 4),
