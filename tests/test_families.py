@@ -497,3 +497,29 @@ def test_prequantized_untied_head_not_falsely_tied(tmp_path):
     want = quantize_params(params, bits=8)
     np.testing.assert_array_equal(np.asarray(loaded["lm_head"].q),
                                   np.asarray(want["lm_head"].q))
+
+
+def test_mistral_serving_batch_generator_parity():
+    """Sliding-window family through the multi-stream serving plane
+    (per-row frontiers use the windowed per-row XLA mask): every stream
+    reproduces its solo run token for token."""
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    cfg = tiny(model_type="mistral", sliding_window=8, max_seq_len=64)
+    params = llama.init_params(cfg, jax.random.PRNGKey(9))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    prompts = [[5, 9, 2, 11, 4, 3, 8, 7, 1, 2], [3, 1, 4, 1], [7, 7, 2]]
+
+    solo = []
+    for p in prompts:
+        g = LlamaGenerator(cfg, params, settings=settings)
+        g.set_prompt(p)
+        solo.append([g.next_token(i).id for i in range(12)])
+
+    bg = BatchGenerator(cfg, params, settings=settings, num_stages=2,
+                        block_size=2)
+    bg.set_prompts(prompts)
+    outs = bg.generate(12)
+    assert [list(o) for o in outs] == solo
