@@ -381,14 +381,13 @@ def self_attention_block(
             s_len = k_cache.q.shape[2]
             use_q8_flash = (
                 t > 1
-                and window is None
                 and jnp.asarray(pos).ndim == 0
                 and _flash_prefill_choice(t, s_len, d) == "flash"
             )
             if use_q8_flash:
                 out = pk.flash_attention_q8(
                     q, k_cache.q, k_cache.scale, v_cache.q, v_cache.scale,
-                    pos,
+                    pos, window=window,
                 )
             else:
                 out = attend(q, kv.dequant_kv(k_cache, q.dtype),

@@ -46,6 +46,21 @@ def _pick_block(n: int, preferred: int) -> int:
     return b
 
 
+def _kv_block_bounds(pos, qb, block_q: int, block_k: int,
+                     window: int | None):
+    """(min_kb, max_kb) of the live KV-block range for q block ``qb`` at
+    frontier ``pos`` — THE one definition of the causal upper bound and
+    the sliding-window lower bound, shared by the kernels' live-range
+    gates and the BlockSpec index maps so fetch clamp and compute mask
+    can never desynchronize. ``qb``/``block_q`` of (0, 1) express the
+    decode case (a single query row at ``pos``)."""
+    max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
+    if window is None:
+        return 0, max_kb
+    lo = jnp.maximum(0, pos + qb * block_q - window + 1)
+    return jax.lax.div(lo, block_k), max_kb
+
+
 # ---------------------------------------------------------------------------
 # Prefill kernel
 # ---------------------------------------------------------------------------
@@ -77,17 +92,11 @@ def _prefill_kernel(
         l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
         acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    # Last kv block index visible to any row of this q block.
-    max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
-    if window is None:
-        live = kb <= max_kb
-    else:
-        # Sliding window (Mistral): blocks entirely below the q block's
-        # lowest valid key position are skipped — the block sweep is
-        # window-proportional, not history-proportional.
-        lo = jnp.maximum(0, pos + qb * block_q - window + 1)
-        min_kb = jax.lax.div(lo, block_k)
-        live = (kb >= min_kb) & (kb <= max_kb)
+    # Sliding window (Mistral): blocks entirely below the q block's
+    # lowest valid key position are skipped — the block sweep is
+    # window-proportional, not history-proportional.
+    min_kb, max_kb = _kv_block_bounds(pos, qb, block_q, block_k, window)
+    live = (kb >= min_kb) & (kb <= max_kb)
 
     @pl.when(live)
     def _compute():
@@ -175,12 +184,8 @@ def flash_attention(
         # Clamp to the causal frontier (and, windowed, to the window's
         # lower bound): fully-masked blocks re-use a live block index, so
         # the pipeline skips their HBM fetch.
-        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
-        idx = jnp.minimum(kb, max_kb)
-        if window is not None:
-            lo = jnp.maximum(0, pos_ref[0] + qb * bq - window + 1)
-            idx = jnp.maximum(idx, jax.lax.div(lo, bk))
-        return (bi, hi // group, idx, 0)
+        min_kb, max_kb = _kv_block_bounds(pos_ref[0], qb, bq, bk, window)
+        return (bi, hi // group, jnp.clip(kb, min_kb, max_kb), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -239,6 +244,7 @@ def _prefill_q8_kernel(
     scale: float,
     num_kv_blocks: int,
     group: int,
+    window: int | None = None,
 ):
     """Same online softmax as :func:`_prefill_kernel`, reading int8 KV. The
     per-token dequant scale is constant along D, so it factors OUT of both
@@ -262,9 +268,10 @@ def _prefill_q8_kernel(
         l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
         acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    max_kb = jax.lax.div(pos + (qb + 1) * block_q - 1, block_k)
+    min_kb, max_kb = _kv_block_bounds(pos, qb, block_q, block_k, window)
+    live = (kb >= min_kb) & (kb <= max_kb)
 
-    @pl.when(kb <= max_kb)
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0]  # [BQ, D]
         kq = kq_ref[0, 0].astype(q.dtype)  # [BK, D] (VMEM convert)
@@ -282,7 +289,10 @@ def _prefill_q8_kernel(
         kpos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1
         )
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
+        mask = kpos <= qpos
+        if window is not None:
+            mask &= kpos > qpos - window
+        s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_ref[:]
         l_prev = l_ref[:]
@@ -315,6 +325,7 @@ def flash_attention_q8(
     *,
     block_q: int = 512,
     block_k: int | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Causal flash attention over an int8 KV buffer (quantize-on-write
@@ -340,15 +351,17 @@ def flash_attention_q8(
     def q_map(bi, hi, qb, kb, pos_ref):
         return (bi, hi, qb, 0)
 
+    def _kb_idx(qb, kb, pos_ref):
+        min_kb, max_kb = _kv_block_bounds(pos_ref[0], qb, bq, bk, window)
+        return jnp.clip(kb, min_kb, max_kb)
+
     def kv_map(bi, hi, qb, kb, pos_ref):
-        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
-        return (bi, hi // group, jnp.minimum(kb, max_kb), 0)
+        return (bi, hi // group, _kb_idx(qb, kb, pos_ref), 0)
 
     def scale_map(bi, hi, qb, kb, pos_ref):
         # full kv-head axis per block (see the kernel docstring); only
         # batch and the (clamped) S block vary
-        max_kb = jax.lax.div(pos_ref[0] + (qb + 1) * bq - 1, bk)
-        return (bi, 0, jnp.minimum(kb, max_kb))
+        return (bi, 0, _kb_idx(qb, kb, pos_ref))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -369,7 +382,7 @@ def flash_attention_q8(
     )
     kernel = functools.partial(
         _prefill_q8_kernel, block_q=bq, block_k=bk, scale=scale,
-        num_kv_blocks=nk, group=group,
+        num_kv_blocks=nk, group=group, window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -421,15 +434,11 @@ def _decode_kernel(
         l_ref[:] = jnp.zeros(l_ref.shape, jnp.float32)
         acc_ref[:] = jnp.zeros(acc_ref.shape, jnp.float32)
 
-    max_kb = jax.lax.div(pos, block_k)
-    if window is None:
-        live = kb <= max_kb
-    else:
-        # sliding window: this row attends keys in (pos-window, pos] only —
-        # at long S the block sweep is window-proportional where the XLA
-        # path sweeps and masks the whole buffer
-        min_kb = jax.lax.div(jnp.maximum(0, pos - window + 1), block_k)
-        live = (kb >= min_kb) & (kb <= max_kb)
+    # sliding window: this row attends keys in (pos-window, pos] only —
+    # at long S the block sweep is window-proportional where the XLA
+    # path sweeps and masks the whole buffer
+    min_kb, max_kb = _kv_block_bounds(pos, 0, 1, block_k, window)
+    live = (kb >= min_kb) & (kb <= max_kb)
 
     @pl.when(live)
     def _compute():
@@ -506,11 +515,8 @@ def flash_decode(
         return (bi, khi, 0, 0)
 
     def kv_map(bi, khi, kb, pos_ref):
-        idx = jnp.minimum(kb, jax.lax.div(pos_ref[bi], bk))
-        if window is not None:
-            lo = jnp.maximum(0, pos_ref[bi] - window + 1)
-            idx = jnp.maximum(idx, jax.lax.div(lo, bk))
-        return (bi, khi, idx, 0)
+        min_kb, max_kb = _kv_block_bounds(pos_ref[bi], 0, 1, bk, window)
+        return (bi, khi, jnp.clip(kb, min_kb, max_kb), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,

@@ -523,3 +523,34 @@ def test_mistral_serving_batch_generator_parity():
     bg.set_prompts(prompts)
     outs = bg.generate(12)
     assert [list(o) for o in outs] == solo
+
+
+def test_mistral_int8_kv_window_composition():
+    """Sliding window x int8 KV cache, with real oracles:
+
+    - a window WIDER than everything the stream ever attends must be a
+      no-op — stream identical to the unwindowed config on the same
+      quantized cache (the sharp equality: the windowed code path
+      degenerates exactly);
+    - the narrow window must actually change the stream (the mask is not
+      silently dropped on the dequant path)."""
+    import dataclasses
+
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    prompt = [5, 9, 2, 11, 4, 3, 8, 7, 1, 2]
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+
+    def stream(cfg):
+        g = LlamaGenerator(cfg, params, settings=settings, kv_quant="int8")
+        g.set_prompt(prompt)
+        return [g.next_token(i).id for i in range(10)]
+
+    base = tiny(model_type="mistral", sliding_window=None, max_seq_len=64)
+    params = llama.init_params(base, jax.random.PRNGKey(10))
+    unwindowed = stream(base)
+    wide = stream(dataclasses.replace(base, sliding_window=1000))
+    assert wide == unwindowed  # window >= history: exact degeneration
+    narrow = stream(dataclasses.replace(base, sliding_window=4))
+    assert narrow != unwindowed  # the mask genuinely applies

@@ -388,3 +388,25 @@ def test_flash_decode_windowed_per_row_and_block_skip():
                       window=window)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [3, 8, 17])
+def test_flash_prefill_q8_windowed_matches_dequant_oracle(window):
+    """Windowed int8-KV flash prefill vs the windowed XLA path over the
+    same quantized buffers (Mistral long-context on the quantized cache)."""
+    from cake_tpu.ops.attention import _attend_xla
+    from cake_tpu.ops.kvcache import dequant_kv, quant_kv
+    from cake_tpu.ops.pallas import flash_attention_q8
+
+    b, kvh, group, t, s, d = 2, 2, 4, 8, 32, 16
+    h = kvh * group
+    pos = 5
+    q, k_all, v_all = _qkv(jax.random.PRNGKey(7), b, h, kvh, t, s, d)
+    kq, vq = quant_kv(k_all), quant_kv(v_all)
+    ref = _attend_xla(q, dequant_kv(kq, q.dtype), dequant_kv(vq, q.dtype),
+                      pos, window=window)
+    out = flash_attention_q8(q, kq.q, kq.scale, vq.q, vq.scale, pos,
+                             block_q=4, block_k=8, window=window,
+                             interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
