@@ -115,6 +115,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "reproducibility), so sampled speculation only "
                         "pays off on repetitive/structured streams)")
     p.add_argument("--max-seq", type=int, default=None, dest="max_seq")
+    p.add_argument("--window", type=int, default=None,
+                   help="override the attention sliding window (tokens): "
+                        "narrow a Mistral-family window, give any model "
+                        "one, or 0 to disable the checkpoint's window")
     p.add_argument("--stages", type=int, default=1,
                    help="on-pod pipeline stages (mesh, not TCP)")
     p.add_argument("--tp", type=int, default=1, help="tensor-parallel width")
@@ -157,6 +161,9 @@ def _load_config(args):
     overrides = {"dtype": _DTYPES[args.dtype]}
     if args.max_seq:
         overrides["max_seq_len"] = args.max_seq
+    if getattr(args, "window", None) is not None:
+        # 0 disables the checkpoint's window; N narrows (or grants) one
+        overrides["sliding_window"] = args.window or None
     config = LlamaConfig.from_hf_json(cfg_path, **overrides)
     if config.sliding_window and getattr(args, "sp", 1) > 1:
         sys.exit("error: sliding-window attention (this checkpoint's "

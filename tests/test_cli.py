@@ -387,3 +387,40 @@ def test_prompts_file_serves_over_sp_window(model_dir, tmp_path):
                  timeout=120, devices=8)
     assert r.returncode != 0 and r.stderr.startswith("error:")
     assert "sp 2" in r.stderr and "Traceback" not in r.stderr
+
+
+def test_window_override(tmp_path):
+    """--window grants/narrows the attention window from the CLI; 0
+    disables a checkpoint's own window."""
+    import dataclasses
+    import json
+
+    import jax
+
+    from cake_tpu.models import llama as L
+    from cake_tpu.models.config import tiny
+    from cake_tpu.utils.weights import save_llama_params
+
+    cfg = tiny(max_seq_len=64)
+    save_llama_params(L.init_params(cfg, jax.random.PRNGKey(0)), tmp_path,
+                      cfg.num_hidden_layers)
+    (tmp_path / "config.json").write_text(json.dumps(cfg.to_hf_dict()))
+    base = ["--model", str(tmp_path), "--prompt-ids", "3,5,7,9,2,8,1,4",
+            "-n", "6", "--temperature", "0", "--max-seq", "64", "--cpu",
+            "--dtype", "f32"]
+    def toks(argv):
+        r = _run_cli(argv)
+        assert r.returncode == 0, r.stderr[-2000:]
+        return r.stdout.strip().splitlines()[-1]
+
+    plain = toks(base)
+    windowed = toks(base + ["--window", "4"])
+    assert plain != windowed  # the override genuinely narrows attention
+    assert toks(base + ["--window", "0"]) == plain  # 0 == no window
+
+    # a mistral config's own window applies by default and is disabled
+    # by --window 0
+    mcfg = dataclasses.replace(cfg, model_type="mistral", sliding_window=4)
+    (tmp_path / "config.json").write_text(json.dumps(mcfg.to_hf_dict()))
+    assert toks(base + ["--window", "0"]) == plain
+    assert toks(base) == windowed
