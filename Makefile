@@ -42,6 +42,10 @@ kernel-check:
 flash-sweep:
 	$(PY) -m cake_tpu.tools.flash_sweep --json-out flash_sweep.json
 
+# int4 decode-gemv diagnosis: block/unpack variants + XLA-s4 vs baselines
+int4-sweep:
+	$(PY) -m cake_tpu.tools.int4_sweep --json-out int4_sweep.json
+
 # per-hop inter-stage (ppermute) latency/bandwidth — run on a pod slice
 ici-probe:
 	$(PY) -m cake_tpu.tools.ici_probe --json-out ici_probe.json
@@ -62,4 +66,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep ici-probe ttft deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe ttft deploy clean
