@@ -324,3 +324,64 @@ def test_two_process_sharded_load_reads_only_local_stages(model_dir):
     half = CFG.num_hidden_layers // 2
     assert f"LAYERS 0 {list(range(half))}" in outs[0][0]
     assert f"LAYERS 1 {list(range(half, CFG.num_hidden_layers))}" in outs[1][0]
+
+
+_EP_DRIVER = r"""
+import sys
+import jax
+jax.config.update('jax_platforms', 'cpu')
+pid = int(sys.argv[1])
+jax.distributed.initialize('127.0.0.1:{port}', 2, pid)
+from cake_tpu.models.config import tiny_moe
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.mesh import MeshPlan
+from cake_tpu.runtime.mesh_generator import MeshGenerator
+from cake_tpu.utils import sharded_load
+
+cfg = tiny_moe()
+plan = MeshPlan.build(cfg, ep=2, devices=jax.devices())
+grid = plan.mesh.devices
+span = tuple(sorted(d.process_index for d in grid[0, 0, 0, :, 0]))
+assert span == (0, 1), span  # the expert-parallel psum crosses processes
+params = sharded_load.load_llama_params_on_mesh(
+    {model_dir!r}, cfg, plan.mesh)
+g = MeshGenerator(cfg, params, plan=plan,
+                  settings=SamplerSettings(temperature=0.0,
+                                           repeat_penalty=1.1))
+g.set_prompt([3, 5, 7])
+print('TOKENS', pid, [g.next_token(i).id for i in range(6)])
+"""
+
+
+@pytest.fixture(scope="module")
+def moe_model_dir(tmp_path_factory):
+    from cake_tpu.models.config import tiny_moe
+
+    cfg = tiny_moe()
+    d = tmp_path_factory.mktemp("mhmoe")
+    params = llama.init_params(cfg, jax.random.PRNGKey(1), dtype="float32")
+    save_llama_params(params, d)
+    (d / "config.json").write_text(json.dumps(cfg.to_hf_dict()))
+    return d
+
+
+def test_two_process_ep_psum_crosses_process_boundary(moe_model_dir):
+    """ep=2 over 2 processes x 1 device: the expert-parallel combine psum
+    (each process holds HALF the experts) crosses the process boundary,
+    greedy tokens match the single-device oracle — the last mesh axis
+    (after stage/tp/sp/dp) proven multi-host."""
+    from cake_tpu.models.config import tiny_moe
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+    from cake_tpu.utils.weights import load_llama_params
+
+    cfg = tiny_moe()
+    params = load_llama_params(moe_model_dir, cfg.num_hidden_layers,
+                               dtype=cfg.dtype)
+    g = LlamaGenerator(cfg, params,
+                       settings=SamplerSettings(temperature=0.0,
+                                                repeat_penalty=1.1))
+    g.set_prompt([3, 5, 7])
+    want = str([g.next_token(i).id for i in range(6)])
+    got0, got1 = _run_pair(_EP_DRIVER, moe_model_dir, devices_per_proc=1)
+    assert got0 == want and got1 == want, (got0, got1, want)
