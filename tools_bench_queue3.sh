@@ -26,6 +26,7 @@ for i in $(seq 1 30); do
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_ROUNDS=16 CAKE_BENCH_SEQ=1024
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_ROUNDS=32 CAKE_BENCH_SEQ=2048
     run_row CAKE_BENCH_CHURN=1 CAKE_BENCH_MULTISTEP=32
+    run_row CAKE_BENCH_BATCH=4   # plain-b4 baseline for the b4+spec8 row
     echo "queue3 done $(date -u +%FT%TZ)" >>"$LOG"
     exit 0
   fi
