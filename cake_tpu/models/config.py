@@ -54,7 +54,10 @@ class LlamaConfig:
     # model/mod.rs:21-29).
     model_type: str = "llama"
     # q/k/v projection bias (Qwen2; HF Llama's `attention_bias` key maps
-    # here too). o_proj stays bias-free in every supported family.
+    # here too). Qwen2 itself is o-bias-free, but llama-arch
+    # `attention_bias` checkpoints may carry an o_proj bias — the loaders
+    # detect it per-checkpoint (utils/weights detect_family o_bias) and
+    # attention plumbs it through, so no config field gates it.
     attention_bias: bool = False
     # Sliding-window attention (Mistral): key positions more than `window`
     # behind the query are masked out. None = full causal.

@@ -75,8 +75,10 @@ def hbm_budget(
     mlp = 3 * c.hidden_size * c.intermediate_size  # gate/up/down
     mlp_out = 2 * c.intermediate_size + c.hidden_size
     if n_exp:
-        mlp = mlp * n_exp / ep
-        mlp_out = mlp_out * n_exp / ep
+        # integer division is exact here: validate_shardable guarantees
+        # ep | n_exp, so the byte counts stay integral for MoE configs
+        mlp = mlp * n_exp // ep
+        mlp_out = mlp_out * n_exp // ep
     lin += mlp
     lin_out = qkv_out + c.hidden_size + mlp_out
     norms = 2 * c.hidden_size
