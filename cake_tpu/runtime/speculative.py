@@ -346,6 +346,68 @@ def spec_rounds_fn(
     return tokens, counts, last, ctx, pos, cache, history, hist_slot
 
 
+def spec_replay_fn(
+    params,
+    corpus,  # [S] int32 — the REAL token stream being replayed
+    pos,  # [] int32: corpus[0..pos-1] in the KV cache; corpus[pos] is the
+    #     last "emitted" token, NOT yet cached — this round's fed[0]
+    #     writes its KV at `pos` (callers prefill corpus[:P], pass pos=P)
+    cache: KVCache,
+    acc,  # [] f32 logits checksum carry (see below)
+    config: LlamaConfig,
+    k: int,
+    n_max: int,
+    rounds: int,
+):
+    """``rounds`` TEACHER-FORCED propose→verify rounds fused into one
+    program — the honest companion to :func:`spec_rounds_fn`'s synthetic
+    self-repeating stream (r4 verdict: "no measured row on realistic text
+    exists").
+
+    The decoded stream is forced to the corpus: each round proposes with
+    the same device n-gram lookup production uses
+    (:func:`ngram_propose_device` over the replayed prefix), runs the REAL
+    ``[1, K+1]`` verification forward (same cost as live speculation), and
+    accepts the run where proposals match the corpus's actual next tokens
+    — so tokens/dispatch and the acceptance rate measure the proposer
+    against real text statistics while tok/s includes the true verify
+    FLOPs/bytes. What it does not measure: the model's own agreement with
+    its proposals (that needs trained weights; with random bench weights a
+    live run degenerates to noise — the forced replay is the honest
+    alternative, and is labeled as such in the bench row).
+
+    ``acc`` accumulates a logits checksum; without it the teacher-forced
+    accept never reads the logits and XLA would dead-code-eliminate the
+    lm_head (and with it the bench's verify cost). Caller guarantees
+    ``pos + rounds*(k+1) < min(len(corpus), max_seq)``.
+
+    Returns ``(counts [rounds], pos, cache, acc)``.
+    """
+    cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
+                           scaling=config.rope_scaling)
+
+    def round_body(carry, _):
+        pos, cache, acc = carry
+        props = ngram_propose_device(corpus, pos + 1, n_max=n_max, k=k)
+        last = corpus[pos]
+        fed = jnp.concatenate([last[None], jnp.maximum(props, 0)])[None, :]
+        logits, cache = _verify_forward(params, fed, cache, pos, cos, sin,
+                                        config)
+        # teacher-forced accept: the "model output" at slot i is the
+        # corpus's true next token; the run survives while proposals match
+        # (-1 pads never match) — same run-length semantics as accept_fn.
+        truth = jax.lax.dynamic_slice(corpus, (pos + 1,), (k,))
+        lead = jnp.cumprod((props == truth).astype(jnp.int32))
+        count = 1 + lead.sum()
+        acc = acc + logits.sum()  # forces the lm_head to materialize
+        return (pos + count, cache, acc), count
+
+    (pos, cache, acc), counts = jax.lax.scan(
+        round_body, (pos, cache, acc), None, length=rounds,
+    )
+    return counts, pos, cache, acc
+
+
 class SpeculativeMixin:
     """The speculation loop, shared by the single-chip and mesh
     generators. Subclasses build ``self._verify`` (a compiled

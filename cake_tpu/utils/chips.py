@@ -1,0 +1,52 @@
+"""Public per-chip hardware specs (one copy — bench.py and the tools
+share it so a spec correction can never leave one caller's roofline
+denominator stale).
+
+Sources: published TPU spec sheets. These feed roofline DENOMINATORS
+(weights-bound ideal tok/s = HBM bytes/s / model bytes; MFU = FLOPs/s /
+peak) — they are never presented as measurements.
+"""
+
+from __future__ import annotations
+
+# chip kind substring -> HBM GB/s
+HBM_GBPS = {
+    "v5 lite": 819.0,  # v5e: 16 GiB @ 819 GB/s
+    "v5e": 819.0,
+    "v4": 1228.0,
+    "v5p": 2765.0,
+    "v6e": 1640.0,
+    "cpu": 50.0,
+}
+
+# chip kind substring -> approx bf16 peak TFLOP/s
+PEAK_TFLOPS = {
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v4": 275.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+    "cpu": 1.0,
+}
+
+# chip kind substring -> HBM capacity GiB
+HBM_GIB = {
+    "v5 lite": 16.0,
+    "v5e": 16.0,
+    "v4": 32.0,
+    "v5p": 95.0,
+    "v6e": 32.0,
+}
+
+
+def device_spec(device, table: dict, default: float) -> float:
+    """Look up a spec by substring match on ``device.device_kind``."""
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for k, v in table.items():
+        if k in kind:
+            return v
+    return default
+
+
+def hbm_gbps(device) -> float:
+    return device_spec(device, HBM_GBPS, 819.0)

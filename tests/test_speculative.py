@@ -445,3 +445,57 @@ def test_fused_ctx_invalidated_on_new_prompt(params):
     assert g._ctx is None and g._ctx_synced_pos == -1
     out = [g.next_token(i).id for i in range(12)]
     assert out == _plain(params, [7, 1, 3, 7, 1, 3, 7, 1], 12, settings)
+
+
+def test_spec_replay_teacher_forced_counts_match_host_reference(params):
+    """r5: the fused corpus replay (bench CAKE_BENCH_SPEC_CORPUS) must
+    accept exactly the run lengths a host-side teacher-forced simulation
+    of the same n-gram proposer produces on the same stream — the device
+    proposer, the forced accept, and the position bookkeeping all agree;
+    and the logits checksum is finite (the verify forward was not DCE'd)."""
+    import jax.numpy as jnp
+    from functools import partial
+
+    from cake_tpu.ops.kvcache import init_cache
+    from cake_tpu.runtime.generator import prefill_fn
+    from cake_tpu.runtime.speculative import spec_replay_fn
+    from cake_tpu.utils.corpus import corpus_tokens
+
+    k, n_max, rounds, prompt_len = 4, 3, 6, 16
+    toks = corpus_tokens(CFG.vocab_size)[: CFG.max_seq_len]
+
+    cache = init_cache(CFG, batch=1, max_seq=CFG.max_seq_len)
+    prefill = jax.jit(partial(prefill_fn, config=CFG),
+                      donate_argnames=("cache",))
+    _, cache = prefill(params, jnp.asarray(toks[None, :prompt_len]), cache,
+                       jnp.asarray([prompt_len - 1], jnp.int32))
+    replay = jax.jit(
+        partial(spec_replay_fn, config=CFG, k=k, n_max=n_max, rounds=rounds),
+        donate_argnames=("cache",),
+    )
+    counts, pos, cache, acc = replay(
+        params, jnp.asarray(toks), jnp.int32(prompt_len), cache,
+        jnp.float32(0.0),
+    )
+    counts = np.asarray(counts)
+
+    # host reference: same propose convention (slots 0..p valid), forced
+    # accept = leading proposal/corpus matches + 1
+    p = prompt_len
+    want = []
+    for _ in range(rounds):
+        props = ngram_propose(toks[: p + 1].tolist(), n_max, k)
+        props = props + [-1] * (k - len(props))
+        c = 1
+        for i in range(k):
+            if props[i] == int(toks[p + 1 + i]):
+                c += 1
+            else:
+                break
+        want.append(c)
+        p += c
+
+    assert counts.tolist() == want
+    assert int(pos) == p
+    assert 1 <= counts.min() and counts.max() <= k + 1
+    assert np.isfinite(float(acc))
