@@ -245,6 +245,7 @@ def block_forward(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    sp_chunk: bool = False,
     ep_axis: str | None = None,
     ep_size: int | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
@@ -277,6 +278,7 @@ def block_forward(
         sp_size=sp_size,
         write_gate=write_gate,
         sp_prefill=sp_prefill,
+        sp_chunk=sp_chunk,
         bq=layer.get("bq"),
         bk=layer.get("bk"),
         bv=layer.get("bv"),
@@ -313,6 +315,7 @@ def forward_layers(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    sp_chunk: bool = False,
     ep_axis: str | None = None,
     ep_size: int | None = None,
 ) -> tuple[jax.Array, KVCache]:
@@ -330,8 +333,8 @@ def forward_layers(
                                   num_heads=num_heads, num_kv_heads=num_kv_heads,
                                   tp_axis=tp_axis, sp_axis=sp_axis,
                                   sp_size=sp_size, write_gate=write_gate,
-                                  sp_prefill=sp_prefill, ep_axis=ep_axis,
-                                  ep_size=ep_size)
+                                  sp_prefill=sp_prefill, sp_chunk=sp_chunk,
+                                  ep_axis=ep_axis, ep_size=ep_size)
         return h, (kc, vc)
 
     x, (k_new, v_new) = jax.lax.scan(body, x, (layers, cache.k, cache.v))

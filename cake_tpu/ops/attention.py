@@ -118,12 +118,14 @@ def attend(
     flash decode kernel, T>1 per-row routes to XLA).
 
     ``window``: sliding-window attention — key positions more than
-    ``window`` behind the query are masked out. Prefill rides the flash
-    kernel at the measured crossover (the lower bound is folded into its
-    block sweep: out-of-window KV blocks are neither fetched nor
-    computed); decode and per-row stay on XLA (the decode kernel's
-    frontier sweep has no lower bound), and an explicit ``impl="flash"``
-    there raises rather than silently attending over the full history.
+    ``window`` behind the query are masked out. Both flash kernels fold
+    the window lower bound into their block sweeps (out-of-window KV
+    blocks are neither fetched nor computed). Prefill rides the kernel at
+    the measured crossover; decode under ``impl="auto"`` stays XLA until
+    a measured win lands (flash_sweep ``decode_win*`` rows), with
+    ``impl="flash"``/``CAKE_PALLAS=1`` forcing the windowed kernel.
+    Per-row prefill (T>1 with ``[B]`` pos) stays XLA — not a
+    kernel-served shape.
     """
     t, d = q.shape[2], q.shape[3]
     s = k_all.shape[2]
@@ -243,6 +245,7 @@ def self_attention_block(
     sp_size: int = 1,
     write_gate: jax.Array | None = None,
     sp_prefill: bool | None = None,
+    sp_chunk: bool = False,
     bq: jax.Array | None = None,  # q/k/v projection biases (Qwen2 family)
     bk: jax.Array | None = None,
     bv: jax.Array | None = None,
@@ -274,6 +277,11 @@ def self_attention_block(
     one-token-per-shard prefill chunks — callers that can produce
     ``T_local == 1`` prefill must pass the flag.
 
+    ``sp_chunk`` selects a third sp mode (overriding both): chunked OFFSET
+    prefill against committed history — ``x`` is the full chunk replicated
+    on every sp shard, positioned at scalar ``pos`` (the admission /
+    shared-prefix serving path; see the sp branch below).
+
     ``write_gate`` (scalar bool): when running inside an SPMD-uniform pipeline
     loop every stage executes this code every step (collectives must be
     uniform across devices — a conditional ppermute/psum deadlocks); the gate
@@ -295,19 +303,15 @@ def self_attention_block(
     k = k.reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
     v = v.reshape(b, t, num_kv_heads, d).transpose(0, 2, 1, 3)
 
-    if window is not None and sp_axis is not None and sp_size > 1:
-        raise NotImplementedError(
-            "sliding-window attention does not compose with sequence "
-            "parallelism (the sp ring assumes a full causal window); run "
-            "Mistral-family models with sp=1"
-        )
     if sp_axis is not None and sp_size > 1:
         from cake_tpu.ops import ring
 
         quantized = isinstance(k_cache, kv.QuantizedKV)
         s_l = kv._kv_data(k_cache).shape[2]
         sp_idx = jax.lax.axis_index(sp_axis)
-        is_prefill = sp_prefill if sp_prefill is not None else t > 1
+        is_prefill = (not sp_chunk) and (
+            sp_prefill if sp_prefill is not None else t > 1
+        )
         # pos may be [B] (multi-stream sp serving: per-row frontiers) on
         # the decode path; the prefill path positions by chunk offset and
         # never reads it
@@ -349,7 +353,27 @@ def self_attention_block(
                     k_cache, v_cache, k, v, sp_axis, sp_size, gate=write_gate
                 )
             out = ring.ring_attention(q, k_att, v_att, sp_axis, sp_size,
-                                      q_off=my_off)
+                                      q_off=my_off, window=window)
+        elif sp_chunk:
+            # Chunked offset prefill over the sp-sharded window (the
+            # continuous-batching admission / shared-prefix remainder
+            # path): the chunk's T tokens run REPLICATED on every sp
+            # shard from global position ``pos`` against the committed
+            # history already in the range-sharded cache — owner-masked
+            # range write, then the exact softmax reassembled from
+            # per-shard partials (the T>1 generalization of distributed
+            # flash decode).
+            q = apply_rope(q, cos, sin, pos)
+            k = apply_rope(k, cos, sin, pos)
+            shard_start = sp_idx * s_l
+            k_cache, v_cache = ring.sp_range_cache_write(
+                k_cache, v_cache, k, v, pos, shard_start, gate=write_gate
+            )
+            out = ring.sp_decode_attend(
+                q, kv.dequant_kv(k_cache, q.dtype),
+                kv.dequant_kv(v_cache, q.dtype), pos, sp_axis, shard_start,
+                window=window,
+            )
         else:
             q = apply_rope(q, cos, sin, pos)
             k = apply_rope(k, cos, sin, pos)
@@ -359,7 +383,8 @@ def self_attention_block(
             )
             out = ring.sp_decode_attend(
                 q, kv.dequant_kv(k_cache, q.dtype),
-                kv.dequant_kv(v_cache, q.dtype), pos, sp_axis, shard_start
+                kv.dequant_kv(v_cache, q.dtype), pos, sp_axis, shard_start,
+                window=window,
             )
     else:
         q = apply_rope(q, cos, sin, pos)

@@ -41,6 +41,7 @@ def attend_stats(
     v: jax.Array,  # [B, KH, S, D]
     q_off,  # scalar: global position of q[..., 0, :]
     k_off,  # scalar: global position of k[..., 0, :]
+    window: int | None = None,  # sliding-window width (Mistral); None=full
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Partial causal GQA attention over one KV block.
 
@@ -50,9 +51,13 @@ def attend_stats(
     different KV blocks combine exactly via :func:`merge_stats` /
     :func:`combine_axis`.
 
-    Causality: key position ``k_off + s`` attends iff ``<= q_off + t``. Rows
-    with no valid key yield ``m = NEG_INF, l = 0, o = 0`` and drop out of any
-    merge. ``q_off`` may be scalar or ``[B]`` (per-batch-row causal
+    Causality: key position ``k_off + s`` attends iff ``<= q_off + t``. With
+    ``window`` the lower bound ``> q_off + t - window`` is ANDed in (the
+    sliding-window mask of :func:`cake_tpu.ops.attention._attend_xla`,
+    applied blockwise — a block wholly outside some row's window simply
+    yields ``m = NEG_INF, l = 0`` for that row and drops out of the merge).
+    Rows with no valid key yield ``m = NEG_INF, l = 0, o = 0`` and drop out
+    of any merge. ``q_off`` may be scalar or ``[B]`` (per-batch-row causal
     frontiers — the multi-stream sp serving path).
     """
     b, n_heads, t, d = q.shape
@@ -68,11 +73,15 @@ def attend_stats(
     qpos = jax.lax.broadcasted_iota(jnp.int32, (t, s), 0)
     q_off = jnp.asarray(q_off, jnp.int32)
     if q_off.ndim == 0:
-        mask = (kpos <= qpos + q_off)[None, None, None]  # [1,1,1,T,S]
+        mask = kpos <= qpos + q_off  # [T, S]
+        if window is not None:
+            mask &= kpos > qpos + q_off - window
+        mask = mask[None, None, None]  # [1,1,1,T,S]
     else:
-        mask = (kpos[None] <= qpos[None] + q_off[:, None, None])[
-            :, None, None
-        ]  # [B,1,1,T,S]
+        mask = kpos[None] <= qpos[None] + q_off[:, None, None]  # [B,T,S]
+        if window is not None:
+            mask &= kpos[None] > qpos[None] + q_off[:, None, None] - window
+        mask = mask[:, None, None]  # [B,1,1,T,S]
     scores = jnp.where(mask, scores, NEG_INF)
 
     m = jnp.max(scores, axis=-1)  # [B, KH, G, T]
@@ -129,6 +138,7 @@ def ring_attention(
     axis_size: int,
     q_off,  # scalar: global position of this shard's q[..., 0, :]
     chunk_starts: jax.Array | None = None,  # [axis_size] global start per shard
+    window: int | None = None,  # sliding-window width (Mistral); None=full
 ) -> jax.Array:
     """Causal ring attention inside ``shard_map`` over ``axis_name``.
 
@@ -139,10 +149,21 @@ def ring_attention(
 
     ``chunk_starts[i]`` is the global position of shard *i*'s ``k[..., 0, :]``;
     defaults to the uniform layout ``i * T_l``.
+
+    ``window``: sliding-window attention. The mask's lower bound folds into
+    every blockwise visit, and a visiting block that is WHOLLY outside this
+    shard's window — every key at or below ``q_off - window`` — skips the
+    score/merge math entirely (``lax.cond`` around pure compute; the
+    ppermute rotation stays SPMD-uniform). Long-window Mistral over sp
+    therefore pays window-proportional FLOPs, not prompt-proportional —
+    the sp twin of the windowed flash kernel's bounded block sweep.
     """
     b, n_heads, t, d = q.shape
     if axis_size == 1:
-        o, m, l = attend_stats(q, k, v, q_off, 0 if chunk_starts is None else chunk_starts[0])
+        o, m, l = attend_stats(
+            q, k, v, q_off, 0 if chunk_starts is None else chunk_starts[0],
+            window=window,
+        )
         return finalize_stats(o, m, l, q.dtype)
 
     my = jax.lax.axis_index(axis_name)
@@ -159,8 +180,28 @@ def ring_attention(
     def body(step, carry):
         k, v, o, m, l = carry
         src = (my - step) % axis_size
-        o_p, m_p, l_p = attend_stats(q, k, v, q_off, chunk_starts[src])
-        o, m, l = merge_stats(o, m, l, o_p, m_p, l_p)
+        k_start = chunk_starts[src]
+
+        def visit(args):
+            k, v, o, m, l = args
+            o_p, m_p, l_p = attend_stats(q, k, v, q_off, k_start,
+                                         window=window)
+            return merge_stats(o, m, l, o_p, m_p, l_p)
+
+        if window is None:
+            o, m, l = visit((k, v, o, m, l))
+        else:
+            # Block visibility for this shard's queries (rows q_off ..
+            # q_off+t-1): any key in [k_start, k_start + s) inside
+            # (q_off - window, q_off + t - 1]?  Causality's upper bound and
+            # the window's lower bound, evaluated blockwise.
+            s = k.shape[2]
+            visible = (k_start <= jnp.asarray(q_off) + t - 1) & (
+                k_start + s - 1 > jnp.asarray(q_off) - window
+            )
+            o, m, l = jax.lax.cond(
+                visible, visit, lambda args: args[2:], (k, v, o, m, l)
+            )
         # Rotate the KV block to the neighbor (the final rotation restores
         # the original layout, so the cache leaves this function unmoved).
         k, v = jax.lax.ppermute((k, v), axis_name, perm)
@@ -171,21 +212,29 @@ def ring_attention(
 
 
 def sp_decode_attend(
-    q: jax.Array,  # [B, H, 1, D] (replicated across sp, already roped)
+    q: jax.Array,  # [B, H, T, D] (replicated across sp, already roped)
     k_local: jax.Array,  # [B, KH, S_l, D] this shard's KV slice
     v_local: jax.Array,
     pos,  # scalar or [B]: global position(s) of the query token(s)
     axis_name: str,
     shard_start,  # scalar: global position of k_local[..., 0, :]
+    window: int | None = None,  # sliding-window width (Mistral); None=full
 ) -> jax.Array:
     """Distributed flash decoding over a sequence-sharded KV cache.
 
     Each shard computes partial stats over its slice (keys beyond the causal
     frontier ``pos`` masked — scalar, or ``[B]`` for multi-stream serving
-    with per-row frontiers), then the exact softmax is reassembled with one
-    pmax + two psum. Traffic per step is O(B·H·D), independent of S.
+    with per-row frontiers; a sliding ``window``'s lower bound masks the
+    same way, so an out-of-window shard contributes ``m = NEG_INF, l = 0``
+    and drops out), then the exact softmax is reassembled with one pmax +
+    two psum. Traffic per step is O(B·H·T·D), independent of S.
+
+    ``T > 1`` is the chunked-admission mode (sp serving): the chunk's T
+    queries run replicated on every shard, each row's causal frontier is
+    ``pos + t`` — the same math :func:`attend_stats` already does blockwise.
     """
-    o, m, l = attend_stats(q, k_local, v_local, pos, shard_start)
+    o, m, l = attend_stats(q, k_local, v_local, pos, shard_start,
+                           window=window)
     o, m, l = combine_axis(o, m, l, axis_name)
     return finalize_stats(o, m, l, q.dtype)
 
@@ -257,6 +306,51 @@ def sp_chunked_cache_write(
     def write(cache, new):
         pairs, rebuild = _leaf_pairs(cache, new)
         return rebuild([write_leaf(c, n) for c, n in pairs])
+
+    return write(k_cache, k_new), write(v_cache, v_new)
+
+
+def sp_range_cache_write(
+    k_cache,  # [B, KH, S_l, D] local slice of the range-sharded cache
+    v_cache,
+    k_new: jax.Array,  # [B, KH, C, D] chunk KV, computed REPLICATED per shard
+    v_new: jax.Array,
+    pos0,  # scalar: global position of the chunk's first token
+    shard_start,  # scalar: global position of this shard's slot 0
+    gate: jax.Array | None = None,
+):
+    """Owner-masked RANGE write into a sequence-sharded cache.
+
+    The chunked-admission twin of :func:`sp_cache_write`: a C-token chunk
+    occupies global positions ``[pos0, pos0 + C)`` which may span shard
+    boundaries, and every shard already holds the full chunk KV (the
+    admission row's activations are replicated over sp), so there is no
+    gather — each shard selects the in-range slots of its own window slice
+    via a positional gather + select, exactly the per-slot pattern
+    :func:`sp_chunked_cache_write` uses after its all-gather. Quantized
+    halves quantize-on-write per slot like every other sp write path.
+    """
+    from cake_tpu.ops.kvcache import _kv_data
+
+    s_l = _kv_data(k_cache).shape[2]
+    c = k_new.shape[2]
+    gpos = (jnp.asarray(shard_start, jnp.int32)
+            + jnp.arange(s_l, dtype=jnp.int32))
+    idx = gpos - jnp.asarray(pos0, jnp.int32)  # in-chunk index per slot
+    valid = (idx >= 0) & (idx < c)
+    if gate is not None:
+        valid = valid & gate
+
+    def write_leaf(cache, new):
+        # gather the chunk value owned by each local slot (clamped for
+        # out-of-range slots, which the select below discards)
+        vals = jnp.take(new, jnp.clip(idx, 0, c - 1), axis=2)
+        sel = valid.reshape((1, 1, s_l) + (1,) * (cache.ndim - 3))
+        return jnp.where(sel, vals.astype(cache.dtype), cache)
+
+    def write(cache, new):
+        pairs, rebuild = _leaf_pairs(cache, new)
+        return rebuild([write_leaf(c_, n) for c_, n in pairs])
 
     return write(k_cache, k_new), write(v_cache, v_new)
 

@@ -75,6 +75,7 @@ def _pipeline_layers(
     kv_heads_l: int,
     sp: int = 1,
     sp_prefill: bool = False,
+    sp_chunk: bool = False,
 ):
     """Run the staged pipeline loop. Returns (x_on_stage0, ck, cv).
 
@@ -99,6 +100,7 @@ def _pipeline_layers(
             layers, x, KVCache(k=ck, v=cv), cos, sin, pos, config,
             num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
             sp_axis=SP, sp_size=sp, write_gate=active, sp_prefill=sp_prefill,
+            sp_chunk=sp_chunk,
         )
         x = jnp.where(active, h, x)
         x = jax.lax.ppermute(x, STAGE, perm)
@@ -593,22 +595,29 @@ def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
     prompt's final token (meaningful on the final chunk; ignored
     otherwise). Chunked prefill is exact: chunk ``j`` attends the staging
     cache's committed positions ``< pos0`` plus its own causal prefix, the
-    same math as a single full-prompt pass. Requires ``plan.sp == 1``.
+    same math as a single full-prompt pass.
+
+    ``plan.sp > 1`` (r5): the chunk's tokens run REPLICATED over the sp
+    axis against the sequence-sharded staging cache — owner-masked range
+    write (``ring.sp_range_cache_write``) plus the T>1 distributed-flash
+    chunk attend, so continuous admission composes with the
+    sequence-sharded serving window.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
-    if plan.sp != 1:
-        raise ValueError("admission prefill requires sp == 1 (serving plane)")
 
     def step(params, tokens, cache, pos0, last_local):
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq, config.rope_theta,
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
         x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos0, config,
-            plan.num_stages, heads_l, kv_heads_l,
+            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+            sp_chunk=plan.sp > 1,
         )
+        # the chunk activations are replicated over sp (every shard computes
+        # the full chunk), so the sp==1 last-index selection applies
         x_last = _select_last_sp(x, last_local, 1)
         x_last = _select_stage0(x_last)
         logits = _head_logits(params, x_last, config)
@@ -875,12 +884,16 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     (:func:`_pipelined_prefill_layers`) — ~num_stages× prompt throughput
     once the pipeline fills, identical results.
 
-    ``with_offset = True`` (requires ``sp == 1``, ``microbatch == 1``)
-    appends a trailing scalar ``pos0`` argument: the fed tokens occupy
-    global positions ``pos0..pos0+T-1`` and attend the cache's committed
+    ``with_offset = True`` (requires ``microbatch == 1``) appends a
+    trailing scalar ``pos0`` argument: the fed tokens occupy global
+    positions ``pos0..pos0+T-1`` and attend the cache's committed
     positions below ``pos0`` — the shared-prefix serving path, where a
     common system prompt is prefilled once and each stream's remainder is
-    prefilled at the prefix boundary.
+    prefilled at the prefix boundary. With ``sp > 1`` (r5) the remainder
+    bucket runs REPLICATED over the sp axis against the range-sharded
+    cache (``ring.sp_range_cache_write`` + the T>1 distributed-flash
+    chunk attend) — sp× redundant FLOPs on the remainder in exchange for
+    composing the prefix store with a sequence-sharded window.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     if microbatch > 1 and plan.sp != 1:
@@ -890,9 +903,9 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             "pipelined (microbatch) prefill requires num_stages > 1 — with "
             "one stage there is nothing to overlap, only per-chunk overhead"
         )
-    if with_offset and (plan.sp != 1 or microbatch > 1):
-        raise ValueError("offset prefill requires sp == 1 and "
-                         "microbatch == 1")
+    if with_offset and microbatch > 1:
+        raise ValueError("offset prefill requires microbatch == 1")
+    chunk_mode = with_offset and plan.sp > 1
 
     def step(params, tokens, cache, last_index, *rest):
         pos0 = rest[0] if with_offset else 0
@@ -926,18 +939,20 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
             x, ck, cv = _pipeline_layers(
                 x, params["layers"], cache.k, cache.v, cos, sin, pos0,
                 config, plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
-                sp_prefill=True,
+                sp_prefill=not chunk_mode, sp_chunk=chunk_mode,
             )
         # slice the wanted position first so the cross-stage select moves
         # [B, hidden], not the whole [B, T, hidden] activation
-        x_last = _select_last_sp(x, last_index, plan.sp)
+        # (chunk mode computes the bucket replicated over sp, so the sp==1
+        # owner-select applies)
+        x_last = _select_last_sp(x, last_index, 1 if chunk_mode else plan.sp)
         x_last = _select_stage0(x_last)
         logits = _head_logits(params, x_last, config)
         return logits, KVCache(k=ck, v=cv)
 
     in_specs = [
         param_specs(params_like),
-        P(DP, SP),
+        P(DP, None) if chunk_mode else P(DP, SP),
         cache_specs(kv_quant),
         P(DP),
     ]
