@@ -29,7 +29,11 @@ across the sp axis and every stream still decodes at its own frontier —
 the per-row positions flow through the owner-masked sp cache write and the
 per-row-masked distributed flash decode (ops/ring.py). This is the
 many-LONG-streams composition: window HBM splits over sp while the batch
-splits over dp. Admission, the prefix store, speculation, and the
+splits over dp. Continuous admission and the prefix store compose with
+``sp > 1`` too (r5): the staged row's chunks run replicated over sp
+against the sequence-sharded staging cache (owner-masked range writes +
+the T>1 distributed-flash chunk attend, pipeline.build_admit_prefill),
+and the slot splice is sharding-agnostic. Speculation and the
 interleaved schedules remain ``sp == 1`` features (gated with clear
 errors).
 
@@ -116,6 +120,7 @@ class BatchGenerator:
         ep: int = 1,
         devices=None,
         block_size: int = 1,
+        block_size_max: int = 0,
         kv_quant: str | None = None,
         admit_chunk: int | None = None,
         prefix_share_min: int = 32,
@@ -132,9 +137,10 @@ class BatchGenerator:
                                   dp=dp, sp=1, ep=ep, devices=devices)
         # sp > 1 (r4): multi-stream serving over a sequence-sharded window —
         # per-row frontiers flow through the sp owner-masked KV write and
-        # per-row-masked distributed flash decode. The admission /
-        # prefix-store / speculation / interleave machinery still requires
-        # sp == 1 programs and is gated off below.
+        # per-row-masked distributed flash decode. Admission and the
+        # prefix store compose with sp > 1 (r5, chunk-replicated staging
+        # programs); speculation / interleave still require sp == 1 and
+        # are gated off below.
         if plan.sp != 1 and spec_k:
             raise ValueError(
                 "batched speculation requires sp == 1 (the verification "
@@ -156,6 +162,26 @@ class BatchGenerator:
             )
         self.tokenizer = tokenizer
         self.block_size = max(1, block_size)
+        # Adaptive decode blocks (the continuous-batching dispatch lever):
+        # with block_size_max > block_size, the fused block DOUBLES each
+        # dispatch while the arrival queue is empty — amortizing the
+        # per-dispatch host sync over more tokens — and snaps back to
+        # block_size the moment an arrival waits, so admission latency
+        # stays one base block. Grown sizes live on a doubling ladder
+        # (base*2^k) so the window-headroom cap below can halve back onto
+        # a compiled program; block_size_max is rounded down to the
+        # ladder. warm_blocks() compiles the ladder outside the serving
+        # window. The r4 churn row measured ~1.5 s of dispatch wall per
+        # ~190 ms of device math through the tunnel — block growth is the
+        # repo's own diagnosed fix (BASELINE.md churn row).
+        bmax = max(0, int(block_size_max))
+        if bmax > self.block_size:
+            k = (bmax // self.block_size).bit_length() - 1
+            self.block_size_max = self.block_size * (1 << k)
+        else:
+            self.block_size_max = self.block_size
+        self._adaptive = self.block_size
+        self.__block_progs: dict = {}
         # int8 KV roughly doubles servable batch x window on a fixed HBM
         # budget (quantize-on-write per slot, kvcache.QuantizedKV) — the
         # serving-side long-context lever
@@ -544,7 +570,7 @@ class BatchGenerator:
         # every row keeps >= 1 remainder token. Bit-identical output —
         # positions and tokens are unchanged, only the redundancy goes.
         lcp = 0
-        if b > 1 and self._prefix_share_min and self.plan.sp == 1:
+        if b > 1 and self._prefix_share_min:
             first = self.streams[0].prompt
             lcp = min(len(s.prompt) for s in self.streams) - 1
             for i in range(lcp):
@@ -563,9 +589,12 @@ class BatchGenerator:
         # path). The cap still covers every remainder (n_max < max_seq).
         n_max = max(len(s.prompt) for s in self.streams)
         t_pad = min(_bucket(n_max - lcp, self.max_seq), self.max_seq - lcp)
-        if self.plan.sp > 1 and t_pad % self.plan.sp:
+        if self.plan.sp > 1 and lcp == 0 and t_pad % self.plan.sp:
             # sp prefill shards the bucket over the ring: round up to a
-            # multiple of sp (junk slots stay beyond every frontier)
+            # multiple of sp (junk slots stay beyond every frontier). The
+            # shared-prefix remainder path (lcp > 0) runs chunk-replicated
+            # over sp instead — no divisibility requirement, and rounding
+            # up could push the bucket past max_seq - lcp.
             t_pad = min(-(-t_pad // self.plan.sp) * self.plan.sp,
                         self.max_seq)
         tokens = np.zeros((b, t_pad), np.int32)
@@ -656,10 +685,10 @@ class BatchGenerator:
         emitted in that step's row and the stream joins the batch. Output
         is bit-identical to the same (seed, stream_id, prompt) in any other
         batch or admission timing (per-row positions + per-row token
-        indices). Requires ``sp == 1`` (the admission programs are the
-        sp == 1 serving path)."""
-        if self.plan.sp != 1:
-            raise ValueError("continuous admission requires sp == 1")
+        indices). Composes with ``sp > 1`` (r5): the staged row's chunks
+        run replicated over sp against the sequence-sharded staging cache
+        (owner-masked range writes + the chunk attend,
+        pipeline.build_admit_prefill)."""
         self._arrivals.append((self._encode(prompt), stream_id))
 
     def pending_admissions(self) -> int:
@@ -928,9 +957,7 @@ class BatchGenerator:
         completion here and the first token is returned (recorded;
         subsequent ``step()`` calls carry the stream forward). Use
         ``enqueue`` to interleave the prefill with decode instead. Raises
-        if no stream is done. Requires ``sp == 1`` like ``enqueue``."""
-        if self.plan.sp != 1:
-            raise ValueError("continuous admission requires sp == 1")
+        if no stream is done."""
         if not self.streams:
             raise RuntimeError("set_prompts first")
         ids = self._encode(prompt)
@@ -1268,6 +1295,76 @@ class BatchGenerator:
         local = len(self.streams) // self.plan.dp
         return il if local % self.plan.num_stages == 0 else serial
 
+    def _block_prog(self, steps: int):
+        """The fused decode program for an adaptive-ladder block size
+        (compiled lazily, memoized per (steps, schedule)); the base size
+        reuses the constructor's programs."""
+        if steps == self.block_size and self._decode_block is not None:
+            return self._pick_decode(block=True)
+        il_ok = (
+            self._decode_single_il is not None
+            and (len(self.streams) // self.plan.dp)
+            % self.plan.num_stages == 0
+        )
+        key = (steps, il_ok)
+        prog = self.__block_progs.get(key)
+        if prog is None:
+            if il_ok:
+                prog = self._pinned(build_interleaved_decode(
+                    self.config, self.settings, self.plan,
+                    params_like=self.params, steps=steps,
+                    kv_quant=self.kv_quant))
+            else:
+                prog = self._pinned(build_sharded_decode(
+                    self.config, self.settings, self.plan,
+                    params_like=self.params, steps=steps, per_row=True,
+                    kv_quant=self.kv_quant))
+            self.__block_progs[key] = prog
+        return prog
+
+    def _pick_block_size(self, live_pos) -> int:
+        """Adaptive block size for this dispatch. Base-block behavior when
+        the ladder is off. With the ladder on: snap to the base block the
+        moment an arrival waits (admission latency stays one base block),
+        otherwise dispatch the current ladder rung and double it for next
+        time. The window-headroom cap halves back down the ladder so a
+        stream near its window edge doesn't buy a dispatch that is mostly
+        clamped overrun writes."""
+        base = self.block_size
+        if self.block_size_max <= base:
+            return base
+        if self._arrivals or self._staging is not None:
+            self._adaptive = base
+            return base
+        size = self._adaptive
+        if self._adaptive < self.block_size_max:
+            self._adaptive = min(self._adaptive * 2, self.block_size_max)
+        headroom = self.max_seq - int(min(live_pos))
+        while size > max(1, base) and size > headroom:
+            size //= 2
+        return max(size, base)
+
+    def warm_blocks(self) -> None:
+        """Compile every adaptive-ladder program against the live batch
+        shapes OUTSIDE the serving window (sacrificial state copies are
+        donated and discarded; the live state is untouched). Servers and
+        benches call this once after set_prompts, for the same reason
+        warm_admission exists: a ladder rung's first use must not pay XLA
+        compilation mid-serving."""
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        size = self.block_size
+        while size < self.block_size_max:
+            size = min(size * 2, self.block_size_max)
+            prog = self._block_prog(size)
+            cache = jax.tree.map(lambda x: x.copy(), self.cache)
+            out = prog(
+                self.params, self._last_tokens, cache,
+                jnp.asarray(self._pos), self._keys, self._history,
+                self._hist_slot, jnp.asarray(self._index),
+            )
+            jax.block_until_ready(out)
+
     def _step_decode(self):
         # Buffered fused-block rows are EARLIER tokens than anything a new
         # spec round would produce: drain them first, or a round that finds
@@ -1297,11 +1394,13 @@ class BatchGenerator:
         # _emit marks it done at the window-filling token so the overrun
         # outputs are discarded — one long stream near its edge must not
         # force every stream to single-step dispatches.
-        can_block = self._decode_block is not None
-        if can_block:
+        can_block = (self._decode_block is not None
+                     or self.block_size_max > self.block_size)
+        size = self._pick_block_size(live) if can_block else 1
+        if size > 1:
             t0 = time.perf_counter()
             toks, self.cache, self._history, self._hist_slot = (
-                self._pick_decode(block=True)(
+                self._block_prog(size)(
                     self.params, self._last_tokens, self.cache,
                     jnp.asarray(self._pos), self._keys, self._history,
                     self._hist_slot, jnp.asarray(self._index),
@@ -1310,8 +1409,8 @@ class BatchGenerator:
             rows = self._host(toks)  # [steps, B]
             self._n_decode_dispatches += 1
             self._busy_s += time.perf_counter() - t0
-            self._pos = self._pos + self.block_size
-            self._index = self._index + self.block_size
+            self._pos = self._pos + size
+            self._index = self._index + size
             self._last_tokens = toks[-1].astype(jnp.int32)
             self._block_buf = [rows[i] for i in range(rows.shape[0])]
             return self._emit(self._block_buf.pop(0))

@@ -888,3 +888,102 @@ def test_spec_chain_syncs_once_per_rounds_and_matches_host_loop(params):
         assert g_row[:n] == w_row[:n]
     assert st_host["spec_chains"] == 0
     assert st_fused["spec_chains"] >= 1
+
+
+def test_adaptive_block_bit_identical(params):
+    """The adaptive ladder (block doubling on an empty arrival queue) must
+    not change any stream's greedy output — same per-row positions and
+    in-program key schedule regardless of dispatch granularity."""
+    settings = SamplerSettings(**GREEDY)
+    want = [_single_stream(params, p, 12, settings) for p in PROMPTS]
+    got = _batch_run(params, PROMPTS, 12, settings, dp=1, block_size=2,
+                     block_size_max=8)
+    assert got == want
+
+
+def test_adaptive_block_sampled_invariant(params):
+    """Sampled streams too: the per-row absolute token index keys every
+    draw, so ladder growth cannot perturb the sampling schedule."""
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=11)
+    assert (
+        _batch_run(params, PROMPTS, 8, settings, dp=1, block_size=2,
+                   block_size_max=8)
+        == _batch_run(params, PROMPTS, 8, settings, dp=1)
+    )
+
+
+def test_adaptive_block_grows_then_snaps_back_on_arrival(params):
+    """The ladder doubles while no arrival waits and snaps back to the
+    base block the moment one is queued (admission latency stays one base
+    block), then the admitted stream is bit-identical to its solo run."""
+    settings = SamplerSettings(**GREEDY)
+    cfg = tiny(max_seq_len=64, eos_token_id=-1)
+    g = BG(cfg, params, settings=settings, block_size=2, block_size_max=8)
+    g.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+    for _ in range(8):
+        g.step()
+    # queue empty for several dispatches: the ladder grew past the base
+    assert g._adaptive > g.block_size
+    g.streams[0].done = True
+    g.enqueue(list(PROMPTS[2]), stream_id=7)
+    live_pos = [g._pos[i] for i, s in enumerate(g.streams)
+                if s.active and not s.done]
+    assert g._pick_block_size(live_pos) == g.block_size  # snap-back
+    for _ in range(40):
+        g.step()
+        if all(s.done or not s.active for s in g.streams):
+            break
+        if g.streams[0].stream_id == 7 and len(
+                g.streams[0].generated) >= 6:
+            break
+    admitted = next(s for s in g.streams if s.stream_id == 7)
+    gen7 = LlamaGenerator(cfg, params, settings=settings)
+    gen7.set_prompt(list(PROMPTS[2]))
+    # stream_id drives the key; greedy here so id does not matter
+    want = [gen7.next_token(i).id for i in range(len(admitted.generated))]
+    assert admitted.generated == want[:len(admitted.generated)]
+    assert len(admitted.generated) >= 4
+
+
+def test_adaptive_block_headroom_cap_near_window(params):
+    """Streams near their window edge must halve the grown block back down
+    the ladder instead of dispatching mostly clamped overrun writes; every
+    stream still fills its window exactly."""
+    settings = SamplerSettings(**GREEDY)
+    cfg = tiny(max_seq_len=32, eos_token_id=-1)
+    g = BG(cfg, params, settings=settings, block_size=2, block_size_max=16)
+    g.set_prompts([[5, 9, 2, 11], [3, 1, 4, 1]])
+    single = LlamaGenerator(cfg, params, settings=settings)
+    single.set_prompt([5, 9, 2, 11])
+    n = 32 - 4  # window minus prompt
+    want = [single.next_token(i).id for i in range(n)]
+    out = g.generate(n)
+    assert out[0] == want
+    assert all(s.done for s in g.streams)  # window-full, cleanly
+
+
+def test_warm_blocks_precompiles_ladder(params):
+    """warm_blocks compiles every ladder rung outside the serving window
+    and leaves the live state untouched (outputs discarded)."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, block_size=2, block_size_max=8)
+    g.set_prompts([list(p) for p in PROMPTS])
+    before = [list(s.generated) for s in g.streams]
+    g.warm_blocks()
+    assert [list(s.generated) for s in g.streams] == before
+    progs = g._BatchGenerator__block_progs
+    assert {s for s, _ in progs} == {4, 8}
+    want = [_single_stream(params, p, 10, settings) for p in PROMPTS]
+    assert g.generate(10) == want
+
+
+def test_block_size_max_rounds_down_to_ladder(params):
+    """A non-power-of-two max rounds down to base*2^k so the headroom
+    halving always lands on a compiled rung."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, block_size=3, block_size_max=13)
+    assert g.block_size_max == 12
+    g = BG(CFG, params, settings=settings, block_size=4, block_size_max=4)
+    assert g.block_size_max == 4
+    g = BG(CFG, params, settings=settings, block_size=4)
+    assert g.block_size_max == 4

@@ -7,8 +7,10 @@ flow through the sp owner-masked KV write (`ring.sp_cache_write` with
 (`ring.attend_stats`/`sp_decode_attend`), so N concurrent streams can
 decode against a KV window sharded across chips — the composition that
 serves many LONG streams on a chip set (window HBM splits over sp while
-the batch splits over dp). Admission / prefix store / speculation /
-interleave remain sp == 1 and are gated with clear errors.
+the batch splits over dp). r5: continuous admission, the prefix store,
+and sliding-window attention compose with sp > 1 too (chunk-replicated
+staging programs + the windowed sp masks); speculation / interleave
+remain sp == 1 and are gated with clear errors.
 
 The bar: streams match the sp=1 serving oracle token-for-token (sp
 reassembles the exact softmax via pmax/psum, so logits agree to reduction
@@ -83,16 +85,13 @@ def test_sp_serving_long_window_per_stream_parity(params):
 
 
 def test_sp_serving_gates_unsupported_features(params):
+    """What remains sp == 1 after r5: speculation and the interleaved
+    schedules (admission and the prefix store now compose — see below)."""
     settings = SamplerSettings(temperature=0.0)
     plan = MeshPlan.build(CFG, sp=2)
     with pytest.raises(ValueError, match="sp == 1"):
         BatchGenerator(CFG, params, plan=plan, settings=settings, spec_k=4)
     g = BatchGenerator(CFG, params, plan=plan, settings=settings)
-    g.set_prompts([list(p) for p in PROMPTS])
-    with pytest.raises(ValueError, match="sp == 1"):
-        g.enqueue([1, 2, 3], stream_id=9)
-    with pytest.raises(ValueError, match="sp == 1"):
-        g.admit([1, 2, 3], stream_id=9)
     assert not g._interleave  # interleaved schedules are sp == 1
 
 
@@ -116,3 +115,125 @@ def test_sp_cache_write_per_row_owner_masking():
     assert (np.asarray(k1)[1, :, 1] == 1).all()
     assert (np.asarray(v1)[2, :, 2] == 2).all()
     assert (np.asarray(k1)[0] == 0).all()
+
+
+@pytest.mark.parametrize("mesh_kw", [dict(sp=2), dict(sp=2, num_stages=2)])
+def test_sp_admission_enqueue_matches_sp1_oracle(params, mesh_kw):
+    """r5: continuous admission over a sequence-sharded window — the
+    arrival's chunks run replicated over sp into the sp-sharded staging
+    cache (range writes + chunk attend); the admitted stream and the
+    untouched neighbor both match the sp=1 run token-for-token."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    new_prompt = [2, 8, 1, 7, 6, 5, 4, 3]  # 8 tokens -> 2 chunks of 4
+
+    def run(plan):
+        g = BatchGenerator(CFG, params, plan=plan, settings=settings,
+                           admit_chunk=4)
+        g.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+        g.step(), g.step()
+        g.streams[0].done = True
+        g.enqueue(list(new_prompt), stream_id=7)
+        for _ in range(12):
+            g.step()
+        admitted = next(s for s in g.streams if s.stream_id == 7)
+        neighbor = next(s for s in g.streams if s.stream_id == 1)
+        return list(admitted.generated), list(neighbor.generated)
+
+    want_adm, want_nb = run(None)  # sp == 1 oracle
+    got_adm, got_nb = run(MeshPlan.build(CFG, **mesh_kw))
+    assert len(got_adm) >= 4
+    assert got_adm == want_adm
+    assert got_nb == want_nb
+
+
+def test_sp_shared_prefix_and_store_match_sp1(params):
+    """r5: the shared-prefix batch prefill (prefix staged once, broadcast,
+    remainders at offset) and a later arrival's prefix-store hit both run
+    over the sp-sharded staging cache and match the sp=1 oracle."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    shared = [7, 3, 9, 1, 4, 6, 2, 8, 5, 11, 13, 12]  # 12-token prefix
+    prompts = [shared + [20], shared + [21, 22]]
+    arrival = shared + [23]
+
+    def run(plan):
+        g = BatchGenerator(CFG, params, plan=plan, settings=settings,
+                           prefix_share_min=8, prefix_block=4)
+        g.set_prompts([list(p) for p in prompts])
+        outs = g.generate(4)
+        g.streams[0].done = True
+        g.enqueue(list(arrival), stream_id=9)
+        for _ in range(12):
+            g.step()
+        adm = next(s for s in g.streams if s.stream_id == 9)
+        return outs, list(adm.generated), g._prefix_hits
+
+    want_outs, want_adm, hits1 = run(None)
+    got_outs, got_adm, hits2 = run(MeshPlan.build(CFG, sp=2))
+    assert got_outs == want_outs
+    n = min(len(got_adm), len(want_adm))
+    assert n >= 4 and got_adm[:n] == want_adm[:n]
+    # the arrival actually hit the stored prefix row on both layouts
+    assert hits1 >= 1 and hits2 >= 1
+
+
+def test_sp_windowed_serving_matches_sp1(params):
+    """r5: sliding-window attention composes with sp — the window's lower
+    bound masks each shard's local slice and out-of-window shards drop out
+    of the psum merge. Decode past the window matches the sp=1 windowed
+    oracle (the r4 NotImplementedError is gone)."""
+    wcfg = tiny(model_type="mistral", sliding_window=8, max_seq_len=64)
+    wparams = llama.init_params(wcfg, jax.random.PRNGKey(5))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+
+    def run(plan):
+        g = BatchGenerator(wcfg, wparams, plan=plan, settings=settings)
+        g.set_prompts([list(p) for p in PROMPTS])
+        return g.generate(16)  # prompt+16 > window: lower bound active
+
+    want = run(None)
+    got = run(MeshPlan.build(wcfg, sp=2))
+    assert got == want
+
+
+def test_sp_windowed_ring_prefill_matches_sp1(params):
+    """r5: windowed RING prefill — a long prompt sharded over sp=4 chunks
+    with a window smaller than a chunk, so some visiting blocks are wholly
+    out-of-window (the lax.cond compute-skip path) and the rest fold the
+    window lower bound into their blockwise mask."""
+    wcfg = tiny(model_type="mistral", sliding_window=4, max_seq_len=64)
+    wparams = llama.init_params(wcfg, jax.random.PRNGKey(5))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    long_prompt = [(i * 7) % 29 + 1 for i in range(32)]  # 32 = 4 x 8-chunks
+
+    def run(plan):
+        g = BatchGenerator(wcfg, wparams, plan=plan, settings=settings)
+        g.set_prompts([list(long_prompt), list(PROMPTS[0])])
+        return g.generate(8)
+
+    want = run(None)
+    got = run(MeshPlan.build(wcfg, sp=4))
+    assert got == want
+
+
+def test_sp_range_cache_write_spans_shards():
+    """Unit: a chunk spanning a shard boundary writes each shard's
+    in-range slots only (emulated shard-locally on both shards)."""
+    from cake_tpu.ops.ring import sp_range_cache_write
+
+    b, kh, s_l, d = 2, 2, 4, 8
+    kc = jnp.zeros((b, kh, s_l, d))
+    vc = jnp.zeros((b, kh, s_l, d))
+    c = 3
+    kn = jnp.arange(1, c + 1, dtype=jnp.float32).reshape(1, 1, c, 1)
+    kn = jnp.broadcast_to(kn, (b, kh, c, d))
+    vn = 10.0 * kn
+    pos0 = 3  # global slots 3, 4, 5
+    # shard 0 (start 0): only global slot 3 (chunk idx 0) in range
+    k0, v0 = sp_range_cache_write(kc, vc, kn, vn, pos0, 0)
+    assert (np.asarray(k0)[:, :, 3] == 1).all()
+    assert (np.asarray(k0)[:, :, :3] == 0).all()
+    # shard 1 (start 4): global slots 4, 5 -> local 0, 1 (chunk idx 1, 2)
+    k1, v1 = sp_range_cache_write(kc, vc, kn, vn, pos0, 4)
+    assert (np.asarray(k1)[:, :, 0] == 2).all()
+    assert (np.asarray(v1)[:, :, 1] == 30).all()
+    assert (np.asarray(k1)[:, :, 2:] == 0).all()
