@@ -546,9 +546,14 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     # dispatch wall vs ~190 ms device math (BASELINE.md). 0 disables.
     block_max = int(os.environ.get("CAKE_BENCH_BLOCK_MAX",
                                    str(4 * multistep)))
+    # CAKE_BENCH_LOOKAHEAD=1: double-buffer the block dispatches (the
+    # device computes block N+1 while block N's rows ride the tunnel to
+    # the host) — the second r5 churn lever, orthogonal to block growth
+    lookahead = os.environ.get("CAKE_BENCH_LOOKAHEAD") == "1"
     gen = BatchGenerator(config, params, settings=settings,
                          block_size=multistep, block_size_max=block_max,
-                         kv_quant=kv_quant, admit_chunk=admit_chunk)
+                         lookahead=lookahead, kv_quant=kv_quant,
+                         admit_chunk=admit_chunk)
     base = [5, 9, 2, 4, 8, 1, 3, 7]
     gen.set_prompts([list(base) for _ in range(batch)])
     for _ in range(3):  # compile + warm-up
@@ -577,6 +582,11 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
             break
         if gen.stats()["tokens_emitted"] - e0 >= steps * batch:
             break
+    # measurement boundary: tokens the device already computed (buffered
+    # rows + any in-flight lookahead block) are emitted and counted — the
+    # final sync pays their wall-clock either way, so dropping them would
+    # under-report the lookahead arm
+    gen.drain()
     _sync(gen._last_tokens)
     dt = time.perf_counter() - t0
     emitted = gen.stats()["tokens_emitted"] - e0

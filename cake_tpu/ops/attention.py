@@ -279,8 +279,9 @@ def self_attention_block(
 
     ``sp_chunk`` selects a third sp mode (overriding both): chunked OFFSET
     prefill against committed history — ``x`` is the full chunk replicated
-    on every sp shard, positioned at scalar ``pos`` (the admission /
-    shared-prefix serving path; see the sp branch below).
+    on every sp shard, positioned at ``pos`` (scalar: the admission /
+    shared-prefix serving path; ``[B]``: per-row chunk frontiers, the
+    sp serving speculation-verification path).
 
     ``write_gate`` (scalar bool): when running inside an SPMD-uniform pipeline
     loop every stage executes this code every step (collectives must be

@@ -329,6 +329,11 @@ def sp_range_cache_write(
     via a positional gather + select, exactly the per-slot pattern
     :func:`sp_chunked_cache_write` uses after its all-gather. Quantized
     halves quantize-on-write per slot like every other sp write path.
+
+    ``pos0`` may be scalar (one staged row — admission / shared-prefix
+    remainders) or ``[B]`` (per-row chunk frontiers — the sp serving
+    SPECULATION plane: each row's K+1 verification slots start at its own
+    position, possibly on different shards).
     """
     from cake_tpu.ops.kvcache import _kv_data
 
@@ -336,17 +341,33 @@ def sp_range_cache_write(
     c = k_new.shape[2]
     gpos = (jnp.asarray(shard_start, jnp.int32)
             + jnp.arange(s_l, dtype=jnp.int32))
-    idx = gpos - jnp.asarray(pos0, jnp.int32)  # in-chunk index per slot
-    valid = (idx >= 0) & (idx < c)
-    if gate is not None:
-        valid = valid & gate
+    pos0 = jnp.asarray(pos0, jnp.int32)
 
-    def write_leaf(cache, new):
-        # gather the chunk value owned by each local slot (clamped for
-        # out-of-range slots, which the select below discards)
-        vals = jnp.take(new, jnp.clip(idx, 0, c - 1), axis=2)
-        sel = valid.reshape((1, 1, s_l) + (1,) * (cache.ndim - 3))
-        return jnp.where(sel, vals.astype(cache.dtype), cache)
+    if pos0.ndim == 0:
+        idx = gpos - pos0  # in-chunk index per local slot
+        valid = (idx >= 0) & (idx < c)
+        if gate is not None:
+            valid = valid & gate
+
+        def write_leaf(cache, new):
+            # gather the chunk value owned by each local slot (clamped for
+            # out-of-range slots, which the select below discards)
+            vals = jnp.take(new, jnp.clip(idx, 0, c - 1), axis=2)
+            sel = valid.reshape((1, 1, s_l) + (1,) * (cache.ndim - 3))
+            return jnp.where(sel, vals.astype(cache.dtype), cache)
+    else:
+        idx = gpos[None, :] - pos0[:, None]  # [B, S_l]
+        valid = (idx >= 0) & (idx < c)
+        if gate is not None:
+            valid = valid & gate
+
+        def write_leaf(cache, new):
+            def one(c_, n_, idx_r, ok_r):  # c_ [KH, S_l(, D)], n_ [KH, C(, D)]
+                vals = jnp.take(n_, jnp.clip(idx_r, 0, c - 1), axis=1)
+                sel = ok_r.reshape((1, s_l) + (1,) * (c_.ndim - 2))
+                return jnp.where(sel, vals, c_)
+
+            return jax.vmap(one)(cache, new.astype(cache.dtype), idx, valid)
 
     def write(cache, new):
         pairs, rebuild = _leaf_pairs(cache, new)

@@ -425,8 +425,12 @@ def build_interleaved_decode(
 
     Same signature as ``build_sharded_decode(per_row=True)``:
     ``(params, token [B], cache, pos [B], keys [B,2], history, hist_slot,
-    index0 [B])``; requires ``plan.sp == 1`` and ``B_local % num_stages
-    == 0`` (B_local = B/dp).
+    index0 [B])``; requires ``B_local % num_stages == 0`` (B_local =
+    B/dp). ``plan.sp > 1`` (r5) composes: each cycle's resident
+    microbatch decodes against its sequence-sharded KV rows (owner-masked
+    sp write + distributed flash attend inside ``forward_layers``; the
+    sp collectives run unconditionally every cycle, so SPMD uniformity
+    holds), and the head/sampling state stays sp-replicated.
 
     Bit-identity scope: bf16 weights are bit-identical to the serialized
     program unconditionally. Int8 weights need a pinned quant backend
@@ -436,9 +440,6 @@ def build_interleaved_decode(
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     S = plan.num_stages
-    if plan.sp != 1:
-        raise ValueError("interleaved decode requires sp == 1 (serving "
-                         "plane)")
 
     def step(params, token, cache, pos, keys, history, hist_slot, index0):
         b = token.shape[0]
@@ -449,7 +450,7 @@ def build_interleaved_decode(
             )
         bm = b // S
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq, config.rope_theta,
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
         my_stage = jax.lax.axis_index(STAGE)
@@ -531,6 +532,7 @@ def build_interleaved_decode(
             h, rows = llama.forward_layers(
                 params["layers"], x, rows, cos, sin, pos_res, config,
                 num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
+                sp_axis=SP, sp_size=plan.sp, sp_prefill=False,
                 write_gate=valid,
             )
             x = jnp.where(valid, h, x)
@@ -651,23 +653,25 @@ def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
     multi-chip twin of :func:`cake_tpu.runtime.speculative.verify_fn`.
     KV for all T slots is written; slots past the accepted frontier hold
     rejected garbage that later steps overwrite before it becomes
-    attendable. Requires ``plan.dp == 1`` and ``plan.sp == 1`` (the
-    single-stream speculation plane).
+    attendable. Requires ``plan.dp == 1`` (the single-stream speculation
+    plane); ``plan.sp > 1`` (r5) runs the fed block chunk-replicated over
+    sp against the sequence-sharded cache (range write + chunk attend).
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
-    if plan.sp != 1 or plan.dp != 1:
-        raise ValueError("speculative verification requires dp == 1 and "
-                         "sp == 1 (single-stream plane)")
+    if plan.dp != 1:
+        raise ValueError("speculative verification requires dp == 1 "
+                         "(single-stream plane)")
 
     def step(params, tokens, cache, pos):
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq, config.rope_theta,
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
         x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
-            plan.num_stages, heads_l, kv_heads_l,
+            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+            sp_chunk=plan.sp > 1,
         )
         x = _select_stage0(x[0])  # [T, hidden], valid on stage 0
         logits = _head_logits(params, x, config)  # [T, vocab] f32
@@ -701,23 +705,24 @@ def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
     twin of :func:`build_sharded_verify`. Each row writes its own K+1 KV
     slots at its own frontier; rejected slots hold garbage that the next
     round's fed range fully overwrites before it becomes attendable (the
-    same invariant as the single-stream speculation plane). Requires
-    ``plan.sp == 1``.
+    same invariant as the single-stream speculation plane). ``plan.sp > 1``
+    (r5): every row's fed block runs chunk-replicated over sp against the
+    sequence-sharded cache — per-row range writes
+    (``ring.sp_range_cache_write`` with ``pos [B]``, rows may straddle
+    shard boundaries) + the per-row-masked chunk attend.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
-    if plan.sp != 1:
-        raise ValueError("per-row speculative verification requires sp == 1 "
-                         "(serving plane)")
 
     def step(params, tokens, cache, pos):
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq, config.rope_theta,
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
         x = llama.embed_tokens(params, tokens, config)
         x, ck, cv = _pipeline_layers(
             x, params["layers"], cache.k, cache.v, cos, sin, pos, config,
-            plan.num_stages, heads_l, kv_heads_l,
+            plan.num_stages, heads_l, kv_heads_l, sp=plan.sp,
+            sp_chunk=plan.sp > 1,
         )
         x = _select_stage0(x)  # [B, T, hidden], valid on stage 0
         logits = _head_logits(params, x, config)
@@ -758,14 +763,13 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
     program, so logits are bit-identical per row.
 
     Same signature and specs as ``build_sharded_verify_rows``; requires
-    ``plan.sp == 1`` and ``B_local % num_stages == 0``. Int8 weights need
+    ``B_local % num_stages == 0``. ``plan.sp > 1`` (r5) composes the same
+    way as the serialized verify: each microbatch's fed block runs
+    chunk-replicated over sp with per-row range writes. Int8 weights need
     a pinned quant backend for bit-identity with the serialized program
     (same contract as ``build_interleaved_decode``)."""
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     S = plan.num_stages
-    if plan.sp != 1:
-        raise ValueError("per-row speculative verification requires sp == 1 "
-                         "(serving plane)")
 
     def step(params, tokens, cache, pos):
         b, t = tokens.shape
@@ -776,7 +780,7 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
             )
         bm = b // S
         cos, sin = rope_tables(
-            config.head_dim, cache.max_seq, config.rope_theta,
+            config.head_dim, cache.max_seq * plan.sp, config.rope_theta,
             scaling=config.rope_scaling,
         )
         my_stage = jax.lax.axis_index(STAGE)
@@ -801,6 +805,7 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
             h, rows = llama.forward_layers(
                 params["layers"], x, rows, cos, sin, pos_rows, config,
                 num_heads=heads_l, num_kv_heads=kv_heads_l, tp_axis=TP, ep_axis=EP,
+                sp_axis=SP, sp_size=plan.sp, sp_chunk=plan.sp > 1,
                 write_gate=valid,
             )
             x = jnp.where(valid, h, x)

@@ -29,13 +29,15 @@ across the sp axis and every stream still decodes at its own frontier —
 the per-row positions flow through the owner-masked sp cache write and the
 per-row-masked distributed flash decode (ops/ring.py). This is the
 many-LONG-streams composition: window HBM splits over sp while the batch
-splits over dp. Continuous admission and the prefix store compose with
-``sp > 1`` too (r5): the staged row's chunks run replicated over sp
-against the sequence-sharded staging cache (owner-masked range writes +
-the T>1 distributed-flash chunk attend, pipeline.build_admit_prefill),
-and the slot splice is sharding-agnostic. Speculation and the
-interleaved schedules remain ``sp == 1`` features (gated with clear
-errors).
+splits over dp. Continuous admission, the prefix store, batched
+speculation, AND the interleaved schedules all compose with ``sp > 1``
+too (r5): staged/fed token blocks run chunk-replicated over sp against
+the sequence-sharded cache (owner-masked range writes — per-row for the
+verification plane — plus the T>1 distributed-flash chunk attend), the
+slot splice is sharding-agnostic, and the interleaved cycle loop's
+resident microbatch decodes against its sequence-sharded KV rows. The
+one remaining sp == 1 path is GPipe microbatch PREFILL (prompts at
+sp > 1 ride the ring prefill instead).
 
 Continuous batching: arrivals ``enqueue`` into a FIFO and are admitted into
 freed slots without stalling the batch — each ``step()`` advances the head
@@ -121,6 +123,7 @@ class BatchGenerator:
         devices=None,
         block_size: int = 1,
         block_size_max: int = 0,
+        lookahead: bool = False,
         kv_quant: str | None = None,
         admit_chunk: int | None = None,
         prefix_share_min: int = 32,
@@ -137,20 +140,17 @@ class BatchGenerator:
                                   dp=dp, sp=1, ep=ep, devices=devices)
         # sp > 1 (r4): multi-stream serving over a sequence-sharded window —
         # per-row frontiers flow through the sp owner-masked KV write and
-        # per-row-masked distributed flash decode. Admission and the
-        # prefix store compose with sp > 1 (r5, chunk-replicated staging
-        # programs); speculation / interleave still require sp == 1 and
-        # are gated off below.
-        if plan.sp != 1 and spec_k:
-            raise ValueError(
-                "batched speculation requires sp == 1 (the verification "
-                "programs are the serving-plane sp == 1 path)"
-            )
-        if plan.sp != 1 and interleave:
-            raise ValueError(
-                "the interleaved schedules require sp == 1 (pass "
-                "interleave=None to auto-select where supported)"
-            )
+        # per-row-masked distributed flash decode. Admission, the prefix
+        # store, batched speculation, and the interleaved schedules all
+        # compose with sp > 1 (r5, chunk-replicated programs + sp-aware
+        # cycle loops); only GPipe microbatch prefill stays sp == 1
+        # (_pick_prefill serializes it).
+        # spec_k composes with sp > 1 (r5): the per-row verification
+        # program runs each row's fed block chunk-replicated over sp
+        # (pipeline.build_sharded_verify_rows) with per-row range writes.
+        # (r5: the interleaved schedules compose with sp > 1 too — the
+        # resident microbatch's decode/verify runs against its
+        # sequence-sharded KV rows inside the cycle loop)
         self.config = config
         self.plan = plan
         self.settings = settings or SamplerSettings()
@@ -182,6 +182,24 @@ class BatchGenerator:
             self.block_size_max = self.block_size
         self._adaptive = self.block_size
         self.__block_progs: dict = {}
+        # Lookahead double-buffering (r5): dispatch block N+1 from the
+        # DEVICE-side feedback token (toks[-1]) before fetching block N's
+        # rows to the host, so the device computes the next block while
+        # the host round-trip for the current one is in flight — on a
+        # tunneled chip the fetch RTT is comparable to the block's math
+        # (BASELINE.md churn diagnosis), so this overlaps most of it.
+        # Token streams are unchanged: the feedback token is exactly the
+        # one the host would have fed back, and rows computed past a
+        # stream's EOS/retirement are discarded per-row like every other
+        # overrun (the admission splice drains the in-flight block's rows
+        # BEFORE a slot changes meaning — _finish_admission). Off by
+        # default; incompatible with batched speculation (the spec plane
+        # needs the host between dispatches).
+        if lookahead and spec_k:
+            raise ValueError("lookahead dispatch does not compose with "
+                             "batched speculation (spec_k)")
+        self._lookahead = bool(lookahead)
+        self._inflight: tuple | None = None  # (device toks [steps,B], size)
         # int8 KV roughly doubles servable batch x window on a fixed HBM
         # budget (quantize-on-write per slot, kvcache.QuantizedKV) — the
         # serving-side long-context lever
@@ -229,7 +247,7 @@ class BatchGenerator:
         # dispatch whenever the batch divides by the stage count; serialized
         # programs remain the fallback (programs compile lazily on first
         # use, so the unused path costs nothing).
-        self._interleave = plan.sp == 1 and (
+        self._interleave = (
             plan.num_stages > 1 if interleave is None
             else interleave and plan.num_stages > 1
         )
@@ -664,6 +682,7 @@ class BatchGenerator:
         # emission rows already recorded (admit() flushing the block buffer)
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
+        self._inflight = None  # any prior in-flight block is stale now
         if getattr(self, "_splice_warm_pending", False):
             # warm_admission ran before this set_prompts; the splice warm
             # needs the batch state that only now exists
@@ -893,9 +912,19 @@ class BatchGenerator:
         slot, ids, stream_id = st["slot"], st["ids"], st["sid"]
         # Buffered block rows belong to the pre-admission state: record
         # them before the slot's column changes meaning, so streaming
-        # step() consumers still receive every Token.
+        # step() consumers still receive every Token. An in-flight
+        # lookahead block is the same chronology, one block later — fetch
+        # and record it too (its rows are also pre-admission tokens).
         while self._block_buf:
             self._pending_rows.append(self._emit(self._block_buf.pop(0)))
+        if self._inflight is not None:
+            toks_if, _ = self._inflight
+            self._inflight = None
+            t0 = time.perf_counter()
+            rows_if = self._host(toks_if)
+            self._busy_s += time.perf_counter() - t0
+            for i in range(rows_if.shape[0]):
+                self._pending_rows.append(self._emit(rows_if[i]))
 
         key = jax.random.fold_in(self._base_key, stream_id)
         n_hist = self.settings.repeat_last_n
@@ -1275,7 +1304,10 @@ class BatchGenerator:
         fills, identical results — parallel.pipeline microbatch mode);
         anything else uses the serialized program."""
         S = self.plan.num_stages
-        if not self._interleave or S < 2 or t % S:
+        if not self._interleave or S < 2 or t % S or self.plan.sp != 1:
+            # sp > 1 prompts ride the ring prefill (GPipe microbatching
+            # over a sequence-sharded prompt remains unimplemented — the
+            # one schedule x sp combination left)
             return self._prefill
         if self.__prefill_pipelined is None:
             self.__prefill_pipelined = self._pinned(build_sharded_prefill(
@@ -1365,6 +1397,43 @@ class BatchGenerator:
             )
             jax.block_until_ready(out)
 
+    def drain(self) -> None:
+        """EMIT everything the device has already computed — buffered
+        block rows first, then any in-flight lookahead block — without
+        dispatching further work. The shutdown / measurement boundary:
+        tokens are recorded against their streams and counted immediately
+        (same `_emit` path as stepping); the Token rows land in the
+        pending queue for any consumer still calling step()."""
+        while self._block_buf:
+            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
+        if self._inflight is not None:
+            toks, _ = self._inflight
+            self._inflight = None
+            t0 = time.perf_counter()
+            rows = self._host(toks)
+            self._busy_s += time.perf_counter() - t0
+            for i in range(rows.shape[0]):
+                self._pending_rows.append(self._emit(rows[i]))
+
+    def _dispatch_block(self, size: int):
+        """Dispatch one fused decode block (async): the device-side state
+        (cache / history / feedback token futures) and the host-side
+        pos/index advance immediately; the ``[size, B]`` token rows return
+        UN-fetched so the caller chooses when to pay the host round-trip
+        (the lookahead path dispatches the next block first)."""
+        toks, self.cache, self._history, self._hist_slot = (
+            self._block_prog(size)(
+                self.params, self._last_tokens, self.cache,
+                jnp.asarray(self._pos), self._keys, self._history,
+                self._hist_slot, jnp.asarray(self._index),
+            )
+        )
+        self._n_decode_dispatches += 1
+        self._pos = self._pos + size
+        self._index = self._index + size
+        self._last_tokens = toks[-1].astype(jnp.int32)
+        return toks
+
     def _step_decode(self):
         # Buffered fused-block rows are EARLIER tokens than anything a new
         # spec round would produce: drain them first, or a round that finds
@@ -1394,24 +1463,31 @@ class BatchGenerator:
         # _emit marks it done at the window-filling token so the overrun
         # outputs are discarded — one long stream near its edge must not
         # force every stream to single-step dispatches.
-        can_block = (self._decode_block is not None
-                     or self.block_size_max > self.block_size)
-        size = self._pick_block_size(live) if can_block else 1
-        if size > 1:
+        toks = None
+        if self._inflight is not None:
+            toks, _ = self._inflight  # consume the pipelined block
+            self._inflight = None
+        else:
+            can_block = (self._decode_block is not None
+                         or self.block_size_max > self.block_size)
+            size = self._pick_block_size(live) if can_block else 1
+            if size > 1:
+                toks = self._dispatch_block(size)
+        if toks is not None:
             t0 = time.perf_counter()
-            toks, self.cache, self._history, self._hist_slot = (
-                self._block_prog(size)(
-                    self.params, self._last_tokens, self.cache,
-                    jnp.asarray(self._pos), self._keys, self._history,
-                    self._hist_slot, jnp.asarray(self._index),
+            if (self._lookahead and not self._arrivals
+                    and self._staging is None):
+                # pipeline the NEXT block before this one's host fetch:
+                # EOS/retirement inside the fetched block only discards
+                # per-row outputs (the standard overrun invariant)
+                nsize = self._pick_block_size(
+                    [self._pos[i] for i, s in enumerate(self.streams)
+                     if s.active and not s.done]
                 )
-            )
+                if nsize > 1:
+                    self._inflight = (self._dispatch_block(nsize), nsize)
             rows = self._host(toks)  # [steps, B]
-            self._n_decode_dispatches += 1
             self._busy_s += time.perf_counter() - t0
-            self._pos = self._pos + size
-            self._index = self._index + size
-            self._last_tokens = toks[-1].astype(jnp.int32)
             self._block_buf = [rows[i] for i in range(rows.shape[0])]
             return self._emit(self._block_buf.pop(0))
 

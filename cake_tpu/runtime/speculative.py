@@ -619,6 +619,7 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         max_seq: int | None = None,
         num_stages: int = 1,
         tp: int = 1,
+        sp: int = 1,
         ep: int = 1,
         devices=None,
         kv_quant: str | None = None,
@@ -629,9 +630,12 @@ class MeshSpeculativeGenerator(SpeculativeMixin, MeshGenerator):
         from cake_tpu.parallel.pipeline import build_sharded_verify
 
         settings = settings or SamplerSettings(temperature=0.0)
+        # sp > 1 (r5): the verification pass runs chunk-replicated over
+        # the sequence-sharded cache (build_sharded_verify's sp path), so
+        # single-stream speculation composes with the long-context plane.
         super().__init__(config, params, plan=plan, tokenizer=tokenizer,
                          settings=settings, max_seq=max_seq,
-                         num_stages=num_stages, tp=tp, sp=1, ep=ep,
+                         num_stages=num_stages, tp=tp, sp=sp, ep=ep,
                          devices=devices, block_size=1, kv_quant=kv_quant,
                          prefill_chunks=prefill_chunks)
         self._spec_init(spec_k, spec_ngram)

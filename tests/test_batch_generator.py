@@ -987,3 +987,60 @@ def test_block_size_max_rounds_down_to_ladder(params):
     assert g.block_size_max == 4
     g = BG(CFG, params, settings=settings, block_size=4)
     assert g.block_size_max == 4
+
+
+def test_lookahead_dispatch_bit_identical_with_admission(params):
+    """r5: lookahead double-buffering (dispatch block N+1 before fetching
+    block N) must not change any stream's tokens — the device feedback
+    token is exactly the host's, and an admission mid-flight drains the
+    in-flight block's rows before the slot changes meaning."""
+    settings = SamplerSettings(**GREEDY)
+    new_prompt = [2, 8, 1, 7, 6, 5, 4, 3]
+
+    def run(look):
+        g = BG(CFG, params, settings=settings, block_size=2,
+               block_size_max=8, lookahead=look, admit_chunk=4)
+        g.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+        for _ in range(6):
+            g.step()
+        if look:
+            assert g._inflight is not None  # the pipeline actually engaged
+        g.streams[0].done = True
+        g.enqueue(list(new_prompt), stream_id=7)
+        for _ in range(16):
+            g.step()
+        return {s.stream_id: list(s.generated) for s in g.streams}
+
+    got, want = run(True), run(False)
+    assert set(got) == set(want) == {1, 7}
+    for sid in got:
+        n = min(len(got[sid]), len(want[sid]))
+        assert n >= 4 and got[sid][:n] == want[sid][:n]
+
+
+def test_lookahead_rejects_speculation(params):
+    settings = SamplerSettings(**GREEDY)
+    with pytest.raises(ValueError, match="lookahead"):
+        BG(CFG, params, settings=settings, lookahead=True, spec_k=4)
+
+
+def test_lookahead_drain_emits_inflight_tokens(params):
+    """drain() at a measurement/shutdown boundary fetches the in-flight
+    block without dispatching more; its tokens continue the stream's
+    oracle sequence exactly."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, block_size=2, block_size_max=4,
+           lookahead=True)
+    g.set_prompts([list(PROMPTS[0])])
+    for _ in range(4):
+        g.step()
+    assert g._inflight is not None
+    dispatches_before = g.stats()["decode_dispatches"]
+    before = len(g.streams[0].generated)
+    g.drain()
+    assert g._inflight is None and not g._block_buf
+    got = list(g.streams[0].generated)
+    assert len(got) > before
+    assert g.stats()["decode_dispatches"] == dispatches_before  # no new work
+    want = _single_stream(params, PROMPTS[0], len(got), settings)
+    assert got == want[: len(got)]

@@ -8,9 +8,10 @@ flow through the sp owner-masked KV write (`ring.sp_cache_write` with
 decode against a KV window sharded across chips — the composition that
 serves many LONG streams on a chip set (window HBM splits over sp while
 the batch splits over dp). r5: continuous admission, the prefix store,
-and sliding-window attention compose with sp > 1 too (chunk-replicated
-staging programs + the windowed sp masks); speculation / interleave
-remain sp == 1 and are gated with clear errors.
+sliding-window attention, speculation, AND the interleaved schedules
+compose with sp > 1 too (chunk-replicated fed/staging blocks + the
+windowed sp masks + per-row range writes + sp-aware cycle loops); the
+one path still serialized at sp > 1 is GPipe microbatch prefill.
 
 The bar: streams match the sp=1 serving oracle token-for-token (sp
 reassembles the exact softmax via pmax/psum, so logits agree to reduction
@@ -84,15 +85,22 @@ def test_sp_serving_long_window_per_stream_parity(params):
         assert got == want
 
 
-def test_sp_serving_gates_unsupported_features(params):
-    """What remains sp == 1 after r5: speculation and the interleaved
-    schedules (admission and the prefix store now compose — see below)."""
-    settings = SamplerSettings(temperature=0.0)
-    plan = MeshPlan.build(CFG, sp=2)
-    with pytest.raises(ValueError, match="sp == 1"):
-        BatchGenerator(CFG, params, plan=plan, settings=settings, spec_k=4)
+def test_sp_interleaved_schedule_matches_serialized(params):
+    """r5: the interleaved-microbatch schedule composes with sp too — on
+    an sp x stage mesh with a stage-divisible batch the dispatches take
+    the interleaved program and streams stay bit-identical to the
+    serialized sp run (the last serving-plane sp gate is gone; only
+    GPipe microbatch PREFILL stays serialized at sp > 1)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    plan = MeshPlan.build(CFG, sp=2, num_stages=2)
     g = BatchGenerator(CFG, params, plan=plan, settings=settings)
-    assert not g._interleave  # interleaved schedules are sp == 1
+    assert g._interleave  # auto-engaged on the staged sp mesh
+    g.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+    got = g.generate(8)
+    g2 = BatchGenerator(CFG, params, plan=plan, settings=settings,
+                        interleave=False)
+    g2.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+    assert got == g2.generate(8)
 
 
 def test_sp_cache_write_per_row_owner_masking():
@@ -237,3 +245,74 @@ def test_sp_range_cache_write_spans_shards():
     assert (np.asarray(k1)[:, :, 0] == 2).all()
     assert (np.asarray(v1)[:, :, 1] == 30).all()
     assert (np.asarray(k1)[:, :, 2:] == 0).all()
+
+
+@pytest.mark.parametrize("rounds", [1, 4])
+def test_sp_spec_serving_matches_sp1(params, rounds):
+    """r5: batched speculation over the sequence-sharded window — each
+    row's K+1 verification block runs chunk-replicated over sp with
+    per-row range writes; greedy streams match the sp=1 run on their
+    common prefix (rounds=1: host loop; rounds=4: fused chain)."""
+    cfg = tiny(max_seq_len=256, eos_token_id=-1)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompts = [[5, 9, 2, 5, 9, 2, 5, 9], [7, 1, 3, 7, 1, 3, 7, 1]]
+
+    def run(plan):
+        g = BatchGenerator(cfg, params, plan=plan, settings=settings,
+                           spec_k=4, spec_rounds=rounds)
+        g.set_prompts([list(p) for p in prompts])
+        for _ in range(25):
+            g.step()
+        return [list(s.generated) for s in g.streams], g.stats()
+
+    want, _ = run(None)
+    got, st = run(MeshPlan.build(cfg, sp=2))
+    assert st["spec_dispatches"] >= 1  # speculation actually engaged
+    for g_row, w_row in zip(got, want):
+        n = min(len(g_row), len(w_row))
+        assert n >= 16
+        assert g_row[:n] == w_row[:n]
+
+
+def test_sp_single_stream_mesh_speculation_matches_plain(params):
+    """r5: MeshSpeculativeGenerator over sp=2 — the single-stream
+    verification pass (build_sharded_verify) runs against the
+    sequence-sharded cache and stays bit-identical to plain decode."""
+    from cake_tpu.runtime.generator import LlamaGenerator
+    from cake_tpu.runtime.speculative import MeshSpeculativeGenerator
+
+    cfg = tiny(max_seq_len=64, eos_token_id=-1)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    prompt = [5, 9, 2, 5, 9, 2, 5, 9]
+
+    plain = LlamaGenerator(cfg, params, settings=settings)
+    plain.set_prompt(list(prompt))
+    want = [plain.next_token(i).id for i in range(16)]
+
+    g = MeshSpeculativeGenerator(cfg, params, settings=settings, sp=2,
+                                 spec_k=4)
+    g.set_prompt(list(prompt))
+    got = [g.next_token(i).id for i in range(16)]
+    assert got == want
+
+
+def test_sp_admission_int8_kv_matches_sp1(params):
+    """r5: the quantized staging cache rides the sp range writes too
+    (quantize-on-write through _leaf_pairs; the chunk attend reads the
+    round-tripped values, same as the sp=1 int8 admission oracle)."""
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+
+    def run(plan):
+        g = BatchGenerator(CFG, params, plan=plan, settings=settings,
+                           kv_quant="int8", admit_chunk=4)
+        g.set_prompts([list(PROMPTS[0]), list(PROMPTS[1])])
+        g.step(), g.step()
+        g.streams[0].done = True
+        g.enqueue([2, 8, 1, 7, 6, 5], stream_id=7)
+        for _ in range(10):
+            g.step()
+        return [list(s.generated) for s in g.streams]
+
+    want = run(None)
+    got = run(MeshPlan.build(CFG, sp=2))
+    assert got == want

@@ -1,0 +1,35 @@
+"""Machinery proof for the 70B stage-slice pricing tool (r5 verdict item
+7). The real measurement runs on the tunnel chip (tools_bench_queue5.sh
+tier 4); this pins the tool's arithmetic and output contract at tiny dims
+on CPU, like tests/test_ici_probe.py does for the ICI probe."""
+
+import json
+
+from cake_tpu.tools import stage_slice
+
+
+def test_stage_slice_mini_rows(capsys):
+    rc = stage_slice.main(["--mini", "--steps", "2", "--layers", "3"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    rows = out["rows"]
+    assert [r["quant"] for r in rows] == ["int8", "bf16"]
+    for r in rows:
+        assert r["layers_per_stage"] == 3
+        assert r["stage_step_ms_measured"] > 0
+        assert r["stage_prefill2048_ms_measured"] > 0
+        assert r["single_stream_tok_s_projected"] > 0
+        # the serialized projection is n_stages x slower than one stage
+        t_tok = r["n_stages"] * (
+            r["stage_step_ms_measured"] / 1e3 + r["hop_s_projected"])
+        assert abs(r["single_stream_tok_s_projected"] - 1 / t_tok) < 0.5
+        assert r["interleaved_aggregate_tok_s_upper"] > (
+            r["single_stream_tok_s_projected"])
+    assert "PROJECTIONS" in out["note"]
+
+
+def test_slice_config_is_70b_geometry():
+    cfg = stage_slice.slice_config(5, 8192, mini=False)
+    assert (cfg.hidden_size, cfg.intermediate_size) == (8192, 28672)
+    assert (cfg.num_attention_heads, cfg.num_key_value_heads) == (64, 8)
+    assert cfg.num_hidden_layers == 5 and cfg.vocab_size == 128256

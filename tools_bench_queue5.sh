@@ -38,6 +38,7 @@ for i in $(seq 1 40); do
     run_row CAKE_BENCH_TTFT=1
     # -- tier 2: the r5 feature rows (verdict items 4 and 6) -------------
     run_row CAKE_BENCH_CHURN=1                         # adaptive blocks (64 max)
+    run_row CAKE_BENCH_CHURN=1 CAKE_BENCH_LOOKAHEAD=1  # + double-buffered dispatch
     run_row CAKE_BENCH_CHURN=1 CAKE_BENCH_BLOCK_MAX=0  # control: r4 behavior
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_CORPUS=1 CAKE_BENCH_SEQ=2048
     run_row CAKE_BENCH_SPEC=8                          # synthetic companion
