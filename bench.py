@@ -91,12 +91,15 @@ def _kv_quant() -> str | None:
 
 
 def _config(preset: str):
-    """CAKE_BENCH_FAMILY=mistral|qwen2 swaps the 8b rung's architecture
-    for that family's 7B geometry (random weights — tok/s only): mistral
-    prices the sliding-window mask + windowed flash plane on-chip; qwen2
-    prices the biased-GQA 3584/28-layer geometry. Default family: llama."""
-    from cake_tpu.models.config import (LlamaConfig, llama3_8b, mistral_7b,
-                                        qwen2_7b, tiny)
+    """CAKE_BENCH_FAMILY=mistral|qwen2|gemma swaps the 8b rung's
+    architecture for that family's 7B-class geometry (random weights —
+    tok/s only): mistral prices the sliding-window mask + windowed flash
+    plane on-chip; qwen2 the biased-GQA 3584/28-layer geometry; gemma the
+    MHA/head_dim-256/GeGLU/tied-head shape (its 256k-vocab embed stays
+    bf16, so the int8 rung is the one that fits a v5e). Default family:
+    llama."""
+    from cake_tpu.models.config import (LlamaConfig, gemma_7b, llama3_8b,
+                                        mistral_7b, qwen2_7b, tiny)
 
     seq = int(os.environ.get("CAKE_BENCH_SEQ", "512"))
     fam = os.environ.get("CAKE_BENCH_FAMILY", "llama")
@@ -110,9 +113,11 @@ def _config(preset: str):
             return mistral_7b(max_seq_len=seq)
         if fam == "qwen2":
             return qwen2_7b(max_seq_len=seq)
+        if fam == "gemma":
+            return gemma_7b(max_seq_len=seq)
         if fam != "llama":
             sys.exit(f"error: CAKE_BENCH_FAMILY must be llama|mistral|"
-                     f"qwen2, got {fam!r}")
+                     f"qwen2|gemma, got {fam!r}")
         return llama3_8b(max_seq_len=seq)
     if preset == "small":
         return LlamaConfig(
