@@ -50,6 +50,17 @@ int4-sweep:
 ici-probe:
 	$(PY) -m cake_tpu.tools.ici_probe --json-out ici_probe.json
 
+# 70B per-stage pricing on one chip (BASELINE configs 4/5): measured
+# stage step + prefill, projected v5e-16 tok/s (r5)
+stage-slice:
+	$(PY) -m cake_tpu.tools.stage_slice --json-out stage_slice.json
+
+# speculation on REAL text: teacher-forced corpus replay (r5) —
+# acceptance + tokens/round from actual prose/code n-gram statistics
+spec-corpus:
+	CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_CORPUS=1 CAKE_BENCH_SEQ=2048 \
+	  $(PY) bench.py
+
 ttft:
 	CAKE_BENCH_TTFT=1 $(PY) bench.py
 
@@ -66,4 +77,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe ttft deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus ttft deploy clean
