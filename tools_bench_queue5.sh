@@ -35,6 +35,8 @@ for i in $(seq 1 40); do
     echo "grant healthy at probe $i $(date -u +%FT%TZ)" >>"$LOG"
     # -- tier 1: the metric of record + known-good acquisition paths -----
     run_row CAKE_BENCH_PRESET=8b                       # int8 84.8 record path
+    run_row CAKE_BENCH_MULTISTEP=32                    # record-beater attempt:
+                                                       # half the host syncs
     run_row CAKE_BENCH_TTFT=1
     # -- tier 2: the r5 feature rows (verdict items 4 and 6) -------------
     run_row CAKE_BENCH_CHURN=1                         # adaptive blocks (64 max)
@@ -42,7 +44,9 @@ for i in $(seq 1 40); do
     run_row CAKE_BENCH_CHURN=1 CAKE_BENCH_BLOCK_MAX=0  # control: r4 behavior
     run_row CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_CORPUS=1 CAKE_BENCH_SEQ=2048
     run_row CAKE_BENCH_SPEC=8                          # synthetic companion
-    # -- tier 3: quantized tiers + long-window serving -------------------
+    # -- tier 3: quantized tiers + serving ------------------------------
+    run_row CAKE_BENCH_BATCH=8                         # refresh the 465 tok/s
+                                                       # aggregate (r2-era row)
     run_row CAKE_BENCH_QUANT=int4
     run_row CAKE_BENCH_QUANT=int4 CAKE_BENCH_BATCH=8
     run_row CAKE_BENCH_BATCH=8 CAKE_BENCH_SEQ=4096 CAKE_BENCH_KV=int8
