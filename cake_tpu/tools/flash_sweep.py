@@ -49,7 +49,19 @@ def sweep(json_out: str | None = None) -> list:
     b, h, kvh, d = 1, 32, 8, 128
     key = jax.random.PRNGKey(0)
     ks = jax.random.split(key, 3)
-    results = []
+
+    class _Flushed(list):
+        """append() also rewrites json_out — a mid-sweep crash (r4w2:
+        flash_sweep died on a Mosaic lowering rule mid-run and the
+        committed artifact lost every landed row) keeps its evidence."""
+
+        def append(self, rec) -> None:
+            super().append(rec)
+            if json_out:
+                with open(json_out, "w") as f:
+                    json.dump(list(self), f, indent=1)
+
+    results = _Flushed()
 
     f_pal = jax.jit(partial(flash_attention, interpret=not compiled))
     fd_pal = jax.jit(partial(flash_decode, interpret=not compiled))
@@ -204,10 +216,7 @@ def sweep(json_out: str | None = None) -> list:
         results.append(rec)
         print(json.dumps(rec), flush=True)
 
-    if json_out:
-        with open(json_out, "w") as f:
-            json.dump(results, f, indent=1)
-    return results
+    return list(results)
 
 
 def main() -> int:

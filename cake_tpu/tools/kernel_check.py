@@ -85,8 +85,11 @@ def _report(name: str, device: str, compiled: bool, err: float,
     return ok
 
 
-def check_kernels(dtype=jnp.bfloat16) -> tuple[list, bool]:
-    """Run every Pallas kernel at 8B-like shapes vs its XLA oracle."""
+def check_kernels(dtype=jnp.bfloat16,
+                  results: list | None = None) -> tuple[list, bool]:
+    """Run every Pallas kernel at 8B-like shapes vs its XLA oracle.
+    ``results``: pass a pre-built list (e.g. the crash-safe
+    :class:`_FlushedResults`) to collect rows into."""
     from cake_tpu.ops import norms, quant
     from cake_tpu.ops.attention import _attend_xla
     from cake_tpu.ops.pallas import (
@@ -100,7 +103,8 @@ def check_kernels(dtype=jnp.bfloat16) -> tuple[list, bool]:
     device = dev.device_kind
     compiled = not interpret_default()
     key = jax.random.PRNGKey(0)
-    results: list = []
+    if results is None:
+        results = []
     all_ok = True
 
     # Llama-3-8B attention geometry: 32 q heads, 8 kv heads, head_dim 128.
@@ -262,22 +266,37 @@ def check_end_to_end(results: list) -> None:
     print(json.dumps(rec))
 
 
+class _FlushedResults(list):
+    """A results list whose append also rewrites ``--json-out``: a
+    mid-run crash (the r4w2 wedge killed kernel_check between rows and
+    the committed artifact lost every already-measured row) must never
+    erase landed evidence again."""
+
+    def __init__(self, path: str | None):
+        super().__init__()
+        self.path = path
+
+    def append(self, rec) -> None:
+        super().append(rec)
+        if self.path:
+            with open(self.path, "w") as f:
+                json.dump(list(self), f, indent=1)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--json-out", default=None,
-                    help="also write all records to this file")
+                    help="also write all records to this file (rewritten "
+                         "after every row — crash-safe)")
     ap.add_argument("--e2e", action="store_true",
                     help="include the end-to-end decode comparison")
     args = ap.parse_args()
 
     dev = jax.devices()[0]
     sys.stderr.write(f"device={dev.device_kind} platform={dev.platform}\n")
-    results, ok = check_kernels()
+    results, ok = check_kernels(results=_FlushedResults(args.json_out))
     if args.e2e or dev.platform == "tpu":
         check_end_to_end(results)
-    if args.json_out:
-        with open(args.json_out, "w") as f:
-            json.dump(results, f, indent=1)
     return 0 if ok else 1
 
 
