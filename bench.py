@@ -164,6 +164,22 @@ def _tpu_ledger(max_rows: int = 16) -> list[dict]:
     return rows[:max_rows]
 
 
+def _pick_headline(ledger: list[dict]) -> dict:
+    """The ledger row to headline: the metric of record (master.rs:57-65
+    analogue) is the plain single-stream decode row, and int8 is the tier
+    that fits one v5e — prefer it, then any single-stream decode row, then
+    whatever is freshest (the ledger is already newest-first and ``min``
+    is stable)."""
+    def rank(r):
+        m = r.get("metric", "")
+        if not (m.startswith("decode_tokens_per_sec")
+                and m.endswith("_1chip")):
+            return 2
+        return 0 if "_int8_" in m else 1
+
+    return min(ledger, key=rank)
+
+
 def _emit(row: dict, dev, baseline: str | None = None, **extra) -> None:
     """Print the benchmark row (the driver contract: ONE JSON line on
     stdout per invocation, flushed the moment the row lands) and append it
@@ -196,16 +212,7 @@ def _emit(row: dict, dev, baseline: str | None = None, **extra) -> None:
     if dev.platform == "cpu":
         ledger = _tpu_ledger()
         if ledger:
-            # the metric of record (master.rs:57-65 analogue) is the plain
-            # single-stream decode row; int8 is the tier that fits one v5e
-            def _rank(r):
-                m = r.get("metric", "")
-                if not (m.startswith("decode_tokens_per_sec")
-                        and m.endswith("_1chip")):
-                    return 2
-                return 0 if "_int8_" in m else 1
-
-            headline = min(ledger, key=_rank)
+            headline = _pick_headline(ledger)
             out = dict(
                 row,
                 ledger_note=(
