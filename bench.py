@@ -315,6 +315,10 @@ def _reexec(cpu: bool = False, **env_overrides) -> None:
     if cpu:
         env.update(JAX_PLATFORMS="cpu", CAKE_BENCH_NO_FALLBACK="1")
         env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        # the CPU fallback runs the tiny preset, which is llama geometry —
+        # a surviving family knob would hit the family-requires-8b guard
+        # and turn the fallback into an error exit
+        env.pop("CAKE_BENCH_FAMILY", None)
     os.execve(sys.executable, [sys.executable, __file__], env)
 
 
@@ -878,6 +882,13 @@ def main() -> int:
         )
     rung = (preset, quant)
     default_ladder = [("8b", ""), ("8b", "int8"), ("small", ""), ("tiny", "")]
+    if os.environ.get("CAKE_BENCH_FAMILY", "llama") != "llama":
+        # family geometries exist only at the 8b rung (the fallback
+        # presets are llama shapes); stepping into them would error out
+        # of _config instead of degrading — cap the ladder at the int8
+        # rung and let the no-rung-fits path fall to CPU (which drops the
+        # family knob in _reexec)
+        default_ladder = default_ladder[:2]
     on_default = rung == ("8b", "") or (
         # a step-down re-exec from the default ladder stays on it (marker
         # env set by _reexec below) — otherwise the int8 rung would leak
