@@ -61,6 +61,10 @@ spec-corpus:
 	CAKE_BENCH_SPEC=8 CAKE_BENCH_SPEC_CORPUS=1 CAKE_BENCH_SEQ=2048 \
 	  $(PY) bench.py
 
+# live cluster table over every worker's --status-port page (r5)
+watch:
+	$(PY) -m cake_tpu.tools.watch --topology $(TOPOLOGY) --port 8090
+
 ttft:
 	CAKE_BENCH_TTFT=1 $(PY) bench.py
 
@@ -77,4 +81,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus ttft deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft deploy clean

@@ -1,0 +1,16 @@
+"""The runnable examples must stay runnable — they're the first thing a
+user switching from the reference executes (MIGRATING.md / README)."""
+
+import os
+import runpy
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_serve_demo_runs(capsys):
+    runpy.run_path(os.path.join(_ROOT, "examples", "serve_demo.py"),
+                   run_name="__main__")
+    out = capsys.readouterr().out
+    assert out.count("stream ") == 4  # all four slots reported
+    assert "stream 99:" in out  # the mid-run arrival was admitted
+    assert "tokens/dispatch" in out
