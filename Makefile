@@ -68,6 +68,12 @@ watch:
 ttft:
 	CAKE_BENCH_TTFT=1 $(PY) bench.py
 
+# observability smoke: tiny CPU-only decode with --trace/--metrics-out/
+# --flight-log into /tmp, validating every artifact parses. The same case
+# runs in the default `make test` path (tests/test_obs.py, non-slow).
+trace-smoke:
+	$(PY) -m pytest tests/test_obs.py -q -k smoke
+
 # Deploy plane (reference Makefile:29-39 sync targets): push code +
 # per-worker bundles to every host in TOPOLOGY and optionally start
 # workers. Dry-run by default; DEPLOY_FLAGS="--run --start" executes.
@@ -81,4 +87,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke deploy clean

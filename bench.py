@@ -229,10 +229,33 @@ def _emit(row: dict, dev, baseline: str | None = None, **extra) -> None:
         rec = dict(row, device=getattr(dev, "device_kind", "cpu"),
                    platform=dev.platform,
                    stamp=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        snap = _metrics_snapshot()
+        if snap:
+            rec["metrics"] = snap
         with open(_ledger_path(), "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError:
         pass
+
+
+def _metrics_snapshot() -> dict:
+    """Non-empty obs-registry series for the ledger record, so a bench row
+    carries dispatch/admission percentiles and wire bytes alongside the
+    single throughput number. The snapshot is the process-cumulative
+    registry at emit time: one bench phase runs per process (main()
+    dispatches exactly one _run_* path; step-downs re-exec fresh), so the
+    only extra samples are that phase's own warm-up/compile dispatches.
+    Zero-valued instruments created at import are dropped."""
+    from cake_tpu.obs import metrics as obs_metrics
+
+    out = {}
+    for name, inst in obs_metrics.registry().snapshot().items():
+        kind = inst.get("type")
+        if kind == "histogram" and inst.get("count"):
+            out[name] = inst
+        elif kind in ("counter", "gauge") and inst.get("value"):
+            out[name] = inst
+    return out
 
 
 def _device_init_probe(timeout_s: float) -> bool:

@@ -145,6 +145,29 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cpu", action="store_true", help="force CPU backend")
     p.add_argument("--profile", default=None, metavar="DIR",
                    help="write a jax.profiler trace of generation to DIR")
+    # -- observability (cake_tpu/obs): spans, metrics, flight records ------
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="record runtime spans (prefill, decode.step, "
+                        "decode.segment, wire.send/recv, ...) and write a "
+                        "Chrome trace-event JSON on exit — load it in "
+                        "Perfetto or chrome://tracing; with --profile the "
+                        "spans also pass through to the XLA profile as "
+                        "jax.profiler TraceAnnotations")
+    p.add_argument("--metrics-out", default=None, dest="metrics_out",
+                   metavar="PATH",
+                   help="dump the metrics registry (counters, gauges, "
+                        "latency histograms with p50/p99) as JSON on exit")
+    p.add_argument("--flight-log", default=None, dest="flight_log",
+                   metavar="PATH",
+                   help="append flight-recorder JSON lines to PATH: one per "
+                        "token on the per-token paths (kind, per-segment "
+                        "ms, wire bytes, serialize/sample ms, recovery "
+                        "events), one per dispatch on fused-block/batched "
+                        "paths (with steps/batch fields)")
+    p.add_argument("--log-level", default="info", dest="log_level",
+                   choices=["debug", "info", "warning", "error"],
+                   help="root log level for this process (master or worker "
+                        "subprocess alike; -v forces debug)")
     p.add_argument("-v", "--verbose", action="store_true")
     return p
 
@@ -541,8 +564,10 @@ def run_master(args) -> int:
                  t_warm - t_gen0, memory_report())
     if hasattr(gen, "runner_stats"):
         for s in gen.runner_stats():
-            log.info("segment %s @ %s: %d calls, %.2f ms avg%s",
+            log.info("segment %s @ %s: %d calls, %.2f ms avg "
+                     "(p50 %.2f / p99 %.2f)%s",
                      s["layers"], s["ident"], s["calls"], s["avg_ms"],
+                     s.get("p50_ms", 0.0), s.get("p99_ms", 0.0),
                      f", handshake {s['handshake_ms']} ms"
                      if "handshake_ms" in s else "")
     if hasattr(gen, "close"):
@@ -555,10 +580,19 @@ def run_master(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from cake_tpu import obs
+
+    obs.setup_logging("debug" if args.verbose else args.log_level)
+    if args.trace:
+        # --profile already captures an XLA trace; passing spans through as
+        # TraceAnnotations lines the two timelines up in one Perfetto view
+        obs.tracer().start(xla_annotations=bool(args.profile))
+    if args.flight_log:
+        try:
+            obs.flight.recorder().enable(path=args.flight_log)
+        except OSError as e:
+            # fail before loading the model, not after a full run
+            sys.exit(f"error: cannot open --flight-log {args.flight_log}: {e}")
     if args.cpu:
         import jax
 
@@ -591,11 +625,38 @@ def main(argv=None) -> int:
             fetch_checkpoint(args.fetch, args.model, force=args.refetch)
         except Exception as e:
             sys.exit(f"error: fetch from {args.fetch} failed: {e}")
-    if args.mode == "worker":
-        return run_worker(args)
-    if args.prompts_file:
-        return run_serve(args)
-    return run_master(args)
+    try:
+        if args.mode == "worker":
+            return run_worker(args)
+        if args.prompts_file:
+            return run_serve(args)
+        return run_master(args)
+    finally:
+        # observability outputs land even on an early error/KeyboardInterrupt
+        # — and a failing artifact write must never mask the run's own
+        # outcome or the other artifacts
+        if args.trace:
+            obs.tracer().stop()
+            try:
+                obs.tracer().write_chrome_trace(args.trace)
+                log.info("chrome trace written to %s", args.trace)
+                if obs.tracer().dropped:
+                    log.warning(
+                        "trace buffer filled: %d span(s) dropped — the "
+                        "timeline in %s is truncated",
+                        obs.tracer().dropped, args.trace,
+                    )
+            except OSError as e:
+                log.error("could not write trace to %s: %s", args.trace, e)
+        if args.metrics_out:
+            try:
+                obs.registry().dump_json(args.metrics_out)
+                log.info("metrics snapshot written to %s", args.metrics_out)
+            except OSError as e:
+                log.error("could not write metrics to %s: %s",
+                          args.metrics_out, e)
+        if args.flight_log:
+            obs.flight.recorder().close()
 
 
 if __name__ == "__main__":

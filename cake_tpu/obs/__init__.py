@@ -1,0 +1,48 @@
+"""Unified observability layer: metrics, spans, per-token flight records.
+
+Three composable planes, all stdlib-only at import and near-zero overhead
+when off, threaded through every layer of the runtime:
+
+- :mod:`cake_tpu.obs.metrics` — process-global registry of thread-safe
+  counters / gauges / fixed-bucket histograms; JSON and Prometheus dumps.
+- :mod:`cake_tpu.obs.trace` — context-manager spans with Chrome
+  trace-event export (Perfetto / ``chrome://tracing``) and optional
+  ``jax.profiler.TraceAnnotation`` pass-through.
+- :mod:`cake_tpu.obs.flight` — bounded ring of per-token records
+  (per-segment ms, wire bytes, serialize/sample ms, recoveries),
+  appendable to JSONL.
+
+CLI surface: ``--trace PATH``, ``--metrics-out PATH``, ``--flight-log
+PATH``, ``--log-level``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from cake_tpu.obs import flight, metrics, trace  # noqa: F401
+from cake_tpu.obs.metrics import (  # noqa: F401
+    counter,
+    gauge,
+    histogram,
+    registry,
+)
+from cake_tpu.obs.trace import span, tracer  # noqa: F401
+
+LOG_FORMAT = "%(asctime)s %(name)s %(levelname)s %(message)s"
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def setup_logging(level: str | int = "info") -> None:
+    """Configure root logging once, identically in master and worker
+    processes (CLI ``--log-level``; ``-v`` maps to debug). Reconfigures on
+    repeat calls so a library user can override an earlier basicConfig."""
+    if isinstance(level, str):
+        level = _LEVELS.get(level.lower(), logging.INFO)
+    logging.basicConfig(level=level, format=LOG_FORMAT, force=True)

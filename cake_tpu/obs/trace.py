@@ -1,0 +1,178 @@
+"""Span tracer with Chrome trace-event JSON export.
+
+Context-manager spans (``with span("decode.segment", seg=i):``) record
+complete ``"ph": "X"`` events — name, start, duration, pid/tid, args — into
+a bounded in-memory buffer, exported as Chrome trace-event JSON that
+Perfetto / ``chrome://tracing`` load directly (the Dapper-style timeline
+view of a decode step: local scan vs wire serialize vs remote round-trip vs
+sampling). Per-thread span stacks give each event its enclosing span's name
+as ``args.parent``, so nested timelines stay legible even when events from
+many threads interleave.
+
+Disabled (the default), ``span()`` returns a shared no-op context manager —
+one attribute check per call site, nothing recorded. Enable with
+``tracer().start()`` (the CLI's ``--trace PATH`` does this and writes the
+file on exit). ``start(xla_annotations=True)`` additionally wraps every span
+in ``jax.profiler.TraceAnnotation`` so the same names appear inside XLA
+profiles captured with ``--profile``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+_local = threading.local()
+
+
+def _stack() -> list:
+    s = getattr(_local, "stack", None)
+    if s is None:
+        s = _local.stack = []
+    return s
+
+
+class Tracer:
+    """Process-global span recorder (thread-safe; bounded)."""
+
+    def __init__(self):
+        self.enabled = False
+        self.xla_annotations = False
+        self.dropped = 0
+        self._max_events = 1_000_000
+        self._events: list[tuple] = []  # (name, ts_us, dur_us, tid, args)
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+
+    def start(self, max_events: int = 1_000_000,
+              xla_annotations: bool = False) -> None:
+        with self._lock:
+            self._events = []
+            self.dropped = 0
+            self._max_events = max_events
+            self._t0 = time.perf_counter()
+            self.xla_annotations = xla_annotations
+            self.enabled = True
+
+    def stop(self) -> None:
+        self.enabled = False
+        self.xla_annotations = False
+
+    def record(self, name: str, t_start: float, dur: float, args: dict) -> None:
+        ev = (
+            name,
+            (t_start - self._t0) * 1e6,
+            dur * 1e6,
+            threading.get_ident(),
+            args,
+        )
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._events.append(ev)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+            self.dropped = 0
+
+    def to_chrome_trace(self) -> dict:
+        """Trace-event JSON object: complete ``X`` events sorted by ``ts``
+        plus thread-name metadata, loadable in Perfetto."""
+        pid = os.getpid()
+        with self._lock:
+            events = sorted(self._events, key=lambda e: e[1])
+        names = {t.ident: t.name for t in threading.enumerate()}
+        tids = sorted({e[3] for e in events})
+        out = [
+            {
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": names.get(tid, f"thread-{tid}")},
+            }
+            for tid in tids
+        ]
+        for name, ts, dur, tid, args in events:
+            ev = {
+                "name": name, "cat": "cake", "ph": "X",
+                "ts": round(ts, 3), "dur": round(dur, 3),
+                "pid": pid, "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            out.append(ev)
+        doc = {"traceEvents": out, "displayTimeUnit": "ms"}
+        if self.dropped:
+            # surfaced in the file itself so a truncated timeline can
+            # never be read as complete (Perfetto ignores extra keys)
+            doc["otherData"] = {"dropped_events": self.dropped}
+        return doc
+
+    def write_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+            f.write("\n")
+
+
+_TRACER = Tracer()
+
+
+def tracer() -> Tracer:
+    return _TRACER
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_name", "_args", "_t0", "_ann")
+
+    def __init__(self, name: str, args: dict):
+        self._name = name
+        self._args = args
+        self._ann = None
+
+    def __enter__(self):
+        stack = _stack()
+        if stack:
+            self._args = dict(self._args, parent=stack[-1])
+        stack.append(self._name)
+        if _TRACER.xla_annotations:
+            try:
+                from jax.profiler import TraceAnnotation
+
+                self._ann = TraceAnnotation(self._name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        stack = _stack()
+        if stack and stack[-1] is self._name:
+            stack.pop()
+        _TRACER.record(self._name, self._t0, dur, self._args)
+        return False
+
+
+def span(name: str, **args):
+    """A timed span; no-op unless the tracer is started."""
+    if not _TRACER.enabled:
+        return _NULL_SPAN
+    return _Span(name, args)

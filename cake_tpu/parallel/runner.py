@@ -29,6 +29,8 @@ import numpy as np
 
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.trace import span
 from cake_tpu.ops.kvcache import KVCache, init_cache
 
 log = logging.getLogger("cake_tpu.runner")
@@ -39,6 +41,11 @@ class BlockRunner(ABC):
 
     start: int
     stop: int
+    # per-forward accounting the master folds into flight records: remote
+    # runners fill wire bytes + codec times here each call, local runners
+    # leave it empty (per-instance dict — a shared class default would
+    # cross-contaminate segments on an in-place write)
+    last_call: dict
 
     @abstractmethod
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
@@ -66,6 +73,7 @@ class LocalRunner(BlockRunner):
         assert next(iter(layers.values())).shape[0] == stop - start
         self.config = config
         self.start, self.stop = start, stop
+        self.last_call = {}
         self.layers = layers
         self.max_seq = max_seq or config.max_seq_len
         self.batch = batch
@@ -77,11 +85,12 @@ class LocalRunner(BlockRunner):
         )
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
-        h, self.cache = self._fn(
-            self.layers, jnp.asarray(x, self.config.jax_dtype), self.cache,
-            jnp.int32(pos),
-        )
-        return np.asarray(h)
+        with span("segment.local_scan", layers=f"{self.start}-{self.stop}"):
+            h, self.cache = self._fn(
+                self.layers, jnp.asarray(x, self.config.jax_dtype),
+                self.cache, jnp.int32(pos),
+            )
+            return np.asarray(h)
 
     def forward_jax(self, x: jax.Array, pos) -> jax.Array:
         """Device-resident variant for all-local pipelines (no host copy)."""
@@ -114,6 +123,9 @@ class RemoteRunner(BlockRunner):
         else:
             addr, port = host, "10128"
         self.addr = f"{addr}:{port}"
+        self.last_call = {}
+        self._ser_hist = obs_metrics.histogram("wire.serialize_ms")
+        self._de_hist = obs_metrics.histogram("wire.deserialize_ms")
         self._handshake()
 
     def _handshake(self) -> None:
@@ -159,17 +171,36 @@ class RemoteRunner(BlockRunner):
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
         ops = [(name, pos) for name in self.layer_names()]
-        self.conn.send(self._MsgType.BATCH, self._protocol.encode_ops(x, ops))
-        t, payload = self.conn.recv()
-        if t == self._MsgType.ERROR:
-            raise self._protocol.WorkerOpError(
-                f"worker {self.addr}: {self._protocol.decode_error(payload)}"
-            )
-        if t != self._MsgType.TENSOR:
-            # protocol desync is a transport-level fault: classify as a wire
-            # error so the master's reconnect+replay recovery applies
-            raise self._wire.WireError(f"unexpected reply type {t}")
-        return self._protocol.decode_tensor(payload)
+        with span("segment.remote_rtt", addr=self.addr,
+                  layers=f"{self.start}-{self.stop}"):
+            t0 = time.perf_counter()
+            req = self._protocol.encode_ops(x, ops)
+            t_ser = time.perf_counter() - t0
+            with span("wire.send", bytes=len(req)):
+                self.conn.send(self._MsgType.BATCH, req)
+            with span("wire.recv"):
+                t, payload = self.conn.recv()
+            if t == self._MsgType.ERROR:
+                raise self._protocol.WorkerOpError(
+                    f"worker {self.addr}: "
+                    f"{self._protocol.decode_error(payload)}"
+                )
+            if t != self._MsgType.TENSOR:
+                # protocol desync is a transport-level fault: classify as a
+                # wire error so the master's reconnect+replay recovery applies
+                raise self._wire.WireError(f"unexpected reply type {t}")
+            t0 = time.perf_counter()
+            out = self._protocol.decode_tensor(payload)
+            t_de = time.perf_counter() - t0
+        # per-call accounting: payload-level bytes, so the master's flight
+        # totals line up with the worker's own bytes_in/bytes_out counters
+        self.last_call = {
+            "wire_bytes_out": len(req), "wire_bytes_in": len(payload),
+            "serialize_ms": t_ser * 1e3, "deserialize_ms": t_de * 1e3,
+        }
+        self._ser_hist.observe(t_ser * 1e3)
+        self._de_hist.observe(t_de * 1e3)
+        return out
 
     def ident(self) -> str:
         return self.addr

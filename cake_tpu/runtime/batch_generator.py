@@ -73,6 +73,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.obs import flight as obs_flight
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.trace import span
 from cake_tpu.ops import quant, sampling
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.mesh import (
@@ -347,6 +350,14 @@ class BatchGenerator:
         self._n_emitted = 0
         self._busy_s = 0.0
         self._t_start: float | None = None
+        # per-instance obs instruments (Registry.publish pattern): stats()
+        # percentiles must reflect THIS generator, not samples a
+        # predecessor in the same process left in a shared series
+        self._dispatch_hist = obs_metrics.Histogram("serve.decode_dispatch_ms")
+        self._admit_hist = obs_metrics.Histogram("serve.admit_chunk_ms")
+        self._emitted_ctr = obs_metrics.Counter("serve.tokens_emitted")
+        obs_metrics.registry().publish(
+            self._dispatch_hist, self._admit_hist, self._emitted_ctr)
 
     @property
     def _prefill_offset(self):
@@ -859,18 +870,26 @@ class BatchGenerator:
         pos, chunk, base = st["pos"], st["chunk"], st["base"]
         final = pos + chunk >= st["tokens"].shape[1]
         t0 = time.perf_counter()
-        logits, st["cache"] = self._admit_prefill(
-            self.params,
-            jnp.asarray(st["tokens"][:, pos: pos + chunk]),
-            st["cache"],
-            jnp.int32(base + pos),
-            jnp.asarray(
-                [len(st["ids"]) - 1 - base - pos if final else 0], jnp.int32
-            ),
-        )
-        np.asarray(logits.ravel()[:1])  # sync: busy_s must include compute
+        with span("admit.chunk", pos=base + pos, chunk=chunk):
+            logits, st["cache"] = self._admit_prefill(
+                self.params,
+                jnp.asarray(st["tokens"][:, pos: pos + chunk]),
+                st["cache"],
+                jnp.int32(base + pos),
+                jnp.asarray(
+                    [len(st["ids"]) - 1 - base - pos if final else 0],
+                    jnp.int32,
+                ),
+            )
+            np.asarray(logits.ravel()[:1])  # sync: busy_s must include compute
         self._n_admit_dispatches += 1
-        self._busy_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self._busy_s += dt
+        self._admit_hist.observe(dt * 1e3)
+        obs_flight.recorder().record(
+            kind="admit", total_ms=round(dt * 1e3, 3), chunk=chunk,
+            pos=base + pos,
+        )
         st["pos"] = pos + chunk
         if final:
             self._finish_admission(logits)
@@ -966,6 +985,7 @@ class BatchGenerator:
         s.done = (tok_id in self._eos_ids) or window_full
         text = s.detok.next_token(tok_id) if s.detok else None
         self._n_emitted += 1
+        self._emitted_ctr.inc()
         row: list[Token | None] = [None] * len(self.streams)
         row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done)
         self._pending_rows.append(row)
@@ -1025,7 +1045,9 @@ class BatchGenerator:
             s.done = (tok_id in self._eos_ids) or window_full
             text = s.detok.next_token(tok_id) if s.detok else None
             out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done))
-        self._n_emitted += sum(1 for t in out if t is not None)
+        emitted = sum(1 for t in out if t is not None)
+        self._n_emitted += emitted
+        self._emitted_ctr.inc(emitted)
         return out
 
     def step(self) -> list[Token | None]:
@@ -1421,13 +1443,14 @@ class BatchGenerator:
         pos/index advance immediately; the ``[size, B]`` token rows return
         UN-fetched so the caller chooses when to pay the host round-trip
         (the lookahead path dispatches the next block first)."""
-        toks, self.cache, self._history, self._hist_slot = (
-            self._block_prog(size)(
-                self.params, self._last_tokens, self.cache,
-                jnp.asarray(self._pos), self._keys, self._history,
-                self._hist_slot, jnp.asarray(self._index),
+        with span("decode.dispatch", steps=size, batch=len(self.streams)):
+            toks, self.cache, self._history, self._hist_slot = (
+                self._block_prog(size)(
+                    self.params, self._last_tokens, self.cache,
+                    jnp.asarray(self._pos), self._keys, self._history,
+                    self._hist_slot, jnp.asarray(self._index),
+                )
             )
-        )
         self._n_decode_dispatches += 1
         self._pos = self._pos + size
         self._index = self._index + size
@@ -1475,6 +1498,7 @@ class BatchGenerator:
                 toks = self._dispatch_block(size)
         if toks is not None:
             t0 = time.perf_counter()
+            size = len(toks)
             if (self._lookahead and not self._arrivals
                     and self._staging is None):
                 # pipeline the NEXT block before this one's host fetch:
@@ -1487,23 +1511,38 @@ class BatchGenerator:
                 if nsize > 1:
                     self._inflight = (self._dispatch_block(nsize), nsize)
             rows = self._host(toks)  # [steps, B]
-            self._busy_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self._busy_s += dt
+            # per-token ms so the series is comparable across block sizes
+            self._dispatch_hist.observe(dt * 1e3 / max(1, size))
+            obs_flight.recorder().record(
+                kind="decode", total_ms=round(dt * 1e3, 3), steps=size,
+                batch=len(self.streams),
+            )
             self._block_buf = [rows[i] for i in range(rows.shape[0])]
             return self._emit(self._block_buf.pop(0))
 
         if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
             raise RuntimeError("KV cache exhausted")  # window-full streams done
         t0 = time.perf_counter()
-        tok, self.cache, self._history, self._hist_slot = self._pick_decode(
-            block=False
-        )(
-            self.params, self._last_tokens, self.cache,
-            jnp.asarray(self._pos), self._keys, self._history,
-            self._hist_slot, jnp.asarray(self._index),
-        )
-        row = self._host(tok)  # sync: dispatch is async, busy_s needs compute
+        with span("decode.dispatch", steps=1, batch=len(self.streams)):
+            tok, self.cache, self._history, self._hist_slot = (
+                self._pick_decode(block=False)(
+                    self.params, self._last_tokens, self.cache,
+                    jnp.asarray(self._pos), self._keys, self._history,
+                    self._hist_slot, jnp.asarray(self._index),
+                )
+            )
+            # sync: dispatch is async, busy_s needs compute
+            row = self._host(tok)
         self._n_decode_dispatches += 1
-        self._busy_s += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        self._busy_s += dt
+        self._dispatch_hist.observe(dt * 1e3)
+        obs_flight.recorder().record(
+            kind="decode", total_ms=round(dt * 1e3, 3), steps=1,
+            batch=len(self.streams),
+        )
         self._pos = self._pos + 1
         self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)
@@ -1536,6 +1575,8 @@ class BatchGenerator:
             "tokens_per_dispatch": (
                 round(self._n_emitted / dispatches, 2) if dispatches else None
             ),
+            "dispatch_p50_ms": round(self._dispatch_hist.percentile(0.5), 3),
+            "dispatch_p99_ms": round(self._dispatch_hist.percentile(0.99), 3),
             "busy_s": round(self._busy_s, 3),
             "wall_s": round(wall, 3),
             "aggregate_tok_s": (

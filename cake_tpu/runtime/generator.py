@@ -33,6 +33,9 @@ import jax.numpy as jnp
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.models import llama
+from cake_tpu.obs import flight as obs_flight
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.trace import span
 from cake_tpu.ops import quant
 from cake_tpu.ops.kvcache import KVCache, init_cache
 from cake_tpu.ops.rope import rope_tables
@@ -302,6 +305,11 @@ class LlamaGenerator(GeneratorBase):
         super().__init__(config, tokenizer, settings, max_seq)
         self.params = params
         self.block_size = max(1, block_size)
+        # per-token dispatch latency (block dispatches record ms/token so
+        # the series is comparable across block sizes) and prompt-pass ms
+        self._decode_hist = obs_metrics.Histogram("generator.decode_ms")
+        self._prefill_hist = obs_metrics.Histogram("generator.prefill_ms")
+        obs_metrics.registry().publish(self._decode_hist, self._prefill_hist)
         self.cache = init_cache(config, batch=1, max_seq=self.max_seq,
                                 dtype=cache_dtype, quant=kv_quant)
         self._prefill = jax.jit(
@@ -323,31 +331,50 @@ class LlamaGenerator(GeneratorBase):
         )
 
     def _run_block(self, index: int) -> list[int]:
-        toks, self.cache, self._history, self._hist_slot = self._decode(
-            self.params,
-            jnp.asarray([self._last_token], jnp.int32),
-            self.cache,
-            jnp.int32(self._pos),
-            self._key,  # base key; scan folds with the absolute index
-            self._history,
-            self._hist_slot,
-            index0=jnp.int32(index),
+        t0 = time.perf_counter()
+        with span("decode.block", index=index, steps=self.block_size):
+            toks, self.cache, self._history, self._hist_slot = self._decode(
+                self.params,
+                jnp.asarray([self._last_token], jnp.int32),
+                self.cache,
+                jnp.int32(self._pos),
+                self._key,  # base key; scan folds with the absolute index
+                self._history,
+                self._hist_slot,
+                index0=jnp.int32(index),
+            )
+            self._pos += self.block_size
+            out = [int(t) for t in toks]
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._decode_hist.observe(dt_ms / self.block_size)
+        obs_flight.recorder().record(
+            index=index, kind="decode", total_ms=round(dt_ms, 3),
+            steps=self.block_size,
         )
-        self._pos += self.block_size
-        return [int(t) for t in toks]
+        return out
 
     def _run_single(self, index: int) -> int:
-        tok, self.cache, self._history, self._hist_slot = self._decode_single(
-            self.params,
-            jnp.asarray([self._last_token], jnp.int32),
-            self.cache,
-            jnp.int32(self._pos),
-            jax.random.fold_in(self._key, index),
-            self._history,
-            self._hist_slot,
+        t0 = time.perf_counter()
+        with span("decode.step", index=index):
+            tok, self.cache, self._history, self._hist_slot = (
+                self._decode_single(
+                    self.params,
+                    jnp.asarray([self._last_token], jnp.int32),
+                    self.cache,
+                    jnp.int32(self._pos),
+                    jax.random.fold_in(self._key, index),
+                    self._history,
+                    self._hist_slot,
+                )
+            )
+            self._pos += 1
+            out = int(tok)
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        self._decode_hist.observe(dt_ms)
+        obs_flight.recorder().record(
+            index=index, kind="decode", total_ms=round(dt_ms, 3), steps=1,
         )
-        self._pos += 1
-        return int(tok)
+        return out
 
     def next_token(self, index: int) -> Token:
         """index 0: prefill the whole prompt; index>0: one-token decode
@@ -356,19 +383,28 @@ class LlamaGenerator(GeneratorBase):
         if index == 0:
             self._require_prompt()
             n = len(self._prompt_tokens)
-            t_pad = _bucket(n, self.max_seq)
-            padded = self._prompt_tokens + [0] * (t_pad - n)
-            tokens = jnp.asarray([padded], jnp.int32)
-            logits, self.cache = self._prefill(
-                self.params, tokens, self.cache, jnp.asarray([n - 1], jnp.int32)
+            t0 = time.perf_counter()
+            with span("prefill", tokens=n):
+                t_pad = _bucket(n, self.max_seq)
+                padded = self._prompt_tokens + [0] * (t_pad - n)
+                tokens = jnp.asarray([padded], jnp.int32)
+                logits, self.cache = self._prefill(
+                    self.params, tokens, self.cache,
+                    jnp.asarray([n - 1], jnp.int32)
+                )
+                step_key = jax.random.fold_in(self._key, 0)
+                tok = sampling.sample_token(
+                    logits[0], step_key, self._history, self.settings
+                )
+                self._history, self._hist_slot = sampling.push_history(
+                    self._history, self._hist_slot, tok
+                )
+                self._pos = n
+                tok_id = int(tok)
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            self._prefill_hist.observe(dt_ms)
+            obs_flight.recorder().record(
+                index=0, kind="prefill", total_ms=round(dt_ms, 3), tokens=n,
             )
-            step_key = jax.random.fold_in(self._key, 0)
-            tok = sampling.sample_token(
-                logits[0], step_key, self._history, self.settings
-            )
-            self._history, self._hist_slot = sampling.push_history(
-                self._history, self._hist_slot, tok
-            )
-            self._pos = n
-            return self._finish_token(int(tok))
+            return self._finish_token(tok_id)
         return self._decode_next(index, self._run_block, self._run_single)

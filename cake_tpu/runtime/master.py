@@ -26,6 +26,9 @@ import numpy as np
 
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.obs import flight as obs_flight
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.trace import span
 from cake_tpu.ops import sampling
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.parallel.runner import BlockRunner, LocalRunner, RemoteRunner
@@ -97,13 +100,28 @@ class DistributedGenerator(GeneratorBase):
             partial(sampling.sample_token, settings=self.settings)
         )
         self._t_start: float | None = None
-        # per-runner cumulative forward time (the TPU-side analogue of the
+        # Per-segment forward-time histograms (the TPU-side analogue of the
         # reference's per-worker ops/s + handshake-latency stats, worker.rs:19);
-        # the first call per runner (prefill + XLA compile) is kept apart so
-        # avg_ms reflects steady-state decode, like tokens_per_sec
-        self._runner_time = [0.0] * len(runners)
-        self._runner_calls = [0] * len(runners)
-        self._runner_warmup = [0.0] * len(runners)
+        # the first call per runner per prompt (prefill + XLA compile) is kept
+        # apart in a warmup gauge so the histogram holds steady-state decode
+        # only, like tokens_per_sec. The instruments are per-instance (each
+        # generator's runner_stats reads its own) and published into the
+        # global registry under stable names, latest instance winning, so
+        # --metrics-out and the Prometheus dump see the live generator.
+        reg = obs_metrics.registry()
+        self._seg_hist = [
+            obs_metrics.Histogram(f"master.segment{i}.decode_ms")
+            for i in range(len(runners))
+        ]
+        self._seg_warm = [
+            obs_metrics.Gauge(f"master.segment{i}.warmup_ms")
+            for i in range(len(runners))
+        ]
+        reg.publish(*self._seg_hist, *self._seg_warm)
+        self._tokens_ctr = obs_metrics.counter("master.tokens_generated")
+        self._recoveries_ctr = obs_metrics.counter("master.recoveries")
+        self._last_seg_ms: list[float] = []  # per-segment ms of the last walk
+        self._last_sample_ms = 0.0
         self.recoveries = 0  # successful mid-stream reconnect+replay count
         self._consec_recoveries = 0  # capped so a dead link can't loop forever
         self._timing_paused = False  # replay forwards are not decode samples
@@ -114,7 +132,8 @@ class DistributedGenerator(GeneratorBase):
         self._t_start = None
         # each prompt's first forward is a fresh prefill — re-classify it as
         # warm-up so avg_ms stays steady-state decode only
-        self._runner_warmup = [0.0] * len(self.runners)
+        for g in self._seg_warm:
+            g.set(0.0)
         for r in self.runners:
             r.reset()
 
@@ -126,17 +145,20 @@ class DistributedGenerator(GeneratorBase):
             llama.embed_tokens({"embed": self.embed},
                                jnp.asarray([tokens], jnp.int32), self.config)
         )
+        self._last_seg_ms = []
         for i, runner in enumerate(self.runners):
+            runner.last_call = {}
             t0 = time.perf_counter()
-            x = runner.forward(x, pos)
+            with span("decode.segment", seg=i, ident=runner.ident()):
+                x = runner.forward(x, pos)
             dt = time.perf_counter() - t0
+            self._last_seg_ms.append(dt * 1e3)
             if self._timing_paused:
                 pass  # recovery replay: prefill-sized, not steady-state
-            elif self._runner_warmup[i] == 0.0:
-                self._runner_warmup[i] = dt
+            elif self._seg_warm[i].value == 0.0:
+                self._seg_warm[i].set(dt * 1e3)
             else:
-                self._runner_time[i] += dt
-                self._runner_calls[i] += 1
+                self._seg_hist[i].observe(dt * 1e3)
         x_last = jnp.asarray(x[:, last_index, :])
         return self._head_fn(x_last)[0]
 
@@ -156,74 +178,119 @@ class DistributedGenerator(GeneratorBase):
         t_pad = _bucket(n, self.max_seq)
         self._timing_paused = True
         try:
-            logits = self._forward(ctx + [0] * (t_pad - n), 0, n - 1)
+            with span("recover.replay", tokens=n):
+                logits = self._forward(ctx + [0] * (t_pad - n), 0, n - 1)
         finally:
             self._timing_paused = False
         self._pos = n
         self.recoveries += 1
+        self._recoveries_ctr.inc()
         return logits
 
     # -- Generator trait ----------------------------------------------------
     def next_token(self, index: int) -> Token:
+        t_tok0 = time.perf_counter()
+        recoveries0 = self.recoveries
         if index == 0:
             self._require_prompt()
             n = len(self._prompt_tokens)
             t_pad = _bucket(n, self.max_seq)
-            logits = self._forward(
-                self._prompt_tokens + [0] * (t_pad - n), 0, n - 1
-            )
+            with span("prefill", tokens=n):
+                logits = self._forward(
+                    self._prompt_tokens + [0] * (t_pad - n), 0, n - 1
+                )
+                tok_id = self._sample(logits, index)
             self._pos = n
         else:
             self._check_capacity()
-            try:
-                logits = self._forward([self._last_token], self._pos, 0)
-                self._pos += 1
-                self._consec_recoveries = 0
-            # Transport failures only: a worker-reported op error
-            # (protocol.WorkerOpError) is deterministic — replaying the
-            # context would just re-run the same failing op at prefill cost.
-            except (OSError, wire.WireError) as e:
-                self._consec_recoveries += 1
-                if self._consec_recoveries > self.MAX_CONSEC_RECOVERIES:
-                    raise RuntimeError(
-                        f"giving up after {self.MAX_CONSEC_RECOVERIES} "
-                        f"consecutive recovery attempts"
-                    ) from e
-                log.warning("segment forward failed (%s); reconnecting and "
-                            "replaying %d-token context", e,
-                            len(self._prompt_tokens) + len(self._generated))
-                logits = self._replay_context()
+            with span("decode.step", index=index):
+                try:
+                    logits = self._forward([self._last_token], self._pos, 0)
+                    self._pos += 1
+                    self._consec_recoveries = 0
+                # Transport failures only: a worker-reported op error
+                # (protocol.WorkerOpError) is deterministic — replaying the
+                # context would just re-run the same failing op at prefill
+                # cost.
+                except (OSError, wire.WireError) as e:
+                    self._consec_recoveries += 1
+                    if self._consec_recoveries > self.MAX_CONSEC_RECOVERIES:
+                        raise RuntimeError(
+                            f"giving up after {self.MAX_CONSEC_RECOVERIES} "
+                            f"consecutive recovery attempts"
+                        ) from e
+                    log.warning("segment forward failed (%s); reconnecting "
+                                "and replaying %d-token context", e,
+                                len(self._prompt_tokens)
+                                + len(self._generated))
+                    logits = self._replay_context()
+                tok_id = self._sample(logits, index)
 
-        step_key = jax.random.fold_in(self._key, index)
-        tok = self._sample_fn(logits, step_key, self._history)
-        self._history, self._hist_slot = sampling.push_history(
-            self._history, self._hist_slot, tok
-        )
         if index == 0:
             # tokens/sec excludes the warm-up token (master.rs:37-40)
             self._t_start = time.perf_counter()
-        return self._finish_token(int(tok))
+        self._tokens_ctr.inc()
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            wire_tot = {"wire_bytes_out": 0, "wire_bytes_in": 0,
+                        "serialize_ms": 0.0, "deserialize_ms": 0.0}
+            for r in self.runners:
+                for k in wire_tot:
+                    wire_tot[k] += r.last_call.get(k, 0)
+            rec.record(
+                index=index,
+                kind="prefill" if index == 0 else "decode",
+                total_ms=round((time.perf_counter() - t_tok0) * 1e3, 3),
+                segments_ms=[round(ms, 3) for ms in self._last_seg_ms],
+                sample_ms=round(self._last_sample_ms, 3),
+                recovery=self.recoveries > recoveries0,
+                **{k: round(v, 3) if isinstance(v, float) else v
+                   for k, v in wire_tot.items()},
+            )
+        return self._finish_token(tok_id)
+
+    def _sample(self, logits: jax.Array, index: int) -> int:
+        """Sample + history push, timed for the flight record (the int()
+        fetch synchronizes, so sample_ms covers the real device work)."""
+        t0 = time.perf_counter()
+        with span("sample", index=index):
+            step_key = jax.random.fold_in(self._key, index)
+            tok = self._sample_fn(logits, step_key, self._history)
+            self._history, self._hist_slot = sampling.push_history(
+                self._history, self._hist_slot, tok
+            )
+            tok_id = int(tok)
+        self._last_sample_ms = (time.perf_counter() - t0) * 1e3
+        return tok_id
 
     def tokens_per_sec(self) -> float | None:
-        """Decode throughput excluding the warm-up token (master.rs:57-65)."""
+        """Decode throughput excluding the warm-up token (master.rs:57-65).
+        None until two tokens landed, and None again if the clock has not
+        measurably advanced (a sub-microsecond elapsed denominator would
+        report garbage teraTokens/sec)."""
         if self._t_start is None or len(self._generated) < 2:
             return None
-        return (len(self._generated) - 1) / (time.perf_counter() - self._t_start)
+        dt = time.perf_counter() - self._t_start
+        if dt < 1e-6:
+            return None
+        return (len(self._generated) - 1) / dt
 
     def runner_stats(self) -> list[dict]:
-        """Per-segment steady-state decode latency (warm-up call reported
-        separately). Remote entries include the handshake RTT recorded at
-        connect time (client.rs:72-86 shows the same in the reference's
-        WorkerInfo)."""
+        """Per-segment steady-state decode latency percentiles from the
+        registry histograms (warm-up call reported separately). Remote
+        entries include the handshake RTT recorded at connect time
+        (client.rs:72-86 shows the same in the reference's WorkerInfo)."""
         stats = []
         for i, r in enumerate(self.runners):
-            calls = self._runner_calls[i]
+            h = self._seg_hist[i]
             entry = {
                 "ident": r.ident(),
                 "layers": f"{r.start}-{r.stop - 1}",
-                "calls": calls,
-                "avg_ms": (self._runner_time[i] / calls * 1e3) if calls else 0.0,
-                "warmup_ms": self._runner_warmup[i] * 1e3,
+                "calls": h.count,
+                "avg_ms": h.mean,
+                "p50_ms": h.percentile(0.5),
+                "p99_ms": h.percentile(0.99),
+                "warmup_ms": self._seg_warm[i].value,
             }
             info = getattr(r, "info", None)
             if info is not None and getattr(info, "latency_ms", None):
@@ -232,5 +299,12 @@ class DistributedGenerator(GeneratorBase):
         return stats
 
     def close(self) -> None:
+        # The per-segment series stay registered after close: the CLI's
+        # exit-time --metrics-out dump runs AFTER run_master closes the
+        # generator, and those histograms are the dump's whole point. A
+        # successor generator rebinds overlapping names via publish();
+        # only a successor with FEWER segments can leave a predecessor's
+        # high-index rows visible, and callers who care can
+        # registry().unregister(name, inst) explicitly.
         for r in self.runners:
             r.close()

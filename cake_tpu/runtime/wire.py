@@ -21,6 +21,8 @@ import threading
 import zlib
 from pathlib import Path
 
+from cake_tpu.obs import metrics as _metrics
+
 MAGIC = 0x7CA4E701
 MAX_PAYLOAD = 512 * 1024 * 1024
 _HEADER = struct.Struct("<IBI")  # magic, msg_type, payload_len
@@ -106,6 +108,19 @@ class PeerClosed(WireError):
     pass
 
 
+# Frame-level traffic series, counted in this wrapper so the native and
+# pure-Python framings share one set of numbers (payload bytes, not
+# header/CRC overhead — comparable with the worker's per-op byte counters).
+_FRAMES_OUT = _metrics.counter("wire.frames_out")
+_FRAMES_IN = _metrics.counter("wire.frames_in")
+_BYTES_OUT = _metrics.counter("wire.bytes_out")
+_BYTES_IN = _metrics.counter("wire.bytes_in")
+_CRC_FAILURES = _metrics.counter("wire.crc_failures")
+# frame-size distribution (p50/p99 payload bytes): tells a tuner whether
+# traffic is dominated by tiny control frames or tensor payloads
+_FRAME_BYTES = _metrics.histogram("wire.frame_bytes",
+                                  buckets=_metrics.BYTES_BUCKETS)
+
 _ERRORS = {
     -1: "io error",
     -2: "peer closed",
@@ -121,6 +136,8 @@ _ERRORS = {
 
 
 def _raise(code: int):
+    if code == -9:
+        _CRC_FAILURES.inc()
     if code == -2:
         raise PeerClosed(_ERRORS[-2])
     raise WireError(_ERRORS.get(code, f"wire error {code}"))
@@ -154,6 +171,13 @@ class Connection:
             frame = _HEADER.pack(MAGIC, msg_type, len(payload)) + payload + \
                 struct.pack("<I", crc)
             self._sock.sendall(frame)
+        # counted only after the frame went out whole, so the series never
+        # exceeds what the peer could have seen (a failed mid-stream send
+        # would otherwise skew bytes_out vs the peer's bytes_in in exactly
+        # the recovery scenarios these counters exist to diagnose)
+        _FRAMES_OUT.inc()
+        _BYTES_OUT.inc(len(payload))
+        _FRAME_BYTES.observe(len(payload))
 
     def recv(self) -> tuple[int, bytes]:
         if self._fd is not None:
@@ -167,6 +191,8 @@ class Connection:
             finally:
                 if ln.value:
                     self._lib.cw_free(out)
+            _FRAMES_IN.inc()
+            _BYTES_IN.inc(len(data))
             return rc, data
         else:
             header = self._read_exact(_HEADER.size)
@@ -181,6 +207,8 @@ class Connection:
             crc = zlib.crc32(payload, crc)
             if crc != want_crc:
                 _raise(-9)
+            _FRAMES_IN.inc()
+            _BYTES_IN.inc(len(payload))
             return msg_type, payload
 
     def _read_exact(self, n: int) -> bytes:

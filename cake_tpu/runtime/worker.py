@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs.trace import span
 from cake_tpu.ops.kvcache import KVCache, init_cache
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import protocol, wire
@@ -100,13 +102,20 @@ class Worker:
         # app renders this state in a SwiftUI view, ContentView.swift:28-56;
         # on a headless TPU VM the equivalent is an HTTP JSON endpoint)
         self._stat_lock = threading.Lock()
-        self._total_ops = 0
-        self._total_bytes_in = 0
-        self._total_bytes_out = 0
         self._conns_live = 0
         self._conns_total = 0
         self._started = time.time()
         self._status_httpd = None
+        # Serving counters as per-instance obs instruments (the
+        # Registry.publish pattern) — the single source of truth for both
+        # status() and the registry dumps.
+        self._ops_ctr = obs_metrics.Counter("worker.ops")
+        self._bytes_in_ctr = obs_metrics.Counter("worker.bytes_in")
+        self._bytes_out_ctr = obs_metrics.Counter("worker.bytes_out")
+        self._fwd_hist = obs_metrics.Histogram("worker.forward_ms")
+        obs_metrics.registry().publish(
+            self._ops_ctr, self._bytes_in_ctr, self._bytes_out_ctr,
+            self._fwd_hist)
 
     # -- serving ------------------------------------------------------------
     def serve_forever(self) -> None:
@@ -156,10 +165,13 @@ class Worker:
                 "uptime_s": round(time.time() - self._started, 1),
                 "connections_live": self._conns_live,
                 "connections_total": self._conns_total,
-                "ops_total": self._total_ops,
-                "bytes_in": self._total_bytes_in,
-                "bytes_out": self._total_bytes_out,
+                "ops_total": self._ops_ctr.value,
+                "bytes_in": self._bytes_in_ctr.value,
+                "bytes_out": self._bytes_out_ctr.value,
                 "rss_bytes": rss_bytes(),
+                # full registry snapshot: wire frame/byte/CRC counters and
+                # layer forward-time histograms with p50/p99, one page
+                "metrics": obs_metrics.registry().snapshot(),
             }
 
     def start_status_server(self, port: int = 0) -> int:
@@ -178,9 +190,16 @@ class Worker:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib casing)
-                body = _json.dumps(worker.status(), indent=1).encode()
+                if self.path.rstrip("/") == "/metrics":
+                    # Prometheus text exposition of the same registry the
+                    # JSON page embeds under "metrics"
+                    body = obs_metrics.registry().to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    body = _json.dumps(worker.status(), indent=1).encode()
+                    ctype = "application/json"
                 self.send_response(200)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -267,7 +286,10 @@ class Worker:
                 bytes_in += len(payload)
                 try:
                     x, ops = protocol.decode_ops(payload)
-                    out = self._run_ops(x, ops, caches)
+                    t0 = time.perf_counter()
+                    with span("worker.forward", ops=len(ops)):
+                        out = self._run_ops(x, ops, caches)
+                    self._fwd_hist.observe((time.perf_counter() - t0) * 1e3)
                 except Exception as e:  # report, keep serving
                     log.exception("op failed")
                     conn.send(MsgType.ERROR, protocol.encode_error(str(e)))
@@ -276,10 +298,9 @@ class Worker:
                 bytes_out += len(reply)
                 conn.send(MsgType.TENSOR, reply)
                 ops_done += len(ops)
-                with self._stat_lock:
-                    self._total_ops += len(ops)
-                    self._total_bytes_in += len(payload)
-                    self._total_bytes_out += len(reply)
+                self._ops_ctr.inc(len(ops))
+                self._bytes_in_ctr.inc(len(payload))
+                self._bytes_out_ctr.inc(len(reply))
                 if ops_done >= STATS_EVERY:
                     dt = time.perf_counter() - t_window
                     log.info(
