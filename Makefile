@@ -74,6 +74,16 @@ ttft:
 trace-smoke:
 	$(PY) -m pytest tests/test_obs.py -q -k smoke
 
+# perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
+# micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
+# bytes-per-decode-token acceptance — plus the obs on/off overhead row
+# from the bench ledger path.
+perf-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
+	  tests/test_wire_codec.py -q -m 'not slow'
+	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # Deploy plane (reference Makefile:29-39 sync targets): push code +
 # per-worker bundles to every host in TOPOLOGY and optionally start
 # workers. Dry-run by default; DEPLOY_FLAGS="--run --start" executes.
@@ -87,4 +97,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke perf-smoke deploy clean

@@ -20,6 +20,10 @@ Knobs (env):
                         one program per token like the reference's loop).
                         Measured on v5e (small preset): 1 -> 16% of the HBM
                         roofline, 8 -> 59%, 16 -> 70%, 64 -> 78%.
+  CAKE_BENCH_OBS=1   decode tok/s with observability off vs on (tracer +
+                     flight recorder) through the generator hot path;
+                     emits the overhead percentage (`make perf-smoke`
+                     bounds the disabled-path micro-cost).
 """
 
 from __future__ import annotations
@@ -561,6 +565,63 @@ def _run_ttft(config, params, preset, quant, dev) -> int:
     return 0
 
 
+def _run_obs_overhead(config, params, preset, quant, dev, steps) -> int:
+    """CAKE_BENCH_OBS=1: decode tokens/sec with the observability planes
+    OFF vs ON (tracer + flight recorder enabled, in-memory only) through
+    the LlamaGenerator hot path — the single-stream loop that calls
+    span()/record()/histogram per token. The figure of merit is the
+    overhead percentage; the obs satellite contract is that OFF costs an
+    attribute check per call site (`make perf-smoke` bounds that
+    micro-cost; this row prices the enabled planes)."""
+    from cake_tpu.obs import flight, trace
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    kv_quant = _kv_quant()
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    n = max(8, min(steps, config.max_seq_len - 16))
+    prompt = [1, 5, 9, 14, 3, 8, 2, 4]
+
+    def run(label: str) -> float:
+        gen = LlamaGenerator(config, params, settings=settings,
+                             kv_quant=kv_quant)
+        gen.set_prompt(prompt)
+        # warm BOTH programs before the clock: next_token(0) compiles only
+        # prefill, next_token(1) the decode step — a timed first decode
+        # would put one ~600 ms XLA compile inside a ~120 ms measurement
+        # window and swamp the obs delta being measured
+        gen.next_token(0)
+        gen.next_token(1)
+        t0 = time.perf_counter()
+        for i in range(2, n):
+            gen.next_token(i)
+        dt = time.perf_counter() - t0
+        sys.stderr.write(f"obs={label}: {(n - 2) / dt:.1f} tok/s\n")
+        return (n - 2) / dt
+
+    off = run("off")
+    trace.tracer().start()
+    flight.recorder().enable()
+    try:
+        on = run("on")
+    finally:
+        trace.tracer().stop()
+        flight.recorder().disable()
+        flight.recorder().clear()
+        trace.tracer().clear()
+    overhead_pct = (off / on - 1.0) * 100.0
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": f"decode_obs_overhead_pct_{_mtag(preset)}_{wtag}_1chip",
+        "value": round(overhead_pct, 2),
+        "unit": "%",
+        "vs_baseline": round(on / off, 4),
+    }, dev, baseline=f"obs_off_{off:.1f}tok/s",
+        obs_off_tok_s=round(off, 2), obs_on_tok_s=round(on, 2),
+        timed_tokens=n - 2)
+    return 0
+
+
 def _run_churn(config, params, preset, quant, dev, batch, steps,
                multistep) -> int:
     """CAKE_BENCH_CHURN=1: serving under arrival churn. Streams that reach
@@ -1038,6 +1099,8 @@ def main() -> int:
         return _run_prefill(config, params, preset, quant, dev)
     if os.environ.get("CAKE_BENCH_TTFT") == "1":
         return _run_ttft(config, params, preset, quant, dev)
+    if os.environ.get("CAKE_BENCH_OBS") == "1":
+        return _run_obs_overhead(config, params, preset, quant, dev, steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

@@ -101,6 +101,22 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="decode_block",
                    help="fused decode steps per dispatch (all-local and mesh "
                         "paths; 1 = one program per token; default 8)")
+    p.add_argument("--lookahead", action="store_true",
+                   help="dispatch decode block N+1 from the device-side "
+                        "feedback token BEFORE fetching block N's tokens to "
+                        "the host — hides readback/detok/emission behind "
+                        "device compute (all-local fused-block path and "
+                        "--prompts-file serving; token streams are "
+                        "bit-identical to the non-lookahead path)")
+    p.add_argument("--wire-codec", choices=["none", "bf16", "int8"],
+                   default=None, dest="wire_codec",
+                   help="activation encoding for cross-host worker hops "
+                        "(negotiated at handshake). Master: the codec every "
+                        "remote segment uses (default none). Worker: "
+                        "restrict what this worker accepts/mirrors "
+                        "(default: all). bf16 ~2x fewer bytes on f32 runs; "
+                        "int8 (per-row absmax scales) ~4x — both perturb "
+                        "low-order logit bits like --kv-quant does")
     p.add_argument("--speculate", type=int, default=0, metavar="K",
                    help="n-gram speculative decoding: propose K tokens per "
                         "round from the context's own n-grams and verify "
@@ -258,7 +274,7 @@ def run_worker(args) -> int:
 
     worker = Worker(args.name, config, topology, loader,
                     address=args.address, max_seq=args.max_seq,
-                    kv_quant=args.kv_quant)
+                    kv_quant=args.kv_quant, wire_codec=args.wire_codec)
     if args.status_port is not None:
         worker.start_status_server(args.status_port)
     log.info("worker ready (%s)", memory_report())
@@ -290,6 +306,15 @@ def run_serve(args) -> int:
     if args.prefill_chunks > 1:
         sys.exit("error: --prefill-chunks is not supported with "
                  "--prompts-file serving")
+    # "none" is the documented default — a semantic no-op, not a request
+    # for compression; only a compressing codec is misplaced here
+    if args.wire_codec not in (None, "none"):
+        sys.exit("error: --wire-codec applies to cross-host worker hops "
+                 "(master/worker --topology runs); serving rides the mesh")
+    if args.lookahead and args.decode_block == 1:
+        sys.exit("error: --lookahead needs fused blocks to pipeline; it "
+                 "requires --decode-block > 1 (it would otherwise be "
+                 "silently ignored)")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
     settings = _settings(args)
@@ -333,6 +358,7 @@ def run_serve(args) -> int:
                              block_size=(args.decode_block
                                          if args.decode_block is not None
                                          else 8),
+                             lookahead=args.lookahead,
                              kv_quant=args.kv_quant, spec_k=args.speculate)
     except ValueError as e:  # e.g. --max-seq not divisible by --sp
         sys.exit(f"error: {e}")
@@ -400,6 +426,28 @@ def run_master(args) -> int:
         sys.exit("error: --decode-block does not compose with --speculate "
                  "(speculative rounds replace fused-block dispatches; the "
                  "flag would otherwise be silently ignored)")
+    if args.wire_codec not in (None, "none") and (
+        use_mesh or not args.topology
+    ):
+        # explicit "none" is the default spelled out — harmless anywhere
+        sys.exit("error: --wire-codec applies to cross-host worker hops; "
+                 "it needs a host-addressed --topology (it would otherwise "
+                 "be silently ignored)")
+    if args.lookahead:
+        # lookahead needs the fused-block programs (all-local path here,
+        # BatchGenerator on the serving path); reject combinations that
+        # would silently ignore it
+        if args.speculate:
+            sys.exit("error: --lookahead does not compose with --speculate "
+                     "(the spec plane needs the host between dispatches)")
+        if use_mesh or args.topology:
+            sys.exit("error: --lookahead runs the all-local fused-block "
+                     "path (or --prompts-file serving); it is not "
+                     "supported with --stages/--tp/--sp or --topology")
+        if args.decode_block == 1:
+            sys.exit("error: --lookahead needs fused blocks to pipeline; "
+                     "it requires --decode-block > 1 (it would otherwise "
+                     "be silently ignored)")
     decode_block = args.decode_block if args.decode_block is not None else 8
     if args.prefill_chunks > 1:
         # Overlap needs stages to overlap across, and the sp plane owns
@@ -486,7 +534,12 @@ def run_master(args) -> int:
                 quantize=args.quantize,
             )["layers"]
 
-        runners = build_runners(config, topology, loader, max_seq=args.max_seq)
+        try:
+            runners = build_runners(config, topology, loader,
+                                    max_seq=args.max_seq,
+                                    wire_codec=args.wire_codec or "none")
+        except RuntimeError as e:  # e.g. worker rejects the codec
+            sys.exit(f"error: {e}")
         gen = DistributedGenerator(config, head, runners, tokenizer=tokenizer,
                                    settings=settings, max_seq=args.max_seq)
     else:
@@ -508,7 +561,8 @@ def run_master(args) -> int:
             gen = LlamaGenerator(config, params, tokenizer=tokenizer,
                                  settings=settings, max_seq=args.max_seq,
                                  block_size=decode_block,
-                                 kv_quant=args.kv_quant)
+                                 kv_quant=args.kv_quant,
+                                 lookahead=args.lookahead)
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
              memory_report())
 

@@ -51,6 +51,14 @@ class BlockRunner(ABC):
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
         """Run blocks [start, stop) on ``x [B, T, hidden]`` at ``pos``."""
 
+    def forward_jax(self, x, pos: int):
+        """Device-aware entry the master's segment walk uses: takes a
+        jax.Array OR numpy, returns whatever is cheapest for this placement
+        (a device array for local runners, numpy for remote hops). Default:
+        materialize on host and run :meth:`forward` — remote runners ship
+        numpy anyway, so the host copy here IS the wire boundary."""
+        return self.forward(np.asarray(x), pos)
+
     @abstractmethod
     def ident(self) -> str:
         """Placement identity ('local' or worker address), cake/mod.rs:156-158."""
@@ -77,6 +85,9 @@ class LocalRunner(BlockRunner):
         self.layers = layers
         self.max_seq = max_seq or config.max_seq_len
         self.batch = batch
+        # span tag formatted once, not per token (the disabled-tracer path
+        # must stay near-zero on the decode hot loop)
+        self._span_tag = f"{start}-{stop}"
         self.cache = init_cache(config, batch=batch, max_seq=self.max_seq,
                                 num_layers=stop - start)
         self._fn = jax.jit(
@@ -85,17 +96,18 @@ class LocalRunner(BlockRunner):
         )
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
-        with span("segment.local_scan", layers=f"{self.start}-{self.stop}"):
+        return np.asarray(self.forward_jax(x, pos))
+
+    def forward_jax(self, x, pos) -> jax.Array:
+        """Device-resident execution (no device->host copy): the master's
+        segment walk keeps activations on device across consecutive local
+        segments and only materializes numpy at remote boundaries."""
+        with span("segment.local_scan", layers=self._span_tag):
             h, self.cache = self._fn(
                 self.layers, jnp.asarray(x, self.config.jax_dtype),
                 self.cache, jnp.int32(pos),
             )
-            return np.asarray(h)
-
-    def forward_jax(self, x: jax.Array, pos) -> jax.Array:
-        """Device-resident variant for all-local pipelines (no host copy)."""
-        h, self.cache = self._fn(self.layers, x, self.cache, jnp.int32(pos))
-        return h
+            return h
 
     def ident(self) -> str:
         return "local"
@@ -110,11 +122,12 @@ class RemoteRunner(BlockRunner):
     per call for the whole segment."""
 
     def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000,
-                 max_seq: int | None = None):
+                 max_seq: int | None = None, wire_codec: str = "none"):
         from cake_tpu.runtime import protocol, wire
         from cake_tpu.runtime.protocol import MsgType
 
         self._protocol, self._wire, self._MsgType = protocol, wire, MsgType
+        self.wire_codec = protocol.check_codec(wire_codec)
         self.start, self.stop = start, stop
         self._timeout_ms = timeout_ms
         self._expected_max_seq = max_seq
@@ -124,6 +137,7 @@ class RemoteRunner(BlockRunner):
             addr, port = host, "10128"
         self.addr = f"{addr}:{port}"
         self.last_call = {}
+        self._span_tag = f"{start}-{stop}"
         self._ser_hist = obs_metrics.histogram("wire.serialize_ms")
         self._de_hist = obs_metrics.histogram("wire.deserialize_ms")
         self._handshake()
@@ -168,15 +182,29 @@ class RemoteRunner(BlockRunner):
                 f"worker {self.info.name}@{self.addr} max_seq "
                 f"{self.info.max_seq} != master max_seq {self._expected_max_seq}"
             )
+        # Codec negotiation: the worker advertises what it accepts (and will
+        # mirror); a codec it never heard of would decode as garbage — fail
+        # at handshake, not mid-stream.
+        if self.wire_codec != "none" and self.wire_codec not in (
+            self.info.codecs or ["none"]
+        ):
+            raise RuntimeError(
+                f"worker {self.info.name}@{self.addr} does not accept wire "
+                f"codec {self.wire_codec!r} (advertises {self.info.codecs})"
+            )
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
+        x = np.asarray(x)
         ops = [(name, pos) for name in self.layer_names()]
         with span("segment.remote_rtt", addr=self.addr,
-                  layers=f"{self.start}-{self.stop}"):
+                  layers=self._span_tag):
             t0 = time.perf_counter()
-            req = self._protocol.encode_ops(x, ops)
+            # buffer sequence straight into the gather-write transport: the
+            # activation payload is never copied into a contiguous frame
+            req = self._protocol.encode_ops_parts(x, ops, self.wire_codec)
+            req_len = sum(len(p) for p in req)
             t_ser = time.perf_counter() - t0
-            with span("wire.send", bytes=len(req)):
+            with span("wire.send", bytes=req_len):
                 self.conn.send(self._MsgType.BATCH, req)
             with span("wire.recv"):
                 t, payload = self.conn.recv()
@@ -190,12 +218,15 @@ class RemoteRunner(BlockRunner):
                 # wire error so the master's reconnect+replay recovery applies
                 raise self._wire.WireError(f"unexpected reply type {t}")
             t0 = time.perf_counter()
-            out = self._protocol.decode_tensor(payload)
+            out, _ = self._protocol.decode_activation(payload)
             t_de = time.perf_counter() - t0
         # per-call accounting: payload-level bytes, so the master's flight
-        # totals line up with the worker's own bytes_in/bytes_out counters
+        # totals line up with the worker's own bytes_in/bytes_out counters.
+        # raw_bytes is the pre-codec activation size both ways — the flight
+        # record's view of what the wire codec saved this call.
         self.last_call = {
-            "wire_bytes_out": len(req), "wire_bytes_in": len(payload),
+            "wire_bytes_out": req_len, "wire_bytes_in": len(payload),
+            "wire_bytes_raw": int(x.nbytes + out.nbytes),
             "serialize_ms": t_ser * 1e3, "deserialize_ms": t_de * 1e3,
         }
         self._ser_hist.observe(t_ser * 1e3)

@@ -67,6 +67,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
@@ -686,7 +687,8 @@ class BatchGenerator:
         # stream admitted later starts its own schedule at 1)
         self._index = np.ones((b,), np.int32)
         self._emitted_first = False
-        self._block_buf: list[np.ndarray] = []
+        # deque of [B] token rows: the per-step pop is O(1), not list.pop(0)
+        self._block_buf: deque[np.ndarray] = deque()
         self._spec_bank = [[] for _ in self.streams]
         self._spec_ctx = None  # fresh prompts: device ctx rows are stale
         self._spec_ctx_pos = None
@@ -886,10 +888,12 @@ class BatchGenerator:
         dt = time.perf_counter() - t0
         self._busy_s += dt
         self._admit_hist.observe(dt * 1e3)
-        obs_flight.recorder().record(
-            kind="admit", total_ms=round(dt * 1e3, 3), chunk=chunk,
-            pos=base + pos,
-        )
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            rec.record(
+                kind="admit", total_ms=round(dt * 1e3, 3), chunk=chunk,
+                pos=base + pos,
+            )
         st["pos"] = pos + chunk
         if final:
             self._finish_admission(logits)
@@ -935,7 +939,7 @@ class BatchGenerator:
         # lookahead block is the same chronology, one block later — fetch
         # and record it too (its rows are also pre-admission tokens).
         while self._block_buf:
-            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
+            self._pending_rows.append(self._emit(self._block_buf.popleft()))
         if self._inflight is not None:
             toks_if, _ = self._inflight
             self._inflight = None
@@ -1427,7 +1431,7 @@ class BatchGenerator:
         (same `_emit` path as stepping); the Token rows land in the
         pending queue for any consumer still calling step()."""
         while self._block_buf:
-            self._pending_rows.append(self._emit(self._block_buf.pop(0)))
+            self._pending_rows.append(self._emit(self._block_buf.popleft()))
         if self._inflight is not None:
             toks, _ = self._inflight
             self._inflight = None
@@ -1463,7 +1467,7 @@ class BatchGenerator:
         # proposals mid-drain would emit later tokens ahead of buffered
         # earlier ones and scramble per-stream order (r4 review repro).
         if self._block_buf:
-            return self._emit(self._block_buf.pop(0))
+            return self._emit(self._block_buf.popleft())
         if self._spec_k:
             row = self._spec_emit_or_round()
             if row is not None:
@@ -1515,12 +1519,14 @@ class BatchGenerator:
             self._busy_s += dt
             # per-token ms so the series is comparable across block sizes
             self._dispatch_hist.observe(dt * 1e3 / max(1, size))
-            obs_flight.recorder().record(
-                kind="decode", total_ms=round(dt * 1e3, 3), steps=size,
-                batch=len(self.streams),
-            )
-            self._block_buf = [rows[i] for i in range(rows.shape[0])]
-            return self._emit(self._block_buf.pop(0))
+            rec = obs_flight.recorder()
+            if rec.enabled:
+                rec.record(
+                    kind="decode", total_ms=round(dt * 1e3, 3), steps=size,
+                    batch=len(self.streams),
+                )
+            self._block_buf = deque(rows[i] for i in range(rows.shape[0]))
+            return self._emit(self._block_buf.popleft())
 
         if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
             raise RuntimeError("KV cache exhausted")  # window-full streams done
@@ -1539,10 +1545,12 @@ class BatchGenerator:
         dt = time.perf_counter() - t0
         self._busy_s += dt
         self._dispatch_hist.observe(dt * 1e3)
-        obs_flight.recorder().record(
-            kind="decode", total_ms=round(dt * 1e3, 3), steps=1,
-            batch=len(self.streams),
-        )
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            rec.record(
+                kind="decode", total_ms=round(dt * 1e3, 3), steps=1,
+                batch=len(self.streams),
+            )
         self._pos = self._pos + 1
         self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 from functools import partial
 
 import jax
@@ -169,9 +170,10 @@ class GeneratorBase:
         self._pos = 0
         self._last_token: int | None = None
         self._eos_ids = set(config.eos_ids())
-        # fused block-decode buffer (subclasses with block_size > 1)
+        # fused block-decode buffer (subclasses with block_size > 1);
+        # deque: the per-token pop is O(1), not the O(n) list.pop(0)
         self.block_size = 1
-        self._block_buf: list[int] = []
+        self._block_buf: deque[int] = deque()
 
     # -- prompt handling ----------------------------------------------------
     def set_prompt(self, prompt: str | list[int]) -> None:
@@ -218,7 +220,7 @@ class GeneratorBase:
                 jnp.asarray(tail, jnp.int32)
             )
             self._hist_slot = jnp.int32(len(tail))
-        self._block_buf = []
+        self._block_buf = deque()
         self._on_new_prompt()
 
     def _on_new_prompt(self) -> None:
@@ -244,18 +246,31 @@ class GeneratorBase:
         return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
 
     def _decode_next(self, index: int, run_block, run_single) -> Token:
-        """Shared block-decode control flow: pop the buffer, else dispatch a
-        fused ``block_size``-step block (``run_block(index) -> list[int]``,
+        """Shared block-decode control flow: pop the buffer, else collect
+        an in-flight lookahead block, else dispatch a fused
+        ``block_size``-step block (``run_block(index) -> list[int]``,
         which must advance ``_pos``/history), else a single step
         (``run_single(index) -> int``) for block_size == 1 or the tail of
-        the KV window."""
+        the KV window. The in-flight check runs BEFORE the capacity check:
+        a lookahead block dispatched up to the window edge has already
+        advanced ``_pos`` to ``max_seq``, and its tokens must still be
+        delivered."""
         if self._block_buf:
-            return self._finish_token(self._block_buf.pop(0))
+            return self._finish_token(self._block_buf.popleft())
+        toks = self._take_inflight(index)
+        if toks is not None:
+            self._block_buf.extend(toks)
+            return self._finish_token(self._block_buf.popleft())
         self._check_capacity()
         if self.block_size > 1 and self._pos + self.block_size <= self.max_seq:
-            self._block_buf = run_block(index)
-            return self._finish_token(self._block_buf.pop(0))
+            self._block_buf.extend(run_block(index))
+            return self._finish_token(self._block_buf.popleft())
         return self._finish_token(run_single(index))
+
+    def _take_inflight(self, index: int) -> list[int] | None:
+        """Hook: tokens already computed (or computing) on device from a
+        lookahead dispatch. Default: none."""
+        return None
 
     # -- Generator trait surface --------------------------------------------
     def next_token(self, index: int) -> Token:  # pragma: no cover - abstract
@@ -292,6 +307,7 @@ class LlamaGenerator(GeneratorBase):
         cache_dtype=None,
         block_size: int = 1,
         kv_quant: str | None = None,
+        lookahead: bool = False,
     ):
         """``block_size > 1`` fuses that many decode steps into one dispatch
         (lax.scan; sampling stays on-device) and streams the buffered tokens
@@ -300,11 +316,21 @@ class LlamaGenerator(GeneratorBase):
         schedule is block-size-invariant (absolute token index), so a given
         seed yields the same stream at any block size.
 
+        ``lookahead`` (needs block_size > 1) dispatches block N+1 from the
+        DEVICE-side feedback token before block N's rows are fetched to the
+        host, hiding the device->host readback + detok + emission behind
+        device compute (JAX async dispatch). Token streams are bit-identical
+        to the non-lookahead path: the feedback token is exactly the one the
+        host would have fed back, and the key schedule is absolute-index
+        based.
+
         ``kv_quant="int8"`` stores the KV cache as int8 + per-slot scales
         (half the cache HBM; quantize-on-write, kvcache.QuantizedKV)."""
         super().__init__(config, tokenizer, settings, max_seq)
         self.params = params
         self.block_size = max(1, block_size)
+        self._lookahead = bool(lookahead) and self.block_size > 1
+        self._inflight = None  # un-fetched [steps] device tokens
         # per-token dispatch latency (block dispatches record ms/token so
         # the series is comparable across block sizes) and prompt-pass ms
         self._decode_hist = obs_metrics.Histogram("generator.decode_ms")
@@ -330,28 +356,64 @@ class LlamaGenerator(GeneratorBase):
             if self.block_size > 1 else self._decode_single
         )
 
+    def _on_new_prompt(self) -> None:
+        # an in-flight lookahead block belongs to the previous stream; its
+        # stale KV writes sit beyond the new prompt's causal frontier (the
+        # same invariant set_prompt documents for the cache itself)
+        self._inflight = None
+
+    def _dispatch_block(self, token_dev, index0: int):
+        """Async-dispatch one fused ``block_size``-step block and advance
+        the host-side position; the ``[steps]`` device token rows return
+        UN-fetched so the caller chooses when to pay the host sync."""
+        toks, self.cache, self._history, self._hist_slot = self._decode(
+            self.params,
+            token_dev,
+            self.cache,
+            jnp.int32(self._pos),
+            self._key,  # base key; scan folds with the absolute index
+            self._history,
+            self._hist_slot,
+            index0=jnp.int32(index0),
+        )
+        self._pos += self.block_size
+        return toks
+
     def _run_block(self, index: int) -> list[int]:
         t0 = time.perf_counter()
         with span("decode.block", index=index, steps=self.block_size):
-            toks, self.cache, self._history, self._hist_slot = self._decode(
-                self.params,
-                jnp.asarray([self._last_token], jnp.int32),
-                self.cache,
-                jnp.int32(self._pos),
-                self._key,  # base key; scan folds with the absolute index
-                self._history,
-                self._hist_slot,
-                index0=jnp.int32(index),
-            )
-            self._pos += self.block_size
+            if self._inflight is not None:
+                toks = self._inflight  # block already computing on device
+                self._inflight = None
+            else:
+                toks = self._dispatch_block(
+                    jnp.asarray([self._last_token], jnp.int32), index
+                )
+            if self._lookahead and self._pos + self.block_size <= self.max_seq:
+                # enqueue block N+1 from the DEVICE feedback token (exactly
+                # the token the host would feed back) BEFORE block N's host
+                # fetch — the device computes ahead while the host detoks
+                # and emits; measured wall below is therefore mostly the
+                # residual fetch wait, not the block's math
+                self._inflight = self._dispatch_block(
+                    toks[-1].reshape(1).astype(jnp.int32),
+                    index + self.block_size,
+                )
             out = [int(t) for t in toks]
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._decode_hist.observe(dt_ms / self.block_size)
-        obs_flight.recorder().record(
-            index=index, kind="decode", total_ms=round(dt_ms, 3),
-            steps=self.block_size,
-        )
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            rec.record(
+                index=index, kind="decode", total_ms=round(dt_ms, 3),
+                steps=self.block_size, lookahead=self._lookahead,
+            )
         return out
+
+    def _take_inflight(self, index: int) -> list[int] | None:
+        if self._inflight is None:
+            return None
+        return self._run_block(index)
 
     def _run_single(self, index: int) -> int:
         t0 = time.perf_counter()
@@ -371,9 +433,11 @@ class LlamaGenerator(GeneratorBase):
             out = int(tok)
         dt_ms = (time.perf_counter() - t0) * 1e3
         self._decode_hist.observe(dt_ms)
-        obs_flight.recorder().record(
-            index=index, kind="decode", total_ms=round(dt_ms, 3), steps=1,
-        )
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            rec.record(
+                index=index, kind="decode", total_ms=round(dt_ms, 3), steps=1,
+            )
         return out
 
     def next_token(self, index: int) -> Token:
@@ -403,8 +467,11 @@ class LlamaGenerator(GeneratorBase):
                 tok_id = int(tok)
             dt_ms = (time.perf_counter() - t0) * 1e3
             self._prefill_hist.observe(dt_ms)
-            obs_flight.recorder().record(
-                index=0, kind="prefill", total_ms=round(dt_ms, 3), tokens=n,
-            )
+            rec = obs_flight.recorder()
+            if rec.enabled:
+                rec.record(
+                    index=0, kind="prefill", total_ms=round(dt_ms, 3),
+                    tokens=n,
+                )
             return self._finish_token(tok_id)
         return self._decode_next(index, self._run_block, self._run_single)

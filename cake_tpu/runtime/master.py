@@ -22,7 +22,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
@@ -44,10 +43,13 @@ def build_runners(
     topology: Topology,
     local_params_loader,  # callable (start, stop) -> stacked layers pytree
     max_seq: int | None = None,
+    wire_codec: str = "none",
 ) -> list[BlockRunner]:
     """Plan the block walk: one runner per contiguous same-owner segment.
     Unassigned layers run locally on the master (llama.rs:177-193: topology
-    decides Client vs local Transformer per layer)."""
+    decides Client vs local Transformer per layer). ``wire_codec`` selects
+    the activation encoding for every remote hop (negotiated against each
+    worker's advertised set at handshake)."""
     runners: list[BlockRunner] = []
     for seg in topology.segments(config.num_hidden_layers):
         if seg.owner is None:
@@ -62,6 +64,7 @@ def build_runners(
             runner = RemoteRunner(
                 node.host, seg.start, seg.stop,
                 max_seq=max_seq or config.max_seq_len,
+                wire_codec=wire_codec,
             )
             log.info("connected: %s", runner.info)
             runners.append(runner)
@@ -84,6 +87,9 @@ class DistributedGenerator(GeneratorBase):
     ):
         super().__init__(config, tokenizer, settings, max_seq)
         self.runners = runners
+        # identities resolved once: span kwargs on the per-token walk must
+        # not re-derive them (disabled-tracer cost stays near-zero)
+        self._seg_idents = [r.ident() for r in runners]
         self.embed = head_params["embed"]
         self.norm_f = head_params["norm_f"]
         self.lm_head = head_params["lm_head"]
@@ -140,17 +146,23 @@ class DistributedGenerator(GeneratorBase):
     # -- forward across runners --------------------------------------------
     def _forward(self, tokens: list[int], pos: int, last_index: int) -> jax.Array:
         # through the shared embedding entry point so family deltas (Gemma's
-        # sqrt(hidden) embed scaling) hold on the distributed path too
-        x = np.asarray(
-            llama.embed_tokens({"embed": self.embed},
+        # sqrt(hidden) embed scaling) hold on the distributed path too.
+        # Device-resident walk: ``x`` stays a jax.Array across consecutive
+        # LocalRunner segments (async dispatch, no host sync) and is only
+        # materialized as numpy at remote boundaries — on a mixed topology
+        # this removes two host copies per local segment per token (the
+        # reference bounces every hop through host memory, llama.rs:100-119).
+        # Per-segment timings therefore measure dispatch for local segments;
+        # their compute lands in the next remote hop's encode sync or the
+        # head fetch, which is exactly the overlap being bought.
+        x = llama.embed_tokens({"embed": self.embed},
                                jnp.asarray([tokens], jnp.int32), self.config)
-        )
         self._last_seg_ms = []
         for i, runner in enumerate(self.runners):
             runner.last_call = {}
             t0 = time.perf_counter()
-            with span("decode.segment", seg=i, ident=runner.ident()):
-                x = runner.forward(x, pos)
+            with span("decode.segment", seg=i, ident=self._seg_idents[i]):
+                x = runner.forward_jax(x, pos)
             dt = time.perf_counter() - t0
             self._last_seg_ms.append(dt * 1e3)
             if self._timing_paused:
@@ -233,6 +245,7 @@ class DistributedGenerator(GeneratorBase):
         rec = obs_flight.recorder()
         if rec.enabled:
             wire_tot = {"wire_bytes_out": 0, "wire_bytes_in": 0,
+                        "wire_bytes_raw": 0,
                         "serialize_ms": 0.0, "deserialize_ms": 0.0}
             for r in self.runners:
                 for k in wire_tot:

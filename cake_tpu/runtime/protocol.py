@@ -28,6 +28,7 @@ from enum import IntEnum
 import numpy as np
 
 from cake_tpu import __version__
+from cake_tpu.obs import metrics as _metrics
 
 
 class MsgType(IntEnum):
@@ -62,15 +63,45 @@ def _np_dtype(name: str):
     return np.dtype(name)
 
 
-def encode_tensor(x) -> bytes:
-    """numpy (or jax-convertible) array -> wire bytes."""
-    arr = np.asarray(x)
+def _dtype_code(arr: np.ndarray) -> int:
     name = arr.dtype.name if arr.dtype.name in _NAME_TO_CODE else str(arr.dtype)
     if name not in _NAME_TO_CODE:
         raise ValueError(f"unsupported wire dtype {arr.dtype}")
-    header = struct.pack("<BB", _NAME_TO_CODE[name], arr.ndim)
-    dims = struct.pack(f"<{arr.ndim}I", *arr.shape)
-    return header + dims + np.ascontiguousarray(arr).tobytes()
+    return _NAME_TO_CODE[name]
+
+
+def _contig(x) -> np.ndarray:
+    arr = np.asarray(x)
+    # (ascontiguousarray would promote 0-d to 1-d; only copy when needed)
+    return arr if arr.flags["C_CONTIGUOUS"] else np.ascontiguousarray(arr)
+
+
+def _buf(arr: np.ndarray):
+    """Zero-copy byte memoryview over a C-contiguous array's storage (the
+    uint8 reinterpret handles dtypes like bfloat16 whose buffer format
+    memoryview.cast cannot)."""
+    return arr.reshape(-1).view(np.uint8).data
+
+
+def encode_tensor_parts(x) -> list:
+    """numpy (or jax-convertible) array -> [header bytes, data buffer].
+
+    The data part is a memoryview over the array's own storage when it is
+    already contiguous — callers that can scatter-gather (wire.Connection
+    hands a buffer sequence to ``sendmsg``) ship multi-MB activations with
+    zero payload copies; ``encode_tensor`` joins the parts once for callers
+    that need one bytes object."""
+    arr = _contig(x)
+    header = struct.pack("<BB", _dtype_code(arr), arr.ndim) + struct.pack(
+        f"<{arr.ndim}I", *arr.shape
+    )
+    return [header, _buf(arr)]
+
+
+def encode_tensor(x) -> bytes:
+    """numpy (or jax-convertible) array -> wire bytes (one copy: the join;
+    the reference's serializer copies per-field, message.rs:104-105)."""
+    return b"".join(encode_tensor_parts(x))
 
 
 def decode_tensor(buf: bytes) -> np.ndarray:
@@ -88,6 +119,113 @@ def decode_tensor(buf: bytes) -> np.ndarray:
             f"shape {dims} {dt}"
         )
     return np.frombuffer(data, dtype=dt).reshape(dims)
+
+
+# -- activation wire codec ---------------------------------------------------
+#
+# Petals (Borzunov et al., 2022) showed activation compression is the
+# enabling trick for pipeline inference over slow links; the reference ships
+# raw full-precision tensors every token (llama.rs:100-119). Here the master
+# negotiates a per-connection codec at handshake (WorkerInfo.codecs) and the
+# worker mirrors whatever codec the request rode in. Encodings are
+# self-describing: `none` is the plain tensor layout above (first byte is a
+# dtype code < 0x80, so it stays wire-compatible with pre-codec peers);
+# compressed layouts open with a marker byte >= 0x80.
+#
+#   bf16: 0x81 | u8 orig_dtype | tensor(bfloat16)          (~2x on f32)
+#   int8: 0x82 | u8 orig_dtype | u8 ndim | u32 dims[ndim]
+#         | f32 scales[rows] | i8 q[rows, last_dim]        (~4x on f32)
+#
+# int8 uses per-row symmetric absmax scales (a row = one token's hidden
+# vector for [B, T, H] activations). Integer dtypes pass through as `none`
+# under every codec (lossless; quantizing ids would corrupt them).
+
+CODECS = ("none", "bf16", "int8")
+_BF16_MARK, _INT8_MARK = 0x81, 0x82
+
+
+def check_codec(codec: str) -> str:
+    """Validate a codec name (shared by the encoder, RemoteRunner, and
+    Worker so the accepted set and the error live in one place)."""
+    if codec not in CODECS:
+        raise ValueError(f"unknown wire codec {codec!r} (know {CODECS})")
+    return codec
+
+# pre/post-compression payload bytes: the registry view of what the codec
+# saves (flight records carry the per-call split via RemoteRunner.last_call)
+_CODEC_RAW = _metrics.counter("wire.codec_bytes_raw")
+_CODEC_ENC = _metrics.counter("wire.codec_bytes_encoded")
+
+
+def encode_activation_parts(x, codec: str = "none") -> list:
+    """Activation tensor -> buffer-sequence under ``codec`` (see module
+    comment for layouts). Float inputs only compress; integer inputs ride
+    the `none` layout regardless of codec."""
+    check_codec(codec)
+    arr = _contig(x)
+    is_float = arr.dtype.kind == "f" or arr.dtype.name == "bfloat16"
+    if codec == "none" or not is_float or (
+        codec == "bf16" and arr.dtype.itemsize <= 2
+    ):
+        # 2-byte floats (bf16 itself, f16) gain nothing from the bf16
+        # layout — same payload size, and an f16->bf16 cast would LOSE
+        # mantissa bits; the none layout ships them verbatim
+        parts = encode_tensor_parts(arr)
+    elif codec == "bf16":
+        import ml_dtypes
+
+        orig = _dtype_code(arr)
+        parts = [struct.pack("<BB", _BF16_MARK, orig)]
+        parts += encode_tensor_parts(arr.astype(ml_dtypes.bfloat16))
+    else:  # int8
+        orig = _dtype_code(arr)
+        f = np.asarray(arr, np.float32)
+        rows = f.reshape(-1, f.shape[-1]) if f.ndim else f.reshape(1, 1)
+        absmax = np.max(np.abs(rows), axis=1)
+        scales = np.where(absmax > 0, absmax / 127.0, 1.0).astype(np.float32)
+        q = np.clip(np.rint(rows / scales[:, None]), -127, 127).astype(
+            np.int8
+        )
+        header = struct.pack("<BBB", _INT8_MARK, orig, arr.ndim)
+        header += struct.pack(f"<{arr.ndim}I", *arr.shape)
+        parts = [header, _buf(scales), _buf(q)]
+    _CODEC_RAW.inc(arr.nbytes)
+    _CODEC_ENC.inc(sum(len(p) for p in parts))
+    return parts
+
+
+def encode_activation(x, codec: str = "none") -> bytes:
+    return b"".join(encode_activation_parts(x, codec))
+
+
+def decode_activation(buf) -> tuple[np.ndarray, str]:
+    """Self-describing inverse of :func:`encode_activation`. Returns the
+    tensor (in its pre-compression dtype) and the codec it rode in, so a
+    worker can mirror the master's choice in its reply."""
+    buf = memoryview(buf)
+    mark = buf[0]
+    if mark < 0x80:
+        return decode_tensor(buf), "none"
+    if mark == _BF16_MARK:
+        orig = _np_dtype(_CODE_TO_NAME[buf[1]])
+        return decode_tensor(buf[2:]).astype(orig), "bf16"
+    if mark == _INT8_MARK:
+        orig_code, ndim = struct.unpack_from("<BB", buf, 1)
+        dims = struct.unpack_from(f"<{ndim}I", buf, 3)
+        off = 3 + 4 * ndim
+        n_rows = int(np.prod(dims[:-1])) if ndim else 1
+        last = dims[-1] if ndim else 1
+        scales = np.frombuffer(buf, np.float32, count=n_rows, offset=off)
+        q = np.frombuffer(buf, np.int8, offset=off + 4 * n_rows)
+        if q.size != n_rows * last:
+            raise ValueError(
+                f"int8 activation payload {q.size} != expected "
+                f"{n_rows * last} for shape {dims}"
+            )
+        x = (q.reshape(n_rows, last).astype(np.float32)
+             * scales[:, None]).reshape(dims)
+        return x.astype(_np_dtype(_CODE_TO_NAME[orig_code])), "int8"
+    raise ValueError(f"unknown activation codec marker 0x{mark:02x}")
 
 
 @dataclasses.dataclass
@@ -111,6 +249,11 @@ class WorkerInfo:
     # handshake (a silently smaller worker cache would clamp KV writes once
     # pos exceeds it and corrupt generation).
     max_seq: int = 0
+    # Activation wire codecs this worker accepts (and will mirror in its
+    # replies). Defaults to just "none" so a pre-codec peer — whose
+    # handshake payload lacks the field — is never credited with
+    # compression support it does not have.
+    codecs: list[str] = dataclasses.field(default_factory=lambda: ["none"])
 
     def to_bytes(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -129,21 +272,33 @@ class WorkerInfo:
         )
 
 
-def encode_ops(x: np.ndarray, ops: list[tuple[str, int]]) -> bytes:
-    """Batch payload: JSON op list (layer_name, index_pos) + tensor.
+def encode_ops_parts(x, ops: list[tuple[str, int]],
+                     codec: str = "none") -> list:
+    """Batch payload as a buffer sequence: JSON op list (layer_name,
+    index_pos) + codec-encoded activation tensor.
 
     The reference `Batch` carries ``Vec<(layer_name, index_pos, block_idx)>``
     (message.rs:57-76); block_idx is recoverable from layer_name so the wire
     format carries just (name, pos)."""
     meta = json.dumps(ops).encode()
-    return struct.pack("<I", len(meta)) + meta + encode_tensor(x)
+    return [struct.pack("<I", len(meta)) + meta] + encode_activation_parts(
+        x, codec
+    )
 
 
-def decode_ops(buf: bytes) -> tuple[np.ndarray, list[tuple[str, int]]]:
+def encode_ops(x: np.ndarray, ops: list[tuple[str, int]],
+               codec: str = "none") -> bytes:
+    return b"".join(encode_ops_parts(x, ops, codec))
+
+
+def decode_ops(buf) -> tuple[np.ndarray, list[tuple[str, int]], str]:
+    """Inverse of :func:`encode_ops`; the returned codec name is what the
+    request's tensor rode in (the worker mirrors it in the reply)."""
+    buf = memoryview(buf)
     (mlen,) = struct.unpack_from("<I", buf, 0)
-    ops = [tuple(o) for o in json.loads(buf[4 : 4 + mlen].decode())]
-    x = decode_tensor(buf[4 + mlen :])
-    return x, ops
+    ops = [tuple(o) for o in json.loads(bytes(buf[4 : 4 + mlen]).decode())]
+    x, codec = decode_activation(buf[4 + mlen :])
+    return x, ops, codec
 
 
 class WorkerOpError(RuntimeError):

@@ -27,6 +27,7 @@ different but identically-distributed stream than plain decode).
 
 from __future__ import annotations
 
+from collections import deque
 from functools import partial
 
 import jax
@@ -486,7 +487,7 @@ class SpeculativeMixin:
         self.emitted += len(emitted)
         self._pos += len(emitted)
         self._ctx_synced_pos = self._pos
-        self._block_buf = emitted[1:]
+        self._block_buf = deque(emitted[1:])
         return self._finish_token(emitted[0])
 
     def next_token(self, index: int):
@@ -546,7 +547,7 @@ class SpeculativeMixin:
         # region pos..pos+n-1 is [last, g_0..g_{n-2}] — correct by the
         # match condition. The next round feeds g_{n-1} at pos+n.
         self._pos += n
-        self._block_buf = emitted[1:]
+        self._block_buf = deque(emitted[1:])
         return self._finish_token(emitted[0])
 
 

@@ -156,28 +156,58 @@ class Connection:
         return self._fd is not None
 
     # -- send/recv ----------------------------------------------------------
-    def send(self, msg_type: int, payload: bytes = b"") -> None:
-        if len(payload) > MAX_PAYLOAD:
+    def send(self, msg_type: int, payload=b"") -> None:
+        """Send one frame. ``payload`` is a bytes-like object or a sequence
+        of them (the zero-copy path: protocol.encode_*_parts hand back
+        memoryviews over tensor storage, and the Python transport passes
+        them straight to ``sendmsg`` — a multi-MB activation is never
+        copied into a contiguous frame)."""
+        parts = (
+            [memoryview(payload)]
+            if isinstance(payload, (bytes, bytearray, memoryview))
+            else [memoryview(p) for p in payload]
+        )
+        plen = sum(len(p) for p in parts)
+        if plen > MAX_PAYLOAD:
             raise WireError(_ERRORS[-7])
         if self._fd is not None:
-            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload) \
-                if payload else None
-            rc = self._lib.cw_send_msg(self._fd, msg_type, buf, len(payload))
+            # the native ABI takes one contiguous buffer; join only here
+            buf = None
+            if plen:
+                payload = parts[0] if len(parts) == 1 else b"".join(parts)
+                buf = (ctypes.c_uint8 * plen).from_buffer_copy(payload)
+            rc = self._lib.cw_send_msg(self._fd, msg_type, buf, plen)
             if rc < 0:
                 _raise(rc)
         else:
             crc = zlib.crc32(bytes([msg_type]))
-            crc = zlib.crc32(payload, crc)
-            frame = _HEADER.pack(MAGIC, msg_type, len(payload)) + payload + \
-                struct.pack("<I", crc)
-            self._sock.sendall(frame)
+            for p in parts:
+                crc = zlib.crc32(p, crc)
+            header = _HEADER.pack(MAGIC, msg_type, plen)
+            trailer = struct.pack("<I", crc)
+            self._send_parts([memoryview(header), *parts,
+                              memoryview(trailer)])
         # counted only after the frame went out whole, so the series never
         # exceeds what the peer could have seen (a failed mid-stream send
         # would otherwise skew bytes_out vs the peer's bytes_in in exactly
         # the recovery scenarios these counters exist to diagnose)
         _FRAMES_OUT.inc()
-        _BYTES_OUT.inc(len(payload))
-        _FRAME_BYTES.observe(len(payload))
+        _BYTES_OUT.inc(plen)
+        _FRAME_BYTES.observe(plen)
+
+    def _send_parts(self, parts: list) -> None:
+        """Gather-write a buffer sequence (``sendmsg``), advancing across
+        partial sends; falls back to sendall on sockets without sendmsg."""
+        if not hasattr(self._sock, "sendmsg"):
+            self._sock.sendall(b"".join(parts))
+            return
+        while parts:
+            sent = self._sock.sendmsg(parts)
+            while parts and sent >= len(parts[0]):
+                sent -= len(parts[0])
+                parts.pop(0)
+            if parts and sent:
+                parts[0] = parts[0][sent:]
 
     def recv(self) -> tuple[int, bytes]:
         if self._fd is not None:

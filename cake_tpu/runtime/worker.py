@@ -67,6 +67,7 @@ class Worker:
         address: str = "0.0.0.0:10128",
         max_seq: int | None = None,
         kv_quant: str | None = None,
+        wire_codec: str | None = None,
     ):
         if name not in topology:
             raise ValueError(f"worker '{name}' not present in topology")
@@ -77,6 +78,17 @@ class Worker:
         # int8 per-connection KV caches: halves this worker's cache HBM
         # (each connection gets fresh quantized buffers, same isolation)
         self.kv_quant = kv_quant
+        # Activation wire codecs advertised in the handshake. By default
+        # every codec is on offer and the master picks per connection
+        # (--wire-codec); setting one here restricts the offer to
+        # {none, that codec} — the operator's lever to forbid lossy
+        # compression on a worker regardless of master flags.
+        if wire_codec is None:
+            self.codecs = list(protocol.CODECS)
+        else:
+            protocol.check_codec(wire_codec)
+            self.codecs = (["none"] if wire_codec == "none"
+                           else ["none", wire_codec])
         indices = self.node.layer_indices()
         if not indices:
             raise ValueError(f"worker '{name}' has no layers assigned")
@@ -159,6 +171,7 @@ class Worker:
                 "device_idx": info.device_idx,
                 "dtype": info.dtype,
                 "kv_quant": self.kv_quant,
+                "wire_codecs": list(self.codecs),
                 "max_seq": self.max_seq,
                 "port": self.port,
                 "layer_runs": [list(r) for r in self.runs],
@@ -240,6 +253,7 @@ class Worker:
             device_idx=getattr(dev, "id", 0),
             dtype=self.config.dtype,
             max_seq=self.max_seq,
+            codecs=list(self.codecs),
             layers=[
                 f"model.layers.{i}"
                 for lo, hi in self.runs
@@ -285,7 +299,16 @@ class Worker:
                     continue
                 bytes_in += len(payload)
                 try:
-                    x, ops = protocol.decode_ops(payload)
+                    x, ops, codec = protocol.decode_ops(payload)
+                    if codec not in self.codecs:
+                        # enforce the advertised restriction server-side: a
+                        # client that skipped the handshake check must not
+                        # smuggle lossy compression onto a worker whose
+                        # operator forbade it
+                        raise ValueError(
+                            f"wire codec '{codec}' not accepted by this "
+                            f"worker (offers {self.codecs})"
+                        )
                     t0 = time.perf_counter()
                     with span("worker.forward", ops=len(ops)):
                         out = self._run_ops(x, ops, caches)
@@ -294,13 +317,16 @@ class Worker:
                     log.exception("op failed")
                     conn.send(MsgType.ERROR, protocol.encode_error(str(e)))
                     continue
-                reply = protocol.encode_tensor(out)
-                bytes_out += len(reply)
+                # the reply mirrors the request's codec (master chose it at
+                # handshake against this worker's advertised set)
+                reply = protocol.encode_activation_parts(out, codec)
+                reply_len = sum(len(p) for p in reply)
+                bytes_out += reply_len
                 conn.send(MsgType.TENSOR, reply)
                 ops_done += len(ops)
                 self._ops_ctr.inc(len(ops))
                 self._bytes_in_ctr.inc(len(payload))
-                self._bytes_out_ctr.inc(len(reply))
+                self._bytes_out_ctr.inc(reply_len)
                 if ops_done >= STATS_EVERY:
                     dt = time.perf_counter() - t_window
                     log.info(

@@ -424,3 +424,29 @@ def test_window_override(tmp_path):
     (tmp_path / "config.json").write_text(json.dumps(mcfg.to_hf_dict()))
     assert toks(base + ["--window", "0"]) == plain
     assert toks(base) == windowed
+
+
+def test_lookahead_and_wire_codec_flag_guards(model_dir):
+    """--lookahead with --decode-block 1 and a compressing --wire-codec on
+    a non-topology run are rejected loudly (not silently ignored); spelling
+    out the default --wire-codec none anywhere is a harmless no-op."""
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "2",
+        "--temperature", "0", "--max-seq", "32", "--cpu",
+        "--lookahead", "--decode-block", "1",
+    ])
+    assert r.returncode != 0
+    assert "requires --decode-block > 1" in r.stderr
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "2",
+        "--temperature", "0", "--max-seq", "32", "--cpu",
+        "--wire-codec", "int8",
+    ])
+    assert r.returncode != 0
+    assert "host-addressed --topology" in r.stderr
+    r = _run_cli([
+        "--model", str(model_dir), "--prompt-ids", "3,5", "-n", "2",
+        "--temperature", "0", "--max-seq", "32", "--cpu",
+        "--wire-codec", "none", "--lookahead", "--decode-block", "4",
+    ])
+    assert r.returncode == 0, r.stderr

@@ -271,7 +271,8 @@ def test_worker_op_error_not_retried(params):
     def boom(x, pos):
         raise protocol.WorkerOpError("worker 127.0.0.1:1: bad op")
 
-    g.runners[0].forward = boom
+    # forward_jax is the seam the master's segment walk calls
+    g.runners[0].forward_jax = boom
     with pytest.raises(protocol.WorkerOpError):
         g.next_token(1)
     assert g.recoveries == 0
@@ -292,7 +293,7 @@ def test_recovery_attempts_capped(params):
     g.next_token(0)
 
     calls = {"n": 0}
-    real_forward = g.runners[0].forward
+    real_forward = g.runners[0].forward_jax
 
     def flaky(x, pos):
         calls["n"] += 1
@@ -301,7 +302,7 @@ def test_recovery_attempts_capped(params):
             raise wire.WireError("connection reset")
         return real_forward(x, pos)
 
-    g.runners[0].forward = flaky
+    g.runners[0].forward_jax = flaky
     # each failing decode step replays successfully and yields a token, but
     # the consecutive-recovery counter never resets; the cap must trip
     with pytest.raises(RuntimeError, match="consecutive recovery"):

@@ -211,3 +211,56 @@ def test_block_decode_sampled_key_schedule_invariant(gen_setup):
     g.set_prompt([5, 9, 2, 11])
     b = [g.next_token(i).id for i in range(9)]
     assert a == b
+
+
+@pytest.mark.parametrize("block", [1, 4, 8])
+def test_lookahead_stream_bit_identical(gen_setup, block):
+    """Lookahead dispatch (block N+1 enqueued from the device feedback
+    token before block N's host fetch) must be invisible in the output:
+    identical sampled streams at every block size."""
+    cfg, params = gen_setup
+    settings = SamplerSettings(temperature=0.9, top_k=20, seed=11)
+    g = LlamaGenerator(cfg, params, settings=settings, block_size=block)
+    g.set_prompt([3, 1, 4])
+    plain = [g.next_token(i).id for i in range(20)]
+    g2 = LlamaGenerator(cfg, params, settings=settings, block_size=block,
+                        lookahead=True)
+    g2.set_prompt([3, 1, 4])
+    ahead = [g2.next_token(i).id for i in range(20)]
+    assert ahead == plain
+
+
+def test_lookahead_window_edge_delivers_inflight(gen_setup):
+    """A lookahead block dispatched up to the window edge has already
+    advanced pos to max_seq; its tokens must still be delivered before
+    capacity exhaustion raises — and the full stream matches plain."""
+    cfg, params = gen_setup
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    prompt = list(range(1, 9))  # pos 8 after prefill; 3 full 8-blocks fit
+    # 25 tokens: 1 from prefill + 3 fused blocks of 8 fill the window
+    g = LlamaGenerator(cfg, params, settings=settings, max_seq=32,
+                       block_size=8)
+    g.set_prompt(prompt)
+    plain = [g.next_token(i).id for i in range(25)]
+    g2 = LlamaGenerator(cfg, params, settings=settings, max_seq=32,
+                        block_size=8, lookahead=True)
+    g2.set_prompt(prompt)
+    ahead = [g2.next_token(i).id for i in range(25)]
+    assert ahead == plain and g2._pos == 32
+    with pytest.raises(RuntimeError, match="exhausted"):
+        g2.next_token(25)
+
+
+def test_lookahead_new_prompt_drops_inflight(gen_setup):
+    """set_prompt mid-stream must discard the in-flight device block (it
+    belongs to the previous stream)."""
+    cfg, params = gen_setup
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    g = LlamaGenerator(cfg, params, settings=settings, block_size=4,
+                       lookahead=True)
+    g.set_prompt([5, 9, 2])
+    first = [g.next_token(i).id for i in range(6)]
+    assert g._inflight is not None  # a block is pipelined mid-stream
+    g.set_prompt([5, 9, 2])
+    assert g._inflight is None
+    assert [g.next_token(i).id for i in range(6)] == first

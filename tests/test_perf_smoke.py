@@ -1,0 +1,85 @@
+"""Perf smoke: the disabled observability hot path must be near-zero.
+
+`span()` and `flight.recorder().record()` sit on the per-token decode loop;
+when tracing/flight are off they must cost an attribute check, not kwarg
+formatting or dict building (the runtime call sites guard with
+`rec.enabled` / precomputed span tags for exactly this). The micro-bench
+bounds here are ~20x above what a laptop measures (<0.5 us/call) so CI
+noise cannot trip them while a real regression — say a dict build or
+f-string sneaking back onto the disabled path at 10x — still does.
+
+`make perf-smoke` runs this module plus the codec loopback
+(tests/test_wire_codec.py); both are tier-1 (`not slow`).
+"""
+
+import time
+
+from cake_tpu.obs import flight, trace
+from cake_tpu.obs.trace import span
+
+
+def _best_per_call(fn, n=20_000, trials=5) -> float:
+    """Median-of-trials per-call seconds (the min of several runs is the
+    stable estimator for a micro-bench under CI scheduling noise)."""
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        fn(n)
+        times.append((time.perf_counter() - t0) / n)
+    return min(times)
+
+
+def test_disabled_span_is_near_zero():
+    tr = trace.tracer()
+    assert not tr.enabled
+
+    def loop(n):
+        for i in range(n):
+            with span("decode.step", index=i):
+                pass
+
+    per_call = _best_per_call(loop)
+    assert per_call < 10e-6, f"disabled span() cost {per_call * 1e6:.2f}us"
+
+
+def test_disabled_flight_record_is_near_zero():
+    rec = flight.recorder()
+    assert not rec.enabled
+
+    def loop(n):
+        for i in range(n):
+            rec.record(index=i, kind="decode", total_ms=1.0, steps=1)
+
+    per_call = _best_per_call(loop)
+    assert per_call < 10e-6, f"disabled record() cost {per_call * 1e6:.2f}us"
+
+
+def test_enabled_guard_skips_field_construction():
+    """The hot-path pattern: callers check `rec.enabled` before building
+    record kwargs, so the disabled cost is one attribute read."""
+    rec = flight.recorder()
+    assert not rec.enabled
+
+    def loop(n):
+        for i in range(n):
+            if rec.enabled:
+                rec.record(index=i, total_ms=round(i * 0.1, 3))
+
+    per_call = _best_per_call(loop)
+    assert per_call < 2e-6, f"guarded record cost {per_call * 1e6:.2f}us"
+
+
+def test_disabled_registry_instruments_are_noops():
+    from cake_tpu.obs.metrics import Registry
+
+    reg = Registry(enabled=False)
+    ctr = reg.counter("hot")
+    hist = reg.histogram("hot_ms")
+
+    def loop(n):
+        for _ in range(n):
+            ctr.inc()
+            hist.observe(1.0)
+
+    per_call = _best_per_call(loop)
+    assert per_call < 10e-6, f"null instrument cost {per_call * 1e6:.2f}us"

@@ -425,7 +425,7 @@ def test_fused_device_ctx_tracks_true_context(params):
     for i in range(20):
         g.next_token(i)
     assert g._ctx is not None and g._ctx_synced_pos == g._pos
-    true_ctx = g._prompt_tokens + g._generated + g._block_buf
+    true_ctx = g._prompt_tokens + g._generated + list(g._block_buf)
     got = np.asarray(g._ctx)[: g._pos + 1].tolist()
     assert got == true_ctx
 

@@ -138,6 +138,28 @@ def test_worker_info_carries_identity_fields():
 def test_ops_codec_roundtrip():
     x = np.random.RandomState(0).randn(1, 3, 8).astype(np.float32)
     ops = [("model.layers.4", 7), ("model.layers.5", 7)]
-    x2, ops2 = protocol.decode_ops(protocol.encode_ops(x, ops))
+    x2, ops2, codec = protocol.decode_ops(protocol.encode_ops(x, ops))
     np.testing.assert_array_equal(x, x2)
     assert ops2 == ops
+    assert codec == "none"
+
+
+def test_multipart_payload_send(monkeypatch):
+    """A buffer-sequence payload (the zero-copy activation path) frames
+    identically to the equivalent contiguous bytes, across native and
+    Python endpoints."""
+    arr = np.arange(512, dtype=np.float32).reshape(4, 128)
+    parts = protocol.encode_ops_parts(arr, [("model.layers.0", 3)])
+    flat = protocol.encode_ops(arr, [("model.layers.0", 3)])
+    assert b"".join(bytes(p) for p in parts) == flat
+    for server_py in (False, True):
+        listener = wire.Listener("127.0.0.1", 0, force_python=server_py)
+        th = _echo_server(listener)
+        conn = wire.connect("127.0.0.1", listener.port,
+                            force_python=not server_py)
+        conn.send(MsgType.BATCH, parts)
+        t, got = conn.recv()
+        assert t == MsgType.BATCH and got == flat
+        conn.close()
+        th.join(timeout=5)
+        listener.close()

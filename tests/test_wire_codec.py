@@ -1,0 +1,309 @@
+"""Activation wire codec: round-trip properties, handshake negotiation,
+and the compressed loopback master<->worker path.
+
+Pins the perf_opt contract: a 2-segment loopback run under the bf16 codec
+ships >= 1.9x fewer `wire.bytes_out` per decode token than `none`, int8
+~4x, and compressed runs still complete generation (the codec perturbs
+low-order logit bits like kv-quant does, so token parity is only asserted
+for `none`).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.runner import RemoteRunner
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import protocol
+from cake_tpu.runtime.master import DistributedGenerator, build_runners
+from cake_tpu.runtime.protocol import WorkerInfo
+from cake_tpu.runtime.worker import Worker
+
+
+# -- codec round-trip properties --------------------------------------------
+
+_SHAPES = [(1, 1, 32), (2, 5, 16), (7,), (3, 4)]
+
+
+def _rand(shape, dtype, seed):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(*shape) * rng.choice([1e-3, 1.0, 37.0])
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return x.astype(ml_dtypes.bfloat16)
+    if np.issubdtype(np.dtype(dtype), np.integer):
+        return (x * 100).astype(dtype)
+    return x.astype(dtype)
+
+
+@pytest.mark.parametrize("codec", protocol.CODECS)
+@pytest.mark.parametrize(
+    "dtype", ["float32", "bfloat16", "float16", "int32", "int8", "int64"]
+)
+def test_activation_roundtrip_all_dtypes(dtype, codec):
+    """Every (dtype, codec) pair round-trips: shape and dtype exactly;
+    values exactly for `none` and for integer dtypes under any codec
+    (pass-through), within the codec's quantization bound for floats."""
+    for seed, shape in enumerate(_SHAPES):
+        arr = _rand(shape, dtype, seed)
+        out, got_codec = protocol.decode_activation(
+            protocol.encode_activation(arr, codec)
+        )
+        is_int = np.issubdtype(arr.dtype, np.integer)
+        # integers always pass through; 2-byte floats under bf16 compress
+        # nothing (and f16->bf16 would LOSE mantissa bits), so they ride
+        # the none layout verbatim
+        passthrough = is_int or (
+            codec == "bf16" and dtype in ("bfloat16", "float16")
+        )
+        assert got_codec == ("none" if passthrough else codec)
+        assert out.shape == arr.shape and out.dtype == arr.dtype
+        f = np.asarray(arr, np.float32)
+        if codec == "none" or passthrough:
+            np.testing.assert_array_equal(out, arr)
+        elif codec == "bf16":
+            import ml_dtypes
+
+            np.testing.assert_array_equal(
+                out, arr.astype(ml_dtypes.bfloat16).astype(arr.dtype)
+            )
+        else:  # int8: per-row absmax, round-to-nearest -> err <= scale/2,
+            # plus the cast back into a low-precision original dtype
+            rows = f.reshape(-1, f.shape[-1])
+            absmax = np.abs(rows).max(axis=1, keepdims=True)
+            scale = absmax / 127.0
+            eps_orig = {"bfloat16": 2.0 ** -8, "float16": 2.0 ** -10}.get(
+                dtype, 2.0 ** -23
+            )
+            err = np.abs(np.asarray(out, np.float32).reshape(rows.shape)
+                         - rows)
+            assert (err <= scale * 0.51 + absmax * eps_orig + 1e-6).all()
+
+
+def test_int8_codec_compresses_about_4x():
+    x = np.random.RandomState(0).randn(1, 8, 512).astype(np.float32)
+    none_len = len(protocol.encode_activation(x, "none"))
+    int8_len = len(protocol.encode_activation(x, "int8"))
+    bf16_len = len(protocol.encode_activation(x, "bf16"))
+    assert none_len / int8_len > 3.5
+    assert none_len / bf16_len > 1.9
+
+
+def test_codec_counters_track_savings():
+    raw0 = obs_metrics.counter("wire.codec_bytes_raw").value
+    enc0 = obs_metrics.counter("wire.codec_bytes_encoded").value
+    x = np.zeros((1, 4, 256), np.float32) + 1.5
+    protocol.encode_activation(x, "int8")
+    raw = obs_metrics.counter("wire.codec_bytes_raw").value - raw0
+    enc = obs_metrics.counter("wire.codec_bytes_encoded").value - enc0
+    assert raw == x.nbytes and 0 < enc < raw / 3
+
+
+def test_ops_roundtrip_carries_codec():
+    x = np.random.RandomState(1).randn(1, 2, 64).astype(np.float32)
+    ops = [("model.layers.0", 9)]
+    for codec in protocol.CODECS:
+        x2, ops2, got = protocol.decode_ops(
+            protocol.encode_ops(x, ops, codec)
+        )
+        assert got == codec and ops2 == ops and x2.shape == x.shape
+
+
+def test_decode_activation_rejects_unknown_marker():
+    with pytest.raises(ValueError, match="codec marker"):
+        protocol.decode_activation(b"\xff\x00\x00")
+
+
+def test_worker_info_codecs_default_is_none_only():
+    """A pre-codec peer's handshake payload lacks the field; it must not be
+    credited with compression support."""
+    import dataclasses
+    import json
+
+    d = dataclasses.asdict(WorkerInfo(name="old"))
+    d.pop("codecs")
+    got = WorkerInfo.from_bytes(json.dumps(d).encode())
+    assert got.codecs == ["none"]
+
+
+# -- loopback master <-> worker under compression ----------------------------
+
+CFG = tiny(max_seq_len=64)
+# hidden wide enough that the per-token activation dominates the op-list
+# JSON overhead — the >= 1.9x bf16 contract is about payload, not framing
+BIG = tiny(hidden_size=512, intermediate_size=256, num_hidden_layers=2,
+           max_seq_len=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(3))
+
+
+@pytest.fixture(scope="module")
+def big_params():
+    return llama.init_params(BIG, jax.random.PRNGKey(4))
+
+
+def _loader(params):
+    return lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+
+def _head(params):
+    return {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+
+
+def _run_codec(cfg, params, codec, n_layers, n_tokens=4,
+               worker_codec=None):
+    """One loopback generation; returns (tokens, wire bytes_out per decode
+    token, worker handle already shut down)."""
+    w = Worker(
+        "w", cfg,
+        Topology.from_dict({"w": {"layers": [f"model.layers.0-{n_layers - 1}"]}}),
+        _loader(params), address="127.0.0.1:0", max_seq=cfg.max_seq_len,
+        wire_codec=worker_codec,
+    )
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "w": {"host": f"127.0.0.1:{w.port}",
+              "layers": [f"model.layers.0-{n_layers - 1}"]},
+    })
+    try:
+        runners = build_runners(cfg, topo, _loader(params),
+                                wire_codec=codec)
+        g = DistributedGenerator(
+            cfg, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([5, 9, 2])
+        toks = [g.next_token(0).id]
+        out0 = obs_metrics.counter("wire.bytes_out").value
+        for i in range(1, n_tokens):
+            toks.append(g.next_token(i).id)
+        per_tok = (obs_metrics.counter("wire.bytes_out").value - out0) / (
+            n_tokens - 1
+        )
+        g.close()
+        return toks, per_tok
+    finally:
+        w.shutdown()
+
+
+def test_loopback_bf16_halves_wire_bytes_per_decode_token(big_params):
+    """Acceptance: 2-segment loopback under --wire-codec bf16 ships
+    >= 1.9x fewer wire.bytes_out per decode token than none (both request
+    and mirrored reply land in the same process-global counter here)."""
+    toks_none, per_none = _run_codec(BIG, big_params, "none", 2)
+    toks_bf16, per_bf16 = _run_codec(BIG, big_params, "bf16", 2)
+    assert len(toks_none) == len(toks_bf16) == 4
+    assert per_none / per_bf16 >= 1.9, (per_none, per_bf16)
+
+
+def test_loopback_int8_completes_and_shrinks_bytes(params):
+    """--wire-codec int8: generation completes end-to-end and the byte
+    counters shrink ~4x on the activation-dominated payload."""
+    toks_none, per_none = _run_codec(CFG, params, "none", 4, n_tokens=6)
+    toks_int8, per_int8 = _run_codec(CFG, params, "int8", 4, n_tokens=6)
+    assert len(toks_int8) == 6
+    assert all(0 <= t < CFG.vocab_size for t in toks_int8)
+    assert per_int8 < per_none
+    raw = obs_metrics.counter("wire.codec_bytes_raw").value
+    enc = obs_metrics.counter("wire.codec_bytes_encoded").value
+    assert 0 < enc < raw
+
+
+def test_loopback_none_codec_stays_bit_identical(params):
+    """The default codec must not perturb anything: loopback greedy tokens
+    equal the all-local generator's (the existing parity contract)."""
+    from cake_tpu.runtime.generator import LlamaGenerator
+
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.1)
+    toks, _ = _run_codec(CFG, params, "none", 4, n_tokens=6)
+    g = LlamaGenerator(CFG, params, settings=settings)
+    g.set_prompt([5, 9, 2])
+    assert toks == [g.next_token(i).id for i in range(6)]
+
+
+def test_handshake_rejects_unadvertised_codec(params):
+    """A worker restricted to `none` must fail the handshake of a master
+    asking for int8 — at connect time, not mid-stream."""
+    w = Worker(
+        "w", CFG,
+        Topology.from_dict({"w": {"layers": ["model.layers.0-3"]}}),
+        _loader(params), address="127.0.0.1:0", max_seq=CFG.max_seq_len,
+        wire_codec="none",
+    )
+    w.serve_in_background()
+    try:
+        with pytest.raises(RuntimeError, match="does not accept wire codec"):
+            RemoteRunner(f"127.0.0.1:{w.port}", start=0, stop=4,
+                         wire_codec="int8")
+        # the advertised set is visible on the status surface
+        assert w.status()["wire_codecs"] == ["none"]
+    finally:
+        w.shutdown()
+
+
+def test_remote_runner_rejects_unknown_codec():
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        RemoteRunner("127.0.0.1:1", start=0, stop=1, wire_codec="zstd")
+
+
+def test_worker_rejects_unknown_codec(params):
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        Worker("w", CFG,
+               Topology.from_dict({"w": {"layers": ["model.layers.0-3"]}}),
+               _loader(params), address="127.0.0.1:0", wire_codec="zstd")
+
+
+def test_worker_enforces_codec_restriction_server_side(params):
+    """A client that skips the handshake check must not smuggle a lossy
+    codec onto a none-restricted worker: the serve loop rejects the op
+    with an ERROR reply (and keeps serving `none` requests)."""
+    from cake_tpu.runtime import wire
+    from cake_tpu.runtime.protocol import MsgType
+
+    w = Worker(
+        "w", CFG,
+        Topology.from_dict({"w": {"layers": ["model.layers.0-3"]}}),
+        _loader(params), address="127.0.0.1:0", max_seq=CFG.max_seq_len,
+        wire_codec="none",
+    )
+    w.serve_in_background()
+    try:
+        conn = wire.connect("127.0.0.1", w.port)
+        conn.send(MsgType.HELLO)
+        t, _ = conn.recv()
+        assert t == MsgType.WORKER_INFO
+        x = np.zeros((1, 1, CFG.hidden_size), np.float32)
+        conn.send(MsgType.BATCH,
+                  protocol.encode_ops(x, [("model.layers.0", 0)], "int8"))
+        t, payload = conn.recv()
+        assert t == MsgType.ERROR
+        assert "not accepted" in protocol.decode_error(payload)
+        conn.send(MsgType.BATCH,
+                  protocol.encode_ops(x, [("model.layers.0", 0)], "none"))
+        t, _ = conn.recv()
+        assert t == MsgType.TENSOR
+        conn.close()
+    finally:
+        w.shutdown()
+
+
+def test_bf16_on_bf16_activation_passes_through():
+    """Already-bf16 activations ride the none layout under the bf16 codec
+    (no byte saving to be had; skips a full same-dtype copy per hop)."""
+    import ml_dtypes
+
+    x = np.random.RandomState(2).randn(1, 2, 64).astype(ml_dtypes.bfloat16)
+    enc = protocol.encode_activation(x, "bf16")
+    assert enc == protocol.encode_activation(x, "none")
+    out, codec = protocol.decode_activation(enc)
+    assert codec == "none" and out.dtype == x.dtype
+    np.testing.assert_array_equal(out, x)
