@@ -74,11 +74,20 @@ ttft:
 trace-smoke:
 	$(PY) -m pytest tests/test_obs.py -q -k smoke
 
+# cluster observability smoke: 2-worker CPU loopback asserting the merged
+# trace stitches spans from >= 3 pids (master + both workers, clock-
+# rebased), and the cluster report names every worker with forward
+# p50/p99, RTT, clock offset, and the straggler flag on the slowed one.
+cluster-trace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_zcluster_obs.py -q \
+	  -k smoke
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
-# from the bench ledger path.
-perf-smoke:
+# from the bench ledger path. Chains the cluster smoke: the trailer and
+# ping planes ride the same hot path the codec numbers come from.
+perf-smoke: cluster-trace-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -97,4 +106,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke perf-smoke deploy clean

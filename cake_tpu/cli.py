@@ -58,9 +58,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--topology", default=None, help="topology YAML path")
     p.add_argument("--status-port", type=int, default=None,
                    dest="status_port", metavar="PORT",
-                   help="worker mode: serve a live JSON status page over "
-                        "HTTP (0 = ephemeral port) — the headless "
-                        "equivalent of the reference's worker GUI")
+                   help="serve a live status page over HTTP (0 = ephemeral "
+                        "port): worker mode exposes identity/layer/traffic "
+                        "JSON on / (the headless equivalent of the "
+                        "reference's worker GUI), master mode its own "
+                        "registry incl. the merged cluster.* series; both "
+                        "serve Prometheus text on /metrics")
+    p.add_argument("--status-bind", default="127.0.0.1", dest="status_bind",
+                   metavar="ADDR",
+                   help="interface for --status-port (default 127.0.0.1: "
+                        "the page exposes identity, layer assignment, and "
+                        "traffic counters, so it stays host-local unless "
+                        "you opt in; 0.0.0.0 serves every interface — do "
+                        "that only on a trusted network, e.g. for a remote "
+                        "master's cluster scraper or a Prometheus host)")
     p.add_argument("--prompt", default="Why is the sky blue?")
     p.add_argument("--prompt-ids", default=None, dest="prompt_ids",
                    help="comma-separated token ids (bypasses the tokenizer)")
@@ -180,6 +191,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "ms, wire bytes, serialize/sample ms, recovery "
                         "events), one per dispatch on fused-block/batched "
                         "paths (with steps/batch fields)")
+    p.add_argument("--cluster-report", default=None, dest="cluster_report",
+                   metavar="PATH",
+                   help="master+topology runs: write an end-of-run JSON "
+                        "cluster report — per-worker segment forward "
+                        "p50/p99, RTT and clock offset (ping-estimated), "
+                        "byte/op counters, straggler flags, plus the "
+                        "master's own per-segment stats")
+    p.add_argument("--top", action="store_true",
+                   help="master+topology runs: live ANSI cluster panel on "
+                        "stderr while generating (per-worker p50/p99, RTT, "
+                        "offset, straggler flags; plain escape-code "
+                        "refresh, no curses; the token stream on stdout "
+                        "stays clean)")
+    p.add_argument("--straggler-factor", type=float, default=2.0,
+                   dest="straggler_factor", metavar="F",
+                   help="flag a worker as straggler when its segment "
+                        "forward p99 exceeds the median of its peers' "
+                        "p99s by this factor (cluster report / --top / "
+                        "cluster.* gauges; default 2.0)")
     p.add_argument("--log-level", default="info", dest="log_level",
                    choices=["debug", "info", "warning", "error"],
                    help="root log level for this process (master or worker "
@@ -262,6 +292,10 @@ def run_worker(args) -> int:
         sys.exit("error: --mode worker requires --name")
     if not args.topology:
         sys.exit("error: --mode worker requires --topology")
+    if args.cluster_report or args.top:
+        sys.exit("error: --cluster-report/--top are master-side aggregation "
+                 "views; pass them to the master process (they would "
+                 "otherwise be silently ignored in worker mode)")
     config = _load_config(args)
     topology = Topology.from_path(args.topology)
 
@@ -276,7 +310,7 @@ def run_worker(args) -> int:
                     address=args.address, max_seq=args.max_seq,
                     kv_quant=args.kv_quant, wire_codec=args.wire_codec)
     if args.status_port is not None:
-        worker.start_status_server(args.status_port)
+        worker.start_status_server(args.status_port, bind=args.status_bind)
     log.info("worker ready (%s)", memory_report())
     try:
         worker.serve_forever()
@@ -315,6 +349,10 @@ def run_serve(args) -> int:
         sys.exit("error: --lookahead needs fused blocks to pipeline; it "
                  "requires --decode-block > 1 (it would otherwise be "
                  "silently ignored)")
+    if args.cluster_report or args.top:
+        sys.exit("error: --cluster-report/--top aggregate across cross-host "
+                 "workers (master/worker --topology runs); serving rides "
+                 "the mesh")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
     settings = _settings(args)
@@ -433,6 +471,13 @@ def run_master(args) -> int:
         sys.exit("error: --wire-codec applies to cross-host worker hops; "
                  "it needs a host-addressed --topology (it would otherwise "
                  "be silently ignored)")
+    if (args.cluster_report or args.top) and (use_mesh or not args.topology):
+        sys.exit("error: --cluster-report/--top aggregate across cross-host "
+                 "workers; they need a host-addressed --topology (they "
+                 "would otherwise be silently ignored)")
+    if args.straggler_factor <= 1.0:
+        sys.exit("error: --straggler-factor must exceed 1.0 (a worker at "
+                 "the median is not a straggler)")
     if args.lookahead:
         # lookahead needs the fused-block programs (all-local path here,
         # BatchGenerator on the serving path); reject combinations that
@@ -566,6 +611,38 @@ def run_master(args) -> int:
     log.info("model loaded in %.1fs (%s)", time.perf_counter() - t0,
              memory_report())
 
+    # Master-side status surface (satellite of the worker's): same handler
+    # shape, but this registry also carries the merged cluster.* series
+    # once the scraper has run — one Prometheus scrape sees the cluster.
+    status_httpd = None
+    if args.status_port is not None:
+        from cake_tpu import __version__
+        from cake_tpu.obs import metrics as obs_metrics
+        from cake_tpu.obs import statusd
+
+        def master_status():
+            st = {
+                "role": "master",
+                "version": __version__,
+                "model": str(args.model),
+                "metrics": obs_metrics.registry().snapshot(),
+            }
+            if hasattr(gen, "runner_stats"):
+                st["segments"] = gen.runner_stats()
+            return st
+
+        status_httpd, bound = statusd.start_status_server(
+            master_status, bind=args.status_bind, port=args.status_port)
+        log.info("master status page on http://%s:%d/", args.status_bind,
+                 bound)
+
+    top_view = None
+    if args.top:
+        from cake_tpu.obs.top import Top
+
+        top_view = Top(gen.cluster_scraper(args.straggler_factor))
+        top_view.start()
+
     if args.prompt_ids:
         gen.set_prompt([int(t) for t in args.prompt_ids.split(",")])
     else:
@@ -604,6 +681,8 @@ def run_master(args) -> int:
         if args.profile:
             jax.profiler.stop_trace()
             log.info("profiler trace written to %s", args.profile)
+        if top_view is not None:
+            top_view.stop()
     rest = gen.last()
     if rest:
         print(rest, end="")
@@ -618,12 +697,39 @@ def run_master(args) -> int:
                  t_warm - t_gen0, memory_report())
     if hasattr(gen, "runner_stats"):
         for s in gen.runner_stats():
+            # link fields are each optional: a legacy peer has only the
+            # handshake RTT fallback (no clock offset), a local segment
+            # neither
+            extra = "".join(
+                f", {label} {s[key]} ms"
+                for key, label in (("handshake_ms", "handshake"),
+                                   ("rtt_ms", "rtt"),
+                                   ("clock_offset_ms", "clock offset"))
+                if key in s
+            )
             log.info("segment %s @ %s: %d calls, %.2f ms avg "
                      "(p50 %.2f / p99 %.2f)%s",
                      s["layers"], s["ident"], s["calls"], s["avg_ms"],
-                     s.get("p50_ms", 0.0), s.get("p99_ms", 0.0),
-                     f", handshake {s['handshake_ms']} ms"
-                     if "handshake_ms" in s else "")
+                     s.get("p50_ms", 0.0), s.get("p99_ms", 0.0), extra)
+    if args.cluster_report:
+        # one final scrape while the worker connections are still open
+        # (the STATS path rides them); written before close() by design
+        import json as _json
+
+        try:
+            report = gen.cluster_report(args.straggler_factor)
+            with open(args.cluster_report, "w") as f:
+                _json.dump(report, f, indent=1)
+                f.write("\n")
+            log.info("cluster report written to %s", args.cluster_report)
+            for name in report.get("stragglers", []):
+                log.warning("straggler worker: %s", name)
+        except OSError as e:
+            log.error("could not write cluster report to %s: %s",
+                      args.cluster_report, e)
+    if status_httpd is not None:
+        status_httpd.shutdown()
+        status_httpd.server_close()
     if hasattr(gen, "close"):
         gen.close()
     if gen_error is not None:
@@ -647,6 +753,11 @@ def main(argv=None) -> int:
         except OSError as e:
             # fail before loading the model, not after a full run
             sys.exit(f"error: cannot open --flight-log {args.flight_log}: {e}")
+    if args.flight_log or args.metrics_out:
+        # durability: a SIGTERM/SIGINT'd run still lands the flight-log
+        # tail and a metrics snapshot (the clean-exit writes below only
+        # cover runs that reach them)
+        obs.install_flush_handlers(metrics_out=args.metrics_out)
     if args.cpu:
         import jax
 

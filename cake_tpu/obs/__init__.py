@@ -12,15 +12,26 @@ when off, threaded through every layer of the runtime:
   (per-segment ms, wire bytes, serialize/sample ms, recoveries),
   appendable to JSONL.
 
+Cluster scope (the cross-process tier on top of the three planes):
+
+- :mod:`cake_tpu.obs.clock` — per-connection clock-offset/RTT estimation
+  (ping exchange) behind cross-process trace stitching.
+- :mod:`cake_tpu.obs.cluster` — worker snapshot scraper, ``cluster.*``
+  metric merge, straggler detection (``--cluster-report``).
+- :mod:`cake_tpu.obs.top` — live ANSI cluster panel (``--top``).
+- :mod:`cake_tpu.obs.statusd` — shared ``/`` JSON + ``/metrics``
+  Prometheus HTTP surface (worker and master ``--status-port``).
+
 CLI surface: ``--trace PATH``, ``--metrics-out PATH``, ``--flight-log
-PATH``, ``--log-level``.
+PATH``, ``--log-level``, ``--cluster-report PATH``, ``--top``,
+``--status-port``/``--status-bind``.
 """
 
 from __future__ import annotations
 
 import logging
 
-from cake_tpu.obs import flight, metrics, trace  # noqa: F401
+from cake_tpu.obs import clock, flight, metrics, trace  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     counter,
     gauge,
@@ -46,3 +57,67 @@ def setup_logging(level: str | int = "info") -> None:
     if isinstance(level, str):
         level = _LEVELS.get(level.lower(), logging.INFO)
     logging.basicConfig(level=level, format=LOG_FORMAT, force=True)
+
+
+# -- artifact durability ------------------------------------------------------
+#
+# The CLI writes its observability artifacts on the clean exit path; a
+# SIGTERM'd or SIGINT'd run used to lose the batched flight-log tail and the
+# whole --metrics-out dump. These hooks make the artifacts crash-durable:
+# flush on SIGTERM/SIGINT (then chain to the previous handler so exit
+# semantics — KeyboardInterrupt, exit code 143 — are unchanged) and via
+# atexit as the backstop for sys.exit paths.
+
+_flush_state = {"metrics_out": None, "installed": False, "prev": {}}
+
+
+def flush_artifacts() -> None:
+    """Flush every enabled observability sink now (idempotent; safe from a
+    signal handler — the flight/metrics locks it takes are reentrant, so a
+    handler landing on a thread interrupted mid-record cannot deadlock)."""
+    flight.recorder().flush()
+    path = _flush_state["metrics_out"]
+    if path:
+        try:
+            registry().dump_json(path)
+        except OSError as e:
+            logging.getLogger("cake_tpu.obs").error(
+                "metrics flush to %s failed: %s", path, e)
+
+
+def _flush_handler(signum, frame):
+    try:
+        flush_artifacts()
+    except Exception:  # noqa: BLE001 — never block the signal chain
+        logging.getLogger("cake_tpu.obs").exception("artifact flush failed")
+    import os
+    import signal as _signal
+
+    prev = _flush_state["prev"].get(signum, _signal.SIG_DFL)
+    if callable(prev):
+        prev(signum, frame)
+    elif prev != _signal.SIG_IGN:
+        # re-deliver under the default disposition: the process still dies
+        # of the signal (exit code 128+n), just with its artifacts on disk
+        _signal.signal(signum, _signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+
+def install_flush_handlers(metrics_out: str | None = None) -> None:
+    """Arm SIGTERM/SIGINT + atexit artifact flushing (CLI entry; safe to
+    call again — e.g. in-process tests — to re-point ``metrics_out``)."""
+    import atexit
+    import signal as _signal
+
+    _flush_state["metrics_out"] = metrics_out
+    if _flush_state["installed"]:
+        return
+    _flush_state["installed"] = True
+    atexit.register(flush_artifacts)
+    for signum in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            prev = _signal.getsignal(signum)
+            _signal.signal(signum, _flush_handler)
+            _flush_state["prev"][signum] = prev
+        except ValueError:  # not the main thread: atexit still covers exit
+            pass

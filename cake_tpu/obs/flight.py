@@ -32,7 +32,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = 4096):
         self.enabled = False
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._ring: deque = deque(maxlen=capacity)
         self._fh = None
         self._unflushed = 0
@@ -52,6 +52,24 @@ class FlightRecorder:
 
     def disable(self) -> None:
         self.enabled = False
+
+    def flush(self) -> None:
+        """Drain the batched JSONL tail to disk (idempotent, safe from a
+        signal handler): a SIGTERM'd run must not lose its last
+        FLUSH_EVERY-1 records to the write batching."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    self._unflushed = 0
+                except (OSError, RuntimeError) as e:
+                    # RuntimeError: CPython forbids re-entering a buffered
+                    # writer — a signal can land while record() is inside
+                    # _fh.write() on this same thread. The tail stays
+                    # unflushed, but the handler must keep running (chain
+                    # to the previous handler, dump metrics).
+                    log.error("flight log flush to %s failed: %s",
+                              self.path, e)
 
     def close(self) -> None:
         with self._lock:

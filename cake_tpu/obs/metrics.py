@@ -9,7 +9,11 @@ acquire + a few float ops. The registry dumps as JSON (``--metrics-out``) and
 Prometheus-style text (the worker status page serves the JSON snapshot).
 
 Instruments are get-or-create by name, so independent modules (wire, worker,
-master) share series without import-order coupling. A disabled registry
+master) share series without import-order coupling. Instrument and registry
+locks are reentrant: the SIGTERM/SIGINT artifact flush
+(``obs.install_flush_handlers``) runs its dump on whatever thread the
+signal lands on — possibly one interrupted mid-``observe`` with the same
+lock held — and must not deadlock the dying process. A disabled registry
 (``registry().enabled = False``, or env ``CAKE_OBS_METRICS=0`` at import)
 hands out shared null instruments whose methods are no-ops — near-zero
 overhead for code that cached the handle before a sample ever lands.
@@ -44,7 +48,7 @@ class Counter:
 
     def __init__(self, name: str = ""):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._value = 0
 
     def inc(self, n: int | float = 1) -> None:
@@ -70,7 +74,7 @@ class Gauge:
 
     def __init__(self, name: str = ""):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._value = 0.0
 
     def set(self, v: float) -> None:
@@ -106,7 +110,7 @@ class Histogram:
 
     def __init__(self, name: str = "", buckets=LATENCY_MS_BUCKETS):
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)  # last = +inf
         self.count = 0
@@ -228,7 +232,7 @@ class Registry:
     """Thread-safe name -> instrument map."""
 
     def __init__(self, enabled: bool | None = None):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._instruments: dict[str, object] = {}
         if enabled is None:
             enabled = os.environ.get("CAKE_OBS_METRICS", "1") != "0"

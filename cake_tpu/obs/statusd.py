@@ -1,0 +1,54 @@
+"""Shared HTTP status surface: ``/`` JSON + ``/metrics`` Prometheus.
+
+One handler shape for every process that exposes itself over HTTP — the
+worker's ``--status-port`` page (the headless stand-in for the reference's
+worker GUI) and, since the cluster-observability pass, the master's own
+``--status-port`` (whose registry additionally carries the merged
+``cluster.*`` series). ``status_fn`` supplies the JSON body; ``/metrics``
+always serves the process-global registry in Prometheus text exposition.
+
+Binding defaults to loopback: a status page leaks identity, layer
+assignments, and traffic counters, so exposing it beyond the host is an
+explicit ``--status-bind`` decision, not a side effect of starting it.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+
+from cake_tpu.obs import metrics as _metrics
+
+log = logging.getLogger("cake_tpu.obs.statusd")
+
+
+def start_status_server(status_fn, bind: str = "127.0.0.1", port: int = 0):
+    """Serve ``status_fn()`` as JSON on ``/`` and the metrics registry as
+    Prometheus text on ``/metrics``. Returns ``(httpd, bound_port)``;
+    daemon-threaded, stopped with ``httpd.shutdown()`` +
+    ``httpd.server_close()``."""
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path.rstrip("/") == "/metrics":
+                # Prometheus text exposition of the same registry the
+                # JSON page embeds under "metrics"
+                body = _metrics.registry().to_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                body = json.dumps(status_fn(), indent=1).encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            log.debug("status: " + fmt, *args)
+
+    httpd = http.server.ThreadingHTTPServer((bind, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]

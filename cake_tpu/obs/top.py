@@ -1,0 +1,105 @@
+"""Live cluster terminal view (``--top``): plain ANSI refresh, no curses.
+
+Renders the :class:`~cake_tpu.obs.cluster.ClusterScraper` report as a
+compact fixed-width table — one row per worker with up/straggler state,
+segment forward p50/p99, RTT, clock offset, and op/byte counters — and
+repaints it in place with cursor-up escapes. Runs as a daemon thread next
+to a master generation (the panel goes to stderr so the token stream on
+stdout stays clean and pipeable), or one-shot via :func:`render` for
+tests and snapshots.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+_HDR = (f"{'WORKER':<14} {'ST':<4} {'LAYERS':<10} {'p50ms':>8} "
+        f"{'p99ms':>8} {'rtt':>7} {'offset':>8} {'ops':>8} {'MB in':>8} "
+        f"{'MB out':>8}")
+
+
+def _fmt(v, nd=2, scale=1.0) -> str:
+    if v is None:
+        return "-"
+    return f"{v / scale:.{nd}f}"
+
+
+def _runs(layer_runs) -> str:
+    if not layer_runs:
+        return "-"
+    return ",".join(f"{lo}-{hi - 1}" for lo, hi in layer_runs)
+
+
+def render(report: dict) -> str:
+    """Report dict -> multi-line panel (no trailing newline)."""
+    lines = [
+        f"cake-tpu cluster — {len(report.get('workers', {}))} worker(s), "
+        f"median fwd p99 {_fmt(report.get('median_forward_p99_ms'))} ms, "
+        f"straggler factor {report.get('straggler_factor')}",
+        _HDR,
+    ]
+    for name, w in sorted(report.get("workers", {}).items()):
+        if not w.get("up"):
+            lines.append(f"{name:<14} DOWN")
+            continue
+        state = "SLOW" if w.get("straggler") else "ok"
+        lines.append(
+            f"{name:<14} {state:<4} {_runs(w.get('layer_runs')):<10} "
+            f"{_fmt(w.get('forward_p50_ms')):>8} "
+            f"{_fmt(w.get('forward_p99_ms')):>8} "
+            f"{_fmt(w.get('rtt_ms')):>7} "
+            f"{_fmt(w.get('clock_offset_ms')):>8} "
+            f"{w.get('ops_total') if w.get('ops_total') is not None else '-':>8} "
+            f"{_fmt(w.get('bytes_in'), 1, 1e6):>8} "
+            f"{_fmt(w.get('bytes_out'), 1, 1e6):>8}"
+        )
+    if report.get("stragglers"):
+        lines.append("stragglers: " + ", ".join(report["stragglers"]))
+    return "\n".join(lines)
+
+
+class Top:
+    """Background refresher: scrape -> render -> repaint every interval."""
+
+    def __init__(self, scraper, out=None, interval_s: float = 1.0):
+        self.scraper = scraper
+        self.out = out if out is not None else sys.stderr
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_lines = 0
+
+    def _paint(self) -> None:
+        frame = render(self.scraper.scrape())
+        if self._last_lines:
+            # cursor up over the previous frame, clear to end of screen —
+            # the whole "UI"; survives any ANSI terminal, needs no curses
+            self.out.write(f"\x1b[{self._last_lines}F\x1b[J")
+        self.out.write(frame + "\n")
+        self.out.flush()
+        self._last_lines = frame.count("\n") + 1
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._paint()
+            except Exception:  # an obs view must never kill the run
+                pass
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self, final_paint: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_paint:
+            try:
+                self._paint()
+            except Exception:
+                pass

@@ -19,6 +19,8 @@ worker — behind one interface, so the generation loop is placement-blind
 from __future__ import annotations
 
 import logging
+import struct
+import threading
 import time
 from abc import ABC, abstractmethod
 from functools import partial
@@ -30,6 +32,8 @@ import numpy as np
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import trace as obs_trace
+from cake_tpu.obs.clock import ClockSync
 from cake_tpu.obs.trace import span
 from cake_tpu.ops.kvcache import KVCache, init_cache
 
@@ -118,8 +122,16 @@ class LocalRunner(BlockRunner):
 
 class RemoteRunner(BlockRunner):
     """Proxy to a worker over the wire (the reference `Client`,
-    client.rs:14-135): handshake measures latency, forward ships one Batch
-    per call for the whole segment."""
+    client.rs:14-135): handshake measures latency + clock offset (ping
+    exchange, CAP_PING), forward ships one Batch per call for the whole
+    segment — carrying a Dapper-style trace context to CAP_TRACE workers
+    when the tracer is on, and stitching the returned span digest into the
+    master's timeline."""
+
+    # ping exchange: samples at handshake, then refreshed between forwards
+    # once the estimate is older than this (clock drift over a long run)
+    CLOCK_PINGS = 5
+    CLOCK_REFRESH_S = 30.0
 
     def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000,
                  max_seq: int | None = None, wire_codec: str = "none"):
@@ -140,6 +152,18 @@ class RemoteRunner(BlockRunner):
         self._span_tag = f"{start}-{stop}"
         self._ser_hist = obs_metrics.histogram("wire.serialize_ms")
         self._de_hist = obs_metrics.histogram("wire.deserialize_ms")
+        # serializes connection use between the forward loop and the
+        # cluster scraper/top thread (fetch_stats shares the socket)
+        self._lock = threading.RLock()
+        self.clock = ClockSync()
+        self.caps: set[str] = set()
+        self._seq = 0
+        self._clock_refreshed = 0.0
+        # set by a STATS exchange that died mid-flight (scraper thread):
+        # the frame stream may carry a late reply, so the next forward
+        # must fault into the master's reconnect+replay instead of
+        # tripping on a stale STATS frame
+        self._poisoned: Exception | None = None
         self._handshake()
 
     def _handshake(self) -> None:
@@ -192,46 +216,189 @@ class RemoteRunner(BlockRunner):
                 f"worker {self.info.name}@{self.addr} does not accept wire "
                 f"codec {self.wire_codec!r} (advertises {self.info.codecs})"
             )
+        # Capability set gates every post-seed wire extension: an old peer
+        # advertises nothing and is never sent a PING/STATS frame or a
+        # trace trailer — its op stream stays byte-identical to the seed.
+        self.caps = set(self.info.caps or [])
+        if self._protocol.CAP_PING in self.caps:
+            self._sync_clock(self.CLOCK_PINGS)
+
+    # -- clock alignment -----------------------------------------------------
+    def _sync_clock(self, n: int = 3) -> None:
+        """NTP-style ping exchange (obs.clock): n samples, min-RTT wins.
+        Caller must hold the connection (handshake or the forward lock)."""
+        for _ in range(n):
+            t0 = time.perf_counter()
+            self.conn.send(self._MsgType.PING, struct.pack("<d", t0))
+            t, payload = self.conn.recv()
+            t1 = self.conn.last_recv_t or time.perf_counter()
+            if t != self._MsgType.PING or len(payload) < 16:
+                raise self._wire.WireError(
+                    f"bad ping reply from {self.addr}: type {t}"
+                )
+            echo, tw = struct.unpack_from("<dd", payload)
+            self.clock.add(echo, tw, t1)
+        self._clock_refreshed = time.monotonic()
+
+    def _maybe_refresh_clock(self) -> None:
+        if (
+            self._protocol.CAP_PING in self.caps
+            and time.monotonic() - self._clock_refreshed > self.CLOCK_REFRESH_S
+        ):
+            try:
+                self._sync_clock(3)
+            except self._wire.WireError:
+                raise
+            except Exception as e:
+                # A partial ping exchange poisons the stream: the PING went
+                # out, so a late reply frame is (or will be) sitting where
+                # the next forward() expects its TENSOR. Surface a wire
+                # fault NOW so the master's reconnect+replay recovery runs
+                # deliberately, instead of the next decode step tripping
+                # over a stale PING frame mid-call.
+                raise self._wire.WireError(
+                    f"clock refresh to {self.addr} failed mid-exchange: {e}"
+                ) from e
 
     def forward(self, x: np.ndarray, pos: int) -> np.ndarray:
         x = np.asarray(x)
         ops = [(name, pos) for name in self.layer_names()]
-        with span("segment.remote_rtt", addr=self.addr,
-                  layers=self._span_tag):
-            t0 = time.perf_counter()
-            # buffer sequence straight into the gather-write transport: the
-            # activation payload is never copied into a contiguous frame
-            req = self._protocol.encode_ops_parts(x, ops, self.wire_codec)
-            req_len = sum(len(p) for p in req)
-            t_ser = time.perf_counter() - t0
-            with span("wire.send", bytes=req_len):
-                self.conn.send(self._MsgType.BATCH, req)
-            with span("wire.recv"):
-                t, payload = self.conn.recv()
-            if t == self._MsgType.ERROR:
-                raise self._protocol.WorkerOpError(
-                    f"worker {self.addr}: "
-                    f"{self._protocol.decode_error(payload)}"
-                )
-            if t != self._MsgType.TENSOR:
-                # protocol desync is a transport-level fault: classify as a
-                # wire error so the master's reconnect+replay recovery applies
-                raise self._wire.WireError(f"unexpected reply type {t}")
-            t0 = time.perf_counter()
-            out, _ = self._protocol.decode_activation(payload)
-            t_de = time.perf_counter() - t0
+        tr = obs_trace.tracer()
+        t_w0 = time.perf_counter()
+        with self._lock:
+            # Waiting here means the cluster scraper held the connection
+            # for a STATS round trip; report the wait via last_call so the
+            # master keeps scraper contention out of the per-segment
+            # histogram the straggler signal reads.
+            lock_wait_ms = (time.perf_counter() - t_w0) * 1e3
+            if self._poisoned is not None:
+                e, self._poisoned = self._poisoned, None
+                raise self._wire.WireError(
+                    f"frame stream to {self.addr} poisoned by a failed "
+                    f"stats exchange: {e}"
+                ) from e
+            # Refresh before opening the RTT span, and report the time it
+            # took via last_call: the periodic 3-ping exchange otherwise
+            # lands inside the master's per-segment timing every 30s and
+            # smears the worker's apparent tail latency (the straggler
+            # signal is built on that histogram's p99).
+            t_r0 = time.perf_counter()
+            self._maybe_refresh_clock()
+            refresh_ms = (time.perf_counter() - t_r0) * 1e3
+            with span("segment.remote_rtt", addr=self.addr,
+                      layers=self._span_tag):
+                tc = None
+                if tr.enabled and self._protocol.CAP_TRACE in self.caps:
+                    # Dapper-style propagation: the worker's handler spans
+                    # join this trace under the span we are inside right now
+                    self._seq += 1
+                    tc = {"tid": tr.trace_id,
+                          "psid": obs_trace.current_span_id(),
+                          "seq": self._seq, "pos": int(pos)}
+                t0 = time.perf_counter()
+                # buffer sequence straight into the gather-write transport:
+                # the activation payload is never copied into a contiguous
+                # frame
+                req = self._protocol.encode_ops_parts(
+                    x, ops, self.wire_codec, trace_ctx=tc)
+                req_len = sum(len(p) for p in req)
+                t_ser = time.perf_counter() - t0
+                t_send0 = time.perf_counter()
+                with span("wire.send", bytes=req_len):
+                    self.conn.send(self._MsgType.BATCH, req)
+                with span("wire.recv"):
+                    t, payload = self.conn.recv()
+                t_recv1 = self.conn.last_recv_t or time.perf_counter()
+                if t == self._MsgType.ERROR:
+                    raise self._protocol.WorkerOpError(
+                        f"worker {self.addr}: "
+                        f"{self._protocol.decode_error(payload)}"
+                    )
+                if t != self._MsgType.TENSOR:
+                    # protocol desync is a transport-level fault: classify
+                    # as a wire error so the master's reconnect+replay
+                    # recovery applies
+                    raise self._wire.WireError(f"unexpected reply type {t}")
+                t0 = time.perf_counter()
+                act, trailer = self._protocol.split_activation(payload)
+                out, _ = self._protocol.decode_activation(act)
+                t_de = time.perf_counter() - t0
+        if tc is not None and trailer:
+            self._stitch_digest(trailer.get("digest"), tc, t_send0, t_recv1)
         # per-call accounting: payload-level bytes, so the master's flight
         # totals line up with the worker's own bytes_in/bytes_out counters.
         # raw_bytes is the pre-codec activation size both ways — the flight
         # record's view of what the wire codec saved this call.
+        # clock_refresh_ms lets the master keep the refresh out of its
+        # per-segment steady-state histogram.
         self.last_call = {
             "wire_bytes_out": req_len, "wire_bytes_in": len(payload),
             "wire_bytes_raw": int(x.nbytes + out.nbytes),
             "serialize_ms": t_ser * 1e3, "deserialize_ms": t_de * 1e3,
+            "clock_refresh_ms": refresh_ms, "lock_wait_ms": lock_wait_ms,
         }
         self._ser_hist.observe(t_ser * 1e3)
         self._de_hist.observe(t_de * 1e3)
         return out
+
+    def _stitch_digest(self, digest: dict | None, tc: dict,
+                       t_send0: float, t_recv1: float) -> None:
+        """Inline the worker's reply span digest into this process's trace:
+        rebase worker perf_counter stamps onto the master timebase via the
+        ping-estimated offset, then clamp the whole digest into this call's
+        own send->recv window (Jaeger-style skew adjustment — the residual
+        offset error is bounded by half the ping RTT asymmetry, and
+        causality says the worker's handling happened inside the window, so
+        any overhang is estimation error, not information)."""
+        if not digest or not digest.get("spans"):
+            return
+        spans = digest["spans"]
+        rebased = [(n, self.clock.to_master(ts), d) for n, ts, d in spans]
+        t_lo = min(ts for _, ts, _ in rebased)
+        t_hi = max(ts + d for _, ts, d in rebased)
+        shift = 0.0
+        if t_hi + shift > t_recv1:
+            shift = t_recv1 - t_hi
+        if t_lo + shift < t_send0:
+            # start wins when the window is tighter than the digest (can
+            # only happen on estimator failure): keep causal order visible
+            shift = t_send0 - t_lo
+        tr = obs_trace.tracer()
+        source = f"{digest.get('name', '?')}@{self.addr}"
+        args = {"trace_id": tc["tid"], "parent_span_id": tc["psid"],
+                "seq": tc["seq"], "pos": tc["pos"]}
+        if abs(shift) > 0:
+            args["skew_adjust_us"] = round(shift * 1e6, 1)
+        for name, ts, dur in rebased:
+            tr.record_remote(source, name, ts + shift, dur, args)
+
+    def fetch_stats(self) -> dict | None:
+        """Worker status/registry snapshot over the op connection
+        (MsgType.STATS; CAP_STATS workers only — returns None for an old
+        peer). Serialized against forward() by the connection lock, so the
+        cluster scraper can run next to a live decode. An exchange that
+        dies mid-flight poisons the frame stream (a late STATS reply would
+        surface where the next forward expects its TENSOR), so it flags
+        the connection: the next forward raises a wire fault and the
+        master's reconnect+replay recovery runs deliberately."""
+        import json
+
+        if self._protocol.CAP_STATS not in self.caps:
+            return None
+        with self._lock:
+            try:
+                self.conn.send(self._MsgType.STATS)
+                t, payload = self.conn.recv()
+            except Exception as e:
+                self._poisoned = e
+                raise self._wire.WireError(
+                    f"stats fetch from {self.addr} failed mid-exchange: {e}"
+                ) from e
+            if t != self._MsgType.STATS:
+                e = self._wire.WireError(f"unexpected STATS reply type {t}")
+                self._poisoned = e
+                raise e
+        return json.loads(payload.decode())
 
     def ident(self) -> str:
         return self.addr
@@ -239,12 +406,20 @@ class RemoteRunner(BlockRunner):
     def reset(self) -> None:
         # Reference semantics: a fresh connection gets a fresh cache clone
         # (worker.rs:52-61). Reconnecting is the reset.
-        self.close()
-        self._handshake()
+        with self._lock:
+            self.close()
+            self._poisoned = None  # a fresh frame stream is clean
+            # a restarted worker process has a new perf_counter epoch:
+            # samples estimated against the old one would poison the
+            # min-RTT pick with an offset that is wrong by the whole
+            # inter-epoch delta
+            self.clock = ClockSync()
+            self._handshake()
 
     def close(self) -> None:
-        try:
-            self.conn.send(self._MsgType.GOODBYE)
-        except Exception:
-            pass
-        self.conn.close()
+        with self._lock:
+            try:
+                self.conn.send(self._MsgType.GOODBYE)
+            except Exception:
+                pass
+            self.conn.close()

@@ -129,6 +129,7 @@ class DistributedGenerator(GeneratorBase):
         self._last_seg_ms: list[float] = []  # per-segment ms of the last walk
         self._last_sample_ms = 0.0
         self.recoveries = 0  # successful mid-stream reconnect+replay count
+        self._scraper = None  # lazy ClusterScraper (cluster_scraper())
         self._consec_recoveries = 0  # capped so a dead link can't loop forever
         self._timing_paused = False  # replay forwards are not decode samples
 
@@ -165,12 +166,20 @@ class DistributedGenerator(GeneratorBase):
                 x = runner.forward_jax(x, pos)
             dt = time.perf_counter() - t0
             self._last_seg_ms.append(dt * 1e3)
+            # the periodic clock refresh (3 ping RTTs every 30s) and any
+            # wait on the scraper's STATS round trip ride inside the
+            # forward call; keep both out of the steady-state histogram so
+            # the segment p99 measures the worker, not the estimator or
+            # --top. The flight record keeps the full wall time.
+            seg_ms = dt * 1e3 - runner.last_call.get(
+                "clock_refresh_ms", 0.0) - runner.last_call.get(
+                "lock_wait_ms", 0.0)
             if self._timing_paused:
                 pass  # recovery replay: prefill-sized, not steady-state
             elif self._seg_warm[i].value == 0.0:
-                self._seg_warm[i].set(dt * 1e3)
+                self._seg_warm[i].set(seg_ms)
             else:
-                self._seg_hist[i].observe(dt * 1e3)
+                self._seg_hist[i].observe(seg_ms)
         x_last = jnp.asarray(x[:, last_index, :])
         return self._head_fn(x_last)[0]
 
@@ -292,7 +301,11 @@ class DistributedGenerator(GeneratorBase):
         """Per-segment steady-state decode latency percentiles from the
         registry histograms (warm-up call reported separately). Remote
         entries include the handshake RTT recorded at connect time
-        (client.rs:72-86 shows the same in the reference's WorkerInfo)."""
+        (client.rs:72-86 shows the same in the reference's WorkerInfo) and,
+        for capability-advertising workers, the ping-estimated link RTT and
+        clock offset (obs.clock) behind the merged trace."""
+        from cake_tpu.obs.cluster import runner_link
+
         stats = []
         for i, r in enumerate(self.runners):
             h = self._seg_hist[i]
@@ -308,8 +321,55 @@ class DistributedGenerator(GeneratorBase):
             info = getattr(r, "info", None)
             if info is not None and getattr(info, "latency_ms", None):
                 entry["handshake_ms"] = round(info.latency_ms, 2)
+            # same rtt/offset definition as the cluster report (ping
+            # estimate, handshake-RTT fallback) — one source of truth
+            entry.update({k: v for k, v in runner_link(r).items()
+                          if v is not None})
             stats.append(entry)
         return stats
+
+    # -- cluster view --------------------------------------------------------
+    def cluster_scraper(self, straggler_factor: float | None = None):
+        """The ClusterScraper over this plan's remote segments: a
+        WireSource per CAP_STATS worker (in-band, works without any worker
+        status port); a worker without the capability but advertising a
+        ``status_port`` in its handshake is scraped over HTTP at its
+        connection host instead. Cached so ``--top`` and
+        ``--cluster-report`` aggregate into the same ``cluster.*``
+        series."""
+        from cake_tpu.obs import cluster as obs_cluster
+        from cake_tpu.runtime import protocol
+
+        if getattr(self, "_scraper", None) is None:
+            sources = []
+            for r in self.runners:
+                if not isinstance(r, RemoteRunner):
+                    continue
+                if protocol.CAP_STATS in r.caps:
+                    sources.append(obs_cluster.WireSource(r))
+                elif getattr(r.info, "status_port", 0):
+                    # mixed-version/third-party peer: advertises a status
+                    # page but not the in-band STATS dialect. Reachability
+                    # is the operator's call — the page binds loopback
+                    # unless the worker ran with --status-bind opened up.
+                    host = r.addr.rsplit(":", 1)[0]
+                    sources.append(obs_cluster.HttpSource(
+                        f"http://{host}:{r.info.status_port}/",
+                        name=r.info.name, runner=r))
+            self._scraper = obs_cluster.ClusterScraper(
+                sources,
+                straggler_factor or obs_cluster.DEFAULT_STRAGGLER_FACTOR,
+            )
+        return self._scraper
+
+    def cluster_report(self, straggler_factor: float | None = None) -> dict:
+        """One aggregation pass over every remote worker plus this
+        master's own per-segment view — the ``--cluster-report`` artifact."""
+        report = self.cluster_scraper(straggler_factor).scrape()
+        report["segments"] = self.runner_stats()
+        report["tokens_per_sec"] = self.tokens_per_sec()
+        report["recoveries"] = self.recoveries
+        return report
 
     def close(self) -> None:
         # The per-segment series stay registered after close: the CLI's

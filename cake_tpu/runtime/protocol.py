@@ -39,6 +39,20 @@ class MsgType(IntEnum):
     TENSOR = 5
     ERROR = 6
     GOODBYE = 7
+    # Cluster-observability plane (capability-gated: the master only sends
+    # these to a worker whose WorkerInfo.caps advertised them; an old
+    # worker never sees them and an old master never sends them).
+    PING = 8  # clock-offset probe: echo payload + worker perf_counter
+    STATS = 9  # registry/status snapshot for workers without a status port
+
+
+# WorkerInfo.caps entries — what this peer's wire dialect understands
+# beyond the seed protocol. Old peers (no field in the handshake JSON)
+# default to none of them, so every extension stays opt-in per connection.
+CAP_TRACE = "trace"  # OPS trace-context trailer + span-digest replies
+CAP_PING = "ping"  # MsgType.PING clock exchange
+CAP_STATS = "stats"  # MsgType.STATS snapshot requests
+ALL_CAPS = (CAP_TRACE, CAP_PING, CAP_STATS)
 
 
 # dtype codes (u8). bf16 rides as raw uint16 payloads with its own code.
@@ -228,6 +242,49 @@ def decode_activation(buf) -> tuple[np.ndarray, str]:
     raise ValueError(f"unknown activation codec marker 0x{mark:02x}")
 
 
+def _tensor_nbytes(buf) -> int:
+    """Encoded length of the plain tensor layout at the head of ``buf``."""
+    code, ndim = struct.unpack_from("<BB", buf, 0)
+    if code not in _CODE_TO_NAME:
+        raise ValueError(f"unknown dtype code {code}")
+    dims = struct.unpack_from(f"<{ndim}I", buf, 2)
+    n = int(np.prod(dims)) if ndim else 1
+    return 2 + 4 * ndim + n * _np_dtype(_CODE_TO_NAME[code]).itemsize
+
+
+def activation_nbytes(buf) -> int:
+    """Byte length of the self-describing activation encoding at the head
+    of ``buf`` — exactly what :func:`decode_activation` would consume. The
+    seam that lets a frame carry an optional trailer AFTER the tensor
+    (trace context on requests, span digests on replies) while the tensor
+    layouts themselves stay byte-identical to pre-trailer peers."""
+    buf = memoryview(buf)
+    mark = buf[0]
+    if mark < 0x80:
+        return _tensor_nbytes(buf)
+    if mark == _BF16_MARK:
+        return 2 + _tensor_nbytes(buf[2:])
+    if mark == _INT8_MARK:
+        _, ndim = struct.unpack_from("<BB", buf, 1)
+        dims = struct.unpack_from(f"<{ndim}I", buf, 3)
+        n_rows = int(np.prod(dims[:-1])) if ndim else 1
+        last = dims[-1] if ndim else 1
+        return 3 + 4 * ndim + 4 * n_rows + n_rows * last
+    raise ValueError(f"unknown activation codec marker 0x{mark:02x}")
+
+
+def split_activation(buf) -> tuple[memoryview, dict | None]:
+    """Split an activation payload into (tensor bytes, trailer dict). The
+    trailer is whatever JSON follows the self-describing tensor encoding;
+    a legacy frame has no leftover and yields ``None`` — the decode side
+    needs no capability flag to stay compatible both directions."""
+    buf = memoryview(buf)
+    alen = activation_nbytes(buf)
+    if len(buf) > alen:
+        return buf[:alen], json.loads(bytes(buf[alen:]).decode())
+    return buf, None
+
+
 @dataclasses.dataclass
 class WorkerInfo:
     """Capability/identity exchange (proto/message.rs:37-53): version, os,
@@ -254,6 +311,14 @@ class WorkerInfo:
     # handshake payload lacks the field — is never credited with
     # compression support it does not have.
     codecs: list[str] = dataclasses.field(default_factory=lambda: ["none"])
+    # Wire-dialect extensions (CAP_*). Same old-peer rule as codecs: the
+    # default is the empty set, so a peer is only ever sent PING/STATS or
+    # trace trailers after it explicitly advertised them.
+    caps: list[str] = dataclasses.field(default_factory=list)
+    # Port of this worker's live status HTTP page (0 = none running). The
+    # master's cluster scraper reaches it at the worker's connection host —
+    # the fallback scrape path for a peer without CAP_STATS.
+    status_port: int = 0
 
     def to_bytes(self) -> bytes:
         return json.dumps(dataclasses.asdict(self)).encode()
@@ -272,32 +337,54 @@ class WorkerInfo:
         )
 
 
-def encode_ops_parts(x, ops: list[tuple[str, int]],
-                     codec: str = "none") -> list:
+def encode_ops_parts(x, ops: list[tuple[str, int]], codec: str = "none",
+                     trace_ctx: dict | None = None) -> list:
     """Batch payload as a buffer sequence: JSON op list (layer_name,
-    index_pos) + codec-encoded activation tensor.
+    index_pos) + codec-encoded activation tensor, plus an optional trace
+    trailer.
 
     The reference `Batch` carries ``Vec<(layer_name, index_pos, block_idx)>``
     (message.rs:57-76); block_idx is recoverable from layer_name so the wire
-    format carries just (name, pos)."""
+    format carries just (name, pos).
+
+    ``trace_ctx`` is the Dapper-style propagation record — ``{"tid":
+    trace_id, "psid": parent_span_id, "seq": n, "pos": p}`` — appended as a
+    JSON trailer after the self-describing tensor (CAP_TRACE peers only;
+    with ``trace_ctx=None`` the frame is byte-identical to the legacy
+    layout)."""
     meta = json.dumps(ops).encode()
-    return [struct.pack("<I", len(meta)) + meta] + encode_activation_parts(
+    parts = [struct.pack("<I", len(meta)) + meta] + encode_activation_parts(
         x, codec
     )
+    if trace_ctx is not None:
+        parts.append(json.dumps({"tc": trace_ctx}).encode())
+    return parts
 
 
 def encode_ops(x: np.ndarray, ops: list[tuple[str, int]],
-               codec: str = "none") -> bytes:
-    return b"".join(encode_ops_parts(x, ops, codec))
+               codec: str = "none", trace_ctx: dict | None = None) -> bytes:
+    return b"".join(encode_ops_parts(x, ops, codec, trace_ctx))
 
 
-def decode_ops(buf) -> tuple[np.ndarray, list[tuple[str, int]], str]:
-    """Inverse of :func:`encode_ops`; the returned codec name is what the
-    request's tensor rode in (the worker mirrors it in the reply)."""
+def decode_ops_traced(
+    buf,
+) -> tuple[np.ndarray, list[tuple[str, int]], str, dict | None]:
+    """Inverse of :func:`encode_ops`, trailer included: returns
+    ``(tensor, ops, codec, trailer)`` where the trailer is the parsed
+    trace-context dict (``None`` on a legacy frame) and the codec name is
+    what the request's tensor rode in (the worker mirrors it in the
+    reply)."""
     buf = memoryview(buf)
     (mlen,) = struct.unpack_from("<I", buf, 0)
     ops = [tuple(o) for o in json.loads(bytes(buf[4 : 4 + mlen]).decode())]
-    x, codec = decode_activation(buf[4 + mlen :])
+    act, trailer = split_activation(buf[4 + mlen :])
+    x, codec = decode_activation(act)
+    return x, ops, codec, trailer
+
+
+def decode_ops(buf) -> tuple[np.ndarray, list[tuple[str, int]], str]:
+    """Trailer-blind :func:`decode_ops_traced` (the seed-era signature)."""
+    x, ops, codec, _ = decode_ops_traced(buf)
     return x, ops, codec
 
 

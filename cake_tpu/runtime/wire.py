@@ -18,6 +18,7 @@ import socket
 import struct
 import subprocess
 import threading
+import time
 import zlib
 from pathlib import Path
 
@@ -150,6 +151,10 @@ class Connection:
         self._fd = fd
         self._sock = sock
         self._lib = native_lib() if fd is not None else None
+        # perf_counter stamped as each frame lands — the clock-offset
+        # estimator's t1 (reading it inside recv() keeps Python-side
+        # dispatch jitter out of the RTT the offset error is bounded by)
+        self.last_recv_t = 0.0
 
     @property
     def is_native(self) -> bool:
@@ -216,6 +221,7 @@ class Connection:
             rc = self._lib.cw_recv_msg(self._fd, ctypes.byref(out), ctypes.byref(ln))
             if rc < 0:
                 _raise(rc)
+            self.last_recv_t = time.perf_counter()
             try:
                 data = ctypes.string_at(out, ln.value) if ln.value else b""
             finally:
@@ -233,6 +239,7 @@ class Connection:
                 _raise(-7)
             payload = self._read_exact(plen) if plen else b""
             (want_crc,) = struct.unpack("<I", self._read_exact(4))
+            self.last_recv_t = time.perf_counter()
             crc = zlib.crc32(bytes([msg_type]))
             crc = zlib.crc32(payload, crc)
             if crc != want_crc:

@@ -21,7 +21,9 @@ TPU-native differences:
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
+import struct
 import threading
 import time
 
@@ -31,7 +33,7 @@ import numpy as np
 
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.obs import metrics as obs_metrics
-from cake_tpu.obs.trace import span
+from cake_tpu.obs.trace import span, tracer
 from cake_tpu.ops.kvcache import KVCache, init_cache
 from cake_tpu.parallel.topology import Topology
 from cake_tpu.runtime import protocol, wire
@@ -118,16 +120,29 @@ class Worker:
         self._conns_total = 0
         self._started = time.time()
         self._status_httpd = None
+        self._status_port = 0  # bound status-page port, advertised in _info()
         # Serving counters as per-instance obs instruments (the
         # Registry.publish pattern) — the single source of truth for both
         # status() and the registry dumps.
         self._ops_ctr = obs_metrics.Counter("worker.ops")
         self._bytes_in_ctr = obs_metrics.Counter("worker.bytes_in")
         self._bytes_out_ctr = obs_metrics.Counter("worker.bytes_out")
+        # steady-state forward times only; each connection's first op
+        # (prefill + possible XLA compile) lands in the warmup gauge — the
+        # master's warmup/steady split, worker-side, so the cluster
+        # straggler check compares decode behavior, not compile luck
         self._fwd_hist = obs_metrics.Histogram("worker.forward_ms")
+        self._warm_gauge = obs_metrics.Gauge("worker.warmup_ms")
+        self._prefill_hist = obs_metrics.Histogram("worker.prefill_ms")
+        # Shapes whose XLA compile this PROCESS has already paid. Warmup
+        # detection must share the compile cache's scope (jit caches per
+        # process, not per connection): after a master reconnect the first
+        # op of a shape on the NEW connection is a fast steady-state call
+        # and belongs in the histogram, not the warmup gauge.
+        self._warmed_shapes: set = set()
         obs_metrics.registry().publish(
             self._ops_ctr, self._bytes_in_ctr, self._bytes_out_ctr,
-            self._fwd_hist)
+            self._fwd_hist, self._warm_gauge, self._prefill_hist)
 
     # -- serving ------------------------------------------------------------
     def serve_forever(self) -> None:
@@ -155,14 +170,16 @@ class Worker:
         return th
 
     # -- status surface ------------------------------------------------------
-    def status(self) -> dict:
+    def status(self, include_metrics: bool = True) -> dict:
         """Live worker state as a plain dict: identity (the WorkerInfo
-        handshake fields), assigned layer runs, and serving counters."""
+        handshake fields), assigned layer runs, and serving counters.
+        ``include_metrics=False`` skips the full registry snapshot — the
+        in-band STATS reply wants the cheap top-level fields only."""
         from cake_tpu.utils.memory import rss_bytes
 
         info = self._info()
         with self._stat_lock:
-            return {
+            st = {
                 "name": info.name,
                 "version": info.version,
                 "os": info.os,
@@ -172,6 +189,7 @@ class Worker:
                 "dtype": info.dtype,
                 "kv_quant": self.kv_quant,
                 "wire_codecs": list(self.codecs),
+                "wire_caps": info.caps,
                 "max_seq": self.max_seq,
                 "port": self.port,
                 "layer_runs": [list(r) for r in self.runs],
@@ -181,53 +199,41 @@ class Worker:
                 "ops_total": self._ops_ctr.value,
                 "bytes_in": self._bytes_in_ctr.value,
                 "bytes_out": self._bytes_out_ctr.value,
+                # THIS worker's segment forward-time distribution, from the
+                # instance-owned histogram (the registry series of the same
+                # name is last-publisher-wins when several Workers share a
+                # process; the cluster scraper's per-worker p50/p99 must
+                # not be)
+                "forward_ms": self._fwd_hist.snapshot(),
+                "prefill_ms": self._prefill_hist.snapshot(),
+                "warmup_ms": self._warm_gauge.value,
                 "rss_bytes": rss_bytes(),
+            }
+            if include_metrics:
                 # full registry snapshot: wire frame/byte/CRC counters and
                 # layer forward-time histograms with p50/p99, one page
-                "metrics": obs_metrics.registry().snapshot(),
-            }
+                st["metrics"] = obs_metrics.registry().snapshot()
+            return st
 
-    def start_status_server(self, port: int = 0) -> int:
+    def start_status_server(self, port: int = 0,
+                            bind: str | None = None) -> int:
         """Serve ``status()`` as JSON over HTTP on ``port`` (0 = ephemeral;
         returns the bound port). The headless-deployment equivalent of the
         reference's worker GUI (`cake-ios-worker-app/Cake
         Worker/ContentView.swift:28-56` renders name/device/layers/state;
-        here ``curl :port/`` or a browser does). Binds the same host the
-        worker's ``--address`` chose — a loopback-only worker must not
-        leak its status on every interface. Daemon-threaded; stopped by
-        :meth:`shutdown`."""
-        import http.server
-        import json as _json
+        here ``curl :port/`` or a browser does). ``bind`` defaults to
+        loopback (CLI ``--status-bind``): the page leaks identity, layer
+        assignments, and traffic counters, so exposure beyond the host is
+        an explicit choice, independent of the serving ``--address``.
+        Daemon-threaded; stopped by :meth:`shutdown`."""
+        from cake_tpu.obs import statusd
 
-        worker = self
-
-        class Handler(http.server.BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib casing)
-                if self.path.rstrip("/") == "/metrics":
-                    # Prometheus text exposition of the same registry the
-                    # JSON page embeds under "metrics"
-                    body = obs_metrics.registry().to_prometheus().encode()
-                    ctype = "text/plain; version=0.0.4"
-                else:
-                    body = _json.dumps(worker.status(), indent=1).encode()
-                    ctype = "application/json"
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def log_message(self, fmt, *args):
-                log.debug("status: " + fmt, *args)
-
-        self._status_httpd = http.server.ThreadingHTTPServer(
-            (self._bind_host, port), Handler)
-        th = threading.Thread(target=self._status_httpd.serve_forever,
-                              daemon=True)
-        th.start()
-        bound = self._status_httpd.server_address[1]
+        bind = bind if bind is not None else "127.0.0.1"
+        self._status_httpd, bound = statusd.start_status_server(
+            self.status, bind=bind, port=port)
+        self._status_port = bound
         log.info("worker %s status page on http://%s:%d/", self.name,
-                 self._bind_host, bound)
+                 bind, bound)
         return bound
 
     def shutdown(self) -> None:
@@ -236,6 +242,7 @@ class Worker:
             self._status_httpd.shutdown()
             self._status_httpd.server_close()
             self._status_httpd = None
+            self._status_port = 0
         # A blocked accept() does not return when the fd is closed from
         # another thread on Linux; wake it with a throwaway connection.
         try:
@@ -254,6 +261,8 @@ class Worker:
             dtype=self.config.dtype,
             max_seq=self.max_seq,
             codecs=list(self.codecs),
+            caps=list(protocol.ALL_CAPS),
+            status_port=self._status_port,
             layers=[
                 f"model.layers.{i}"
                 for lo, hi in self.runs
@@ -264,14 +273,10 @@ class Worker:
     def _handle_connection(self, conn: wire.Connection) -> None:
         """One master connection: Hello -> WorkerInfo, then op loop with a
         per-connection fresh cache (worker.rs:149-258)."""
-        # fresh per-connection caches: isolation over synchronization
-        caches = {
-            (lo, hi): init_cache(
-                self.config, batch=1, max_seq=self.max_seq,
-                num_layers=hi - lo, quant=self.kv_quant,
-            )
-            for lo, hi in self.runs
-        }
+        # fresh per-connection caches: isolation over synchronization.
+        # Allocated lazily on the first op — a PING/STATS-only connection
+        # (the cluster scraper, a health probe) must not pin cache HBM.
+        caches: dict[tuple[int, int], KVCache] | None = None
         ops_done = 0
         t_window = time.perf_counter()
         bytes_in = bytes_out = 0
@@ -291,6 +296,26 @@ class Worker:
                     return
                 if t == MsgType.GOODBYE:
                     return
+                if t == MsgType.PING:
+                    # clock probe (CAP_PING): echo the master's opaque
+                    # timestamp back with this process's perf_counter so
+                    # the master can estimate the inter-clock offset
+                    conn.send(MsgType.PING, [
+                        memoryview(payload),
+                        struct.pack("<d", time.perf_counter()),
+                    ])
+                    continue
+                if t == MsgType.STATS:
+                    # status snapshot over the op connection (CAP_STATS) —
+                    # the scrape path for workers that never opened a
+                    # --status-port. The full registry snapshot stays on
+                    # the HTTP page: the scraper reads only the top-level
+                    # fields, and this reply is serialized against live
+                    # forwards by the master's connection lock, so every
+                    # byte here is decode stall.
+                    conn.send(MsgType.STATS, json.dumps(
+                        self.status(include_metrics=False)).encode())
+                    continue
                 if t not in (MsgType.SINGLE_OP, MsgType.BATCH):
                     conn.send(
                         MsgType.ERROR,
@@ -298,8 +323,11 @@ class Worker:
                     )
                     continue
                 bytes_in += len(payload)
+                t_handle0 = time.perf_counter()
                 try:
-                    x, ops, codec = protocol.decode_ops(payload)
+                    x, ops, codec, trailer = protocol.decode_ops_traced(
+                        payload)
+                    t_dec1 = time.perf_counter()
                     if codec not in self.codecs:
                         # enforce the advertised restriction server-side: a
                         # client that skipped the handshake check must not
@@ -309,10 +337,42 @@ class Worker:
                             f"wire codec '{codec}' not accepted by this "
                             f"worker (offers {self.codecs})"
                         )
+                    if caches is None:
+                        caches = {
+                            (lo, hi): init_cache(
+                                self.config, batch=1, max_seq=self.max_seq,
+                                num_layers=hi - lo, quant=self.kv_quant,
+                            )
+                            for lo, hi in self.runs
+                        }
                     t0 = time.perf_counter()
                     with span("worker.forward", ops=len(ops)):
                         out = self._run_ops(x, ops, caches)
-                    self._fwd_hist.observe((time.perf_counter() - t0) * 1e3)
+                    t_fwd1 = time.perf_counter()
+                    # XLA compiles per activation shape; the process-wide
+                    # first op of each shape (prefill [1,T,H], then the
+                    # first [1,1,H] decode) pays it. Those land in the
+                    # warmup gauge so the histogram — and the cluster
+                    # straggler check built on its p99 — holds steady-state
+                    # decode behavior only, mirroring the master's
+                    # warmup/steady split.
+                    shape = tuple(np.shape(x))
+                    with self._stat_lock:
+                        warmed = shape in self._warmed_shapes
+                        self._warmed_shapes.add(shape)
+                    fwd_ms = (t_fwd1 - t0) * 1e3
+                    if not warmed:
+                        self._warm_gauge.set(fwd_ms)
+                    elif len(shape) >= 2 and shape[1] > 1:
+                        # warmed multi-token forward: a fresh prompt's
+                        # prefill or the master's recovery replay. Real
+                        # work, but ~100x a decode step — it mirrors the
+                        # master's _timing_paused/_seg_warm exclusions
+                        # into its own series so forward_ms (and the
+                        # straggler p99 built on it) stays decode-only.
+                        self._prefill_hist.observe(fwd_ms)
+                    else:
+                        self._fwd_hist.observe(fwd_ms)
                 except Exception as e:  # report, keep serving
                     log.exception("op failed")
                     conn.send(MsgType.ERROR, protocol.encode_error(str(e)))
@@ -320,6 +380,33 @@ class Worker:
                 # the reply mirrors the request's codec (master chose it at
                 # handshake against this worker's advertised set)
                 reply = protocol.encode_activation_parts(out, codec)
+                t_enc1 = time.perf_counter()
+                tc = (trailer or {}).get("tc")
+                if tc is not None:
+                    # the request carried a Dapper-style trace context: ship
+                    # back a compact span digest (this clock's timebase; the
+                    # master rebases via its ClockSync) and mirror the same
+                    # spans into this process's own tracer when it is on.
+                    # No context -> byte-identical legacy reply.
+                    digest_spans = [
+                        ["ops.handle", t_handle0, t_enc1 - t_handle0],
+                        ["ops.decode", t_handle0, t_dec1 - t_handle0],
+                        ["ops.forward", t0, t_fwd1 - t0],
+                        ["ops.encode", t_fwd1, t_enc1 - t_fwd1],
+                    ]
+                    reply.append(json.dumps({"digest": {
+                        "name": self.name,
+                        "seq": tc.get("seq"),
+                        "spans": [[n, round(ts, 7), round(d, 7)]
+                                  for n, ts, d in digest_spans],
+                    }}).encode())
+                    tr = tracer()
+                    if tr.enabled:
+                        args = {"trace_id": tc.get("tid"),
+                                "parent_span_id": tc.get("psid"),
+                                "seq": tc.get("seq")}
+                        for n, ts, d in digest_spans:
+                            tr.record(n, ts, d, args)
                 reply_len = sum(len(p) for p in reply)
                 bytes_out += reply_len
                 conn.send(MsgType.TENSOR, reply)

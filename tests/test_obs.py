@@ -270,7 +270,11 @@ def test_loopback_wire_bytes_consistent_and_spans_recorded(params):
         assert m["wire.bytes_out"]["value"] > 0
         assert m["wire.bytes_in"]["value"] > 0
         assert m["wire.crc_failures"]["value"] == 0
-        assert m["worker.forward_ms"]["count"] >= 4
+        # 4 forwards: the first op of each activation shape (prefill and
+        # the first decode — both compile) lands in the warmup gauge, the
+        # steady-state rest in the histogram
+        assert m["worker.forward_ms"]["count"] >= 2
+        assert m["worker.warmup_ms"]["value"] > 0
         assert m["wire.serialize_ms"]["count"] >= 4
 
         with urllib.request.urlopen(

@@ -1,0 +1,632 @@
+"""Cluster-wide observability: trace stitching, clock alignment, metrics
+aggregation, straggler detection.
+
+Covers the cross-process tier on top of cake_tpu/obs: NTP-style clock
+offset estimation (obs.clock), the trailer-based trace-context propagation
+and span-digest stitching over the OPS wire (protocol/worker/runner), the
+merged multi-process Perfetto export (obs.trace), the cluster scraper with
+straggler flagging (obs.cluster), the shared status HTTP surface
+(obs.statusd), and artifact durability on signals. The loopback smoke at
+the bottom is `make cluster-trace-smoke`.
+
+(Named with a z-prefix on purpose: this is the heaviest loopback suite in
+the tree and the tier-1 run is wall-clock budgeted — it must sort after
+the fast unit suites, not displace them.)
+"""
+
+import json
+import os
+import signal
+import time
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu import obs
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import flight, metrics, trace
+from cake_tpu.obs.clock import ClockSync
+from cake_tpu.obs.cluster import ClusterScraper, HttpSource
+from cake_tpu.obs import top as obs_top
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import protocol
+from cake_tpu.runtime.master import DistributedGenerator, build_runners
+from cake_tpu.runtime.worker import Worker
+
+CFG = tiny(max_seq_len=32)
+
+
+# -- clock alignment ---------------------------------------------------------
+
+def test_clock_offset_min_of_n_beats_noisy_samples():
+    """Synthetic skewed clocks: the worker runs 123.456s ahead; network
+    delay is asymmetric on most samples. The min-RTT sample must win and
+    bound the offset error by its own asymmetry, not the worst one's."""
+    D = 123.456
+    cs = ClockSync()
+    t = 10.0
+    # (outbound delay, inbound delay) per ping; the 0.5ms symmetric pair
+    # has the smallest RTT and zero asymmetry error
+    for out, inn in [(0.004, 0.020), (0.0005, 0.0005), (0.010, 0.002)]:
+        t0 = t
+        tw = t0 + out + D
+        t1 = t0 + out + inn
+        cs.add(t0, tw, t1)
+        t += 1.0
+    assert cs.synced
+    assert cs.rtt_s == pytest.approx(0.001)
+    assert cs.offset_s == pytest.approx(D, abs=1e-9)
+    snap = cs.snapshot()
+    assert snap["samples"] == 3 and snap["rtt_ms"] == pytest.approx(1.0)
+
+    # rebasing keeps worker-side ordering and lands on the master timeline
+    worker_times = [D + 11.0, D + 11.001, D + 11.5]
+    rebased = [cs.to_master(tw) for tw in worker_times]
+    assert rebased == sorted(rebased)
+    for tw, tm in zip(worker_times, rebased):
+        assert tm == pytest.approx(tw - D, abs=1e-9)
+
+
+def test_clock_offset_error_bounded_by_asymmetry():
+    """With only asymmetric samples the estimate is off by at most half
+    the best sample's RTT — the Cristian bound the merge step relies on."""
+    D = -7.5  # worker behind the master
+    cs = ClockSync()
+    out, inn = 0.003, 0.001  # 1ms asymmetry -> <=1ms offset error
+    cs.add(5.0, 5.0 + out + D, 5.0 + out + inn)
+    assert abs(cs.offset_s - D) <= (out + inn) / 2
+    with pytest.raises(ValueError, match="non-causal"):
+        cs.add(1.0, 0.0, 0.5)
+
+
+# -- merged multi-process trace export ---------------------------------------
+
+def test_trace_merge_emits_multiprocess_perfetto_doc():
+    tr = trace.tracer()
+    tr.start()
+    try:
+        with trace.span("decode.step", index=1):
+            with trace.span("segment.remote_rtt", addr="w1:1"):
+                pass
+        base = time.perf_counter()
+        tr.record_remote("w1@h:1", "ops.handle", base, 0.001,
+                         {"seq": 1, "trace_id": tr.trace_id})
+        tr.record_remote("w2@h:2", "ops.handle", base + 0.002, 0.001,
+                         {"seq": 1})
+    finally:
+        tr.stop()
+    doc = json.loads(json.dumps(tr.to_chrome_trace()))  # JSON round-trip
+    evs = doc["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert all(e["ph"] in ("X", "M") for e in evs)
+    assert [e["ts"] for e in xs] == sorted(e["ts"] for e in xs)
+    # one pid per process: the master plus each stitched-in worker
+    pids = {e["pid"] for e in xs}
+    assert len(pids) == 3 and os.getpid() in pids
+    pnames = {e["args"]["name"] for e in evs
+              if e["ph"] == "M" and e["name"] == "process_name"}
+    assert {"w1@h:1", "w2@h:2"} <= pnames
+    # the local span ids feed trace propagation
+    assert trace.current_span_id() == 0
+    assert tr.trace_id and len(tr.trace_id) == 16
+    tr.clear()
+
+
+# -- OPS trailer: byte compatibility + round trip ----------------------------
+
+@pytest.mark.parametrize("codec", ["none", "bf16", "int8"])
+def test_ops_trailer_roundtrip_and_legacy_bytes(codec):
+    """No trace context -> byte-identical legacy frames; with one, the
+    trailer rides after the self-describing tensor and strips back off."""
+    x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+    ops = [("model.layers.0", 3), ("model.layers.1", 3)]
+    legacy = (
+        protocol.encode_ops(x, ops, codec)
+        if codec != "none"
+        else b"".join(
+            [len(json.dumps(ops).encode()).to_bytes(4, "little"),
+             json.dumps(ops).encode(), protocol.encode_tensor(x)]
+        )
+    )
+    assert protocol.encode_ops(x, ops, codec) == legacy
+
+    tc = {"tid": "ab" * 8, "psid": 7, "seq": 42, "pos": 3}
+    framed = protocol.encode_ops(x, ops, codec, trace_ctx=tc)
+    assert framed.startswith(legacy) and len(framed) > len(legacy)
+    x2, ops2, codec2, trailer = protocol.decode_ops_traced(framed)
+    assert ops2 == ops and codec2 == codec and trailer == {"tc": tc}
+    assert x2.shape == x.shape
+    if codec == "none":
+        np.testing.assert_array_equal(x2, x)
+    # the trailer-blind decoder (old peers' code path) still works
+    x3, ops3, codec3 = protocol.decode_ops(framed)
+    assert ops3 == ops and codec3 == codec
+    # reply-side split: activation + digest trailer
+    digest = {"digest": {"name": "w", "seq": 42, "spans": [["ops.handle",
+                                                            1.0, 0.5]]}}
+    reply = protocol.encode_activation(x, codec) + json.dumps(digest).encode()
+    act, tr2 = protocol.split_activation(reply)
+    assert tr2 == digest
+    out, got = protocol.decode_activation(act)
+    assert got == codec and out.shape == x.shape
+    act3, tr3 = protocol.split_activation(protocol.encode_activation(x, codec))
+    assert tr3 is None and len(act3) == protocol.activation_nbytes(act3)
+
+
+def test_worker_info_caps_default_empty_for_old_peer():
+    import dataclasses
+
+    d = dataclasses.asdict(protocol.WorkerInfo(name="old"))
+    d.pop("caps")
+    got = protocol.WorkerInfo.from_bytes(json.dumps(d).encode())
+    assert got.caps == []
+    assert set(protocol.ALL_CAPS) == {"trace", "ping", "stats"}
+
+
+# -- straggler detection -----------------------------------------------------
+
+class _FakeSource:
+    def __init__(self, name, p99, rtt_ms=1.0, up=True):
+        self.name, self.addr, self._p99, self._up = name, f"{name}:1", p99, up
+        self._rtt = rtt_ms
+
+    def fetch(self):
+        if not self._up:
+            return None
+        return {
+            "name": self.name, "layer_runs": [[0, 2]], "ops_total": 10,
+            "bytes_in": 1000, "bytes_out": 1000, "connections_live": 1,
+            "uptime_s": 5.0,
+            "forward_ms": {"count": 10, "p50": self._p99 / 2,
+                           "p99": self._p99},
+        }
+
+    def link(self):
+        return {"rtt_ms": self._rtt, "clock_offset_ms": 0.5}
+
+
+def test_straggler_flagged_on_synthetic_slow_worker():
+    reg = metrics.Registry(enabled=True)
+    scraper = ClusterScraper(
+        [_FakeSource("a", 2.0), _FakeSource("b", 2.2),
+         _FakeSource("slow", 40.0), _FakeSource("dead", 1.0, up=False)],
+        straggler_factor=2.0, registry=reg,
+    )
+    rep = scraper.scrape()
+    assert rep["stragglers"] == ["slow"]
+    assert rep["workers"]["slow"]["straggler"] is True
+    assert rep["workers"]["a"]["straggler"] is False
+    assert rep["workers"]["dead"]["up"] is False
+    assert rep["median_forward_p99_ms"] == pytest.approx(2.2)
+    snap = reg.snapshot(prefix="cluster.")
+    assert snap["cluster.slow.straggler"]["value"] == 1
+    assert snap["cluster.a.straggler"]["value"] == 0
+    assert snap["cluster.slow.forward_p99_ms"]["value"] == 40.0
+    assert snap["cluster.workers_up"]["value"] == 3
+    assert snap["cluster.stragglers"]["value"] == 1
+    assert snap["cluster.dead.up"]["value"] == 0
+    # the live panel renders every state without a terminal
+    frame = obs_top.render(rep)
+    assert "slow" in frame and "SLOW" in frame and "DOWN" in frame
+    assert "stragglers: slow" in frame
+
+    with pytest.raises(ValueError, match="straggler factor"):
+        ClusterScraper([], straggler_factor=1.0, registry=reg)
+
+
+def test_top_refresher_repaints_in_place():
+    """The --top thread: frames land on the stream with ANSI cursor-up
+    rewrites between them, and stop() leaves a final frame behind."""
+    import io
+
+    reg = metrics.Registry(enabled=True)
+    scraper = ClusterScraper([_FakeSource("a", 2.0)], straggler_factor=2.0,
+                             registry=reg)
+    out = io.StringIO()
+    view = obs_top.Top(scraper, out=out, interval_s=0.01)
+    view.start()
+    time.sleep(0.08)
+    view.stop()
+    text = out.getvalue()
+    assert text.count("WORKER") >= 2  # repainted at least once
+    assert "\x1b[" in text  # in-place rewrite, not a scrolling log
+    assert "a" in text
+
+
+def test_two_workers_cannot_both_outrun_median_times_two():
+    """With N=2 the median is the mean: no worker can exceed 2x it, so
+    flagging needs either N>=3 or a sub-2 factor — pin the N>=2 guard."""
+    reg = metrics.Registry(enabled=True)
+    rep = ClusterScraper([_FakeSource("a", 1.0), _FakeSource("b", 30.0)],
+                         straggler_factor=1.5, registry=reg).scrape()
+    assert rep["stragglers"] == ["b"]
+    rep = ClusterScraper([_FakeSource("only", 9.0)],
+                         straggler_factor=1.5, registry=reg).scrape()
+    assert rep["stragglers"] == []  # a cluster of one has no stragglers
+
+
+# -- shared status HTTP surface (master /metrics parity) ---------------------
+
+def test_statusd_serves_json_and_prometheus():
+    from cake_tpu.obs import statusd
+
+    metrics.registry().gauge("cluster.wtest.up").set(1)
+    httpd, port = statusd.start_status_server(
+        lambda: {"role": "master", "metrics": {"x": 1}})
+    try:
+        assert httpd.server_address[0] == "127.0.0.1"  # loopback default
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            st = json.loads(r.read())
+        assert st["role"] == "master"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                                    timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            prom = r.read().decode()
+        # the merged cluster series ride the same exposition
+        assert "cake_cluster_wtest_up 1" in prom
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        metrics.registry().unregister("cluster.wtest.up")
+
+
+# -- artifact durability on signals ------------------------------------------
+
+def test_flush_handlers_land_artifacts_on_sigint(tmp_path):
+    rec = flight.recorder()
+    fl = tmp_path / "flight.jsonl"
+    mt = tmp_path / "metrics.json"
+    prev = {s: signal.getsignal(s) for s in (signal.SIGINT, signal.SIGTERM)}
+    rec.enable(path=str(fl))
+    try:
+        rec.record(index=0, kind="decode", total_ms=1.0)
+        assert fl.read_text() == ""  # batched: nothing on disk yet
+        obs.install_flush_handlers(metrics_out=str(mt))
+        with pytest.raises(KeyboardInterrupt):
+            signal.raise_signal(signal.SIGINT)  # chains to the default
+        lines = fl.read_text().splitlines()
+        assert len(lines) == 1 and json.loads(lines[0])["kind"] == "decode"
+        assert isinstance(json.loads(mt.read_text()), dict)
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        obs._flush_state["metrics_out"] = None
+        rec.close()
+        rec.clear()
+
+
+# -- loopback: old peer negotiation ------------------------------------------
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(11))
+
+
+def _loader(params):
+    return lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+
+def _head(params):
+    return {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+
+
+def test_old_peer_handshake_gets_no_trailer_and_no_pings(params, monkeypatch):
+    """A worker whose handshake advertises no caps (the old-peer wire
+    dialect) must see byte-for-byte legacy op frames even from a tracing
+    master: no trace trailer, no PING/STATS frames, reply digest absent."""
+    w = Worker("w1", CFG, Topology.from_dict(
+        {"w1": {"layers": ["model.layers.0-3"]}}), _loader(params),
+        address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+    # strip the capability advertisement, exactly like a pre-caps peer
+    # whose WorkerInfo JSON lacks the field
+    real_info = w._info
+
+    def old_info():
+        info = real_info()
+        info.caps = []
+        return info
+
+    monkeypatch.setattr(w, "_info", old_info)
+    seen_trailers = []
+    real_decode = protocol.decode_ops_traced
+
+    def spy_decode(buf):
+        out = real_decode(buf)
+        seen_trailers.append(out[3])
+        return out
+
+    monkeypatch.setattr(protocol, "decode_ops_traced", spy_decode)
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{w.port}",
+               "layers": ["model.layers.0-3"]},
+    })
+    tr = trace.tracer()
+    tr.start()
+    try:
+        runners = build_runners(CFG, topo, _loader(params))
+        assert runners[0].caps == set()
+        assert not runners[0].clock.synced  # no PING without the cap
+        assert runners[0].fetch_stats() is None  # no STATS either
+        g = DistributedGenerator(
+            CFG, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        for i in range(3):
+            g.next_token(i)
+        # legacy link shape the CLI's segment log must format: handshake
+        # RTT fallback present, no ping-estimated clock offset
+        (s,) = g.runner_stats()
+        assert "rtt_ms" in s and "clock_offset_ms" not in s
+        g.close()
+    finally:
+        tr.stop()
+        w.shutdown()
+    assert seen_trailers and all(t is None for t in seen_trailers)
+    # nothing got stitched: the merged trace has exactly one pid
+    xs = [e for e in tr.to_chrome_trace()["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in xs} == {os.getpid()}
+    tr.clear()
+
+
+def test_scraper_falls_back_to_http_for_worker_without_cap_stats(
+        params, monkeypatch):
+    """A peer that advertises a status page but not CAP_STATS is scraped
+    over HTTP at its connection host instead of being reported DOWN; link
+    health (RTT/offset) still comes from the master's own connection."""
+    w = Worker("w1", CFG, Topology.from_dict(
+        {"w1": {"layers": ["model.layers.0-3"]}}), _loader(params),
+        address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+    w.start_status_server(0)  # loopback-bound, ephemeral; advertised in caps
+    real_info = w._info
+
+    def no_stats_cap():
+        info = real_info()
+        info.caps = [c for c in info.caps if c != protocol.CAP_STATS]
+        return info
+
+    monkeypatch.setattr(w, "_info", no_stats_cap)
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{w.port}",
+               "layers": ["model.layers.0-3"]},
+    })
+    try:
+        runners = build_runners(CFG, topo, _loader(params))
+        assert runners[0].fetch_stats() is None  # in-band path is gone
+        assert runners[0].info.status_port == w._status_port > 0
+        g = DistributedGenerator(
+            CFG, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        for i in range(3):
+            g.next_token(i)
+        scraper = g.cluster_scraper()
+        assert isinstance(scraper.sources[0], HttpSource)
+        row = scraper.scrape()["workers"]["w1"]
+        assert row["up"] is True and row["ops_total"] > 0
+        assert row["forward_p50_ms"] > 0
+        assert row["rtt_ms"] > 0 and row["clock_offset_ms"] is not None
+        g.close()
+    finally:
+        w.shutdown()
+
+
+def test_failed_clock_refresh_recovers_instead_of_desyncing(params):
+    """A ping exchange that dies mid-flight poisons the connection's frame
+    stream (a late PING reply would surface where the next forward expects
+    its TENSOR). The runner must raise a wire fault so the master's normal
+    reconnect+replay recovery runs deliberately — and after the reconnect,
+    warmup classification must not reset (XLA's compile cache is
+    per-process, not per-connection)."""
+    w = Worker("w1", CFG, Topology.from_dict(
+        {"w1": {"layers": ["model.layers.0-3"]}}), _loader(params),
+        address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{w.port}",
+               "layers": ["model.layers.0-3"]},
+    })
+    try:
+        runners = build_runners(CFG, topo, _loader(params))
+        r = runners[0]
+        g = DistributedGenerator(
+            CFG, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        g.next_token(0)
+        g.next_token(1)  # decode shape now compiled process-wide
+        real_sync = r._sync_clock
+        state = {"failed": False}
+
+        def flaky(n=3):
+            if not state["failed"]:
+                state["failed"] = True
+                raise OSError("simulated recv timeout mid-ping")
+            return real_sync(n)
+
+        r._sync_clock = flaky
+        r._clock_refreshed = -1e9  # due for refresh on the next forward
+        g.next_token(2)
+        assert state["failed"]
+        assert g.recoveries == 1  # deliberate reconnect+replay, no desync
+        assert r.clock.synced  # the re-handshake re-synced the clock
+        # post-recovery decode: the shape was already compiled in this
+        # worker process, so it lands in the steady-state histogram and
+        # leaves the warmup gauge alone
+        warm_after = w._warm_gauge.value
+        hist_after = w._fwd_hist.count
+        g.next_token(3)
+        assert w._fwd_hist.count == hist_after + 1
+        assert w._warm_gauge.value == warm_after
+        g.close()
+    finally:
+        w.shutdown()
+
+
+def test_failed_stats_fetch_poisons_stream_and_recovers(params):
+    """A STATS exchange that dies mid-flight (scraper thread) flags the
+    connection; the NEXT forward raises a wire fault so the master's
+    reconnect+replay runs deliberately — and a later scrape works again."""
+    w = Worker("w1", CFG, Topology.from_dict(
+        {"w1": {"layers": ["model.layers.0-3"]}}), _loader(params),
+        address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+    w.serve_in_background()
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{w.port}",
+               "layers": ["model.layers.0-3"]},
+    })
+    try:
+        runners = build_runners(CFG, topo, _loader(params))
+        r = runners[0]
+        g = DistributedGenerator(
+            CFG, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        g.next_token(0)
+        real_recv = r.conn.recv
+        r.conn.recv = lambda: (_ for _ in ()).throw(
+            OSError("simulated recv timeout mid-stats"))
+        from cake_tpu.runtime import wire
+        with pytest.raises(wire.WireError, match="mid-exchange"):
+            r.fetch_stats()
+        r.conn.recv = real_recv
+        assert r._poisoned is not None
+        g.next_token(1)  # wire fault -> reconnect + replay, not a desync
+        assert g.recoveries == 1
+        assert r._poisoned is None
+        assert r.fetch_stats()["ops_total"] > 0  # stream is clean again
+        g.close()
+    finally:
+        w.shutdown()
+
+
+# -- loopback acceptance smoke (`make cluster-trace-smoke`) ------------------
+
+def test_cluster_trace_smoke_two_workers(params):
+    """2-worker CPU loopback with --trace semantics: ONE Perfetto-valid
+    merged trace holding spans from >= 3 pids, worker `ops.handle` nested
+    (after clock rebasing) inside the master's remote-segment span, and a
+    cluster report naming every worker with per-segment p50/p99, RTT, and
+    clock offset — plus a straggler flag on the artificially slowed one."""
+    workers = []
+    for name, rng in (("w1", "0-1"), ("w2", "2-3")):
+        w = Worker(name, CFG, Topology.from_dict(
+            {name: {"layers": [f"model.layers.{rng}"]}}), _loader(params),
+            address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+        w.serve_in_background()
+        workers.append(w)
+    # make w2 a genuine straggler: every forward pays +50ms. The margin
+    # must survive a loaded CI box: with 2 workers the median is the mean,
+    # so factor f flags w2 only when slow > (f/(2-f)) * fast — at f=1.2
+    # that is fast < 100ms steady-state, comfortably true for a 2-layer
+    # tiny forward even under full-suite load.
+    real_run = workers[1]._run_ops
+
+    def slow_run(*a, **k):
+        time.sleep(0.05)
+        return real_run(*a, **k)
+
+    workers[1]._run_ops = slow_run
+    topo = Topology.from_dict({
+        "w1": {"host": f"127.0.0.1:{workers[0].port}",
+               "layers": ["model.layers.0-1"]},
+        "w2": {"host": f"127.0.0.1:{workers[1].port}",
+               "layers": ["model.layers.2-3"]},
+    })
+    tr = trace.tracer()
+    tr.start()
+    try:
+        runners = build_runners(CFG, topo, _loader(params))
+        for r in runners:
+            assert r.clock.synced and r.clock.rtt_s > 0
+        g = DistributedGenerator(
+            CFG, _head(params), runners,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.1),
+        )
+        g.set_prompt([3, 5, 7])
+        for i in range(4):
+            g.next_token(i)
+
+        stats = g.runner_stats()
+        assert all("rtt_ms" in s and "clock_offset_ms" in s for s in stats)
+
+        report = g.cluster_report(straggler_factor=1.2)
+        assert set(report["workers"]) == {"w1", "w2"}
+        for name, row in report["workers"].items():
+            assert row["up"] is True
+            assert row["forward_p50_ms"] > 0
+            assert row["forward_p99_ms"] >= row["forward_p50_ms"]
+            assert row["rtt_ms"] > 0
+            assert row["clock_offset_ms"] is not None
+            assert row["ops_total"] > 0
+        assert report["stragglers"] == ["w2"]
+        assert report["workers"]["w2"]["straggler"] is True
+        assert len(report["segments"]) == 2
+        # the merged series joined the master registry for /metrics and
+        # --metrics-out parity
+        snap = metrics.registry().snapshot(prefix="cluster.")
+        assert snap["cluster.w2.straggler"]["value"] == 1
+        assert snap["cluster.w1.up"]["value"] == 1
+        g.close()
+    finally:
+        tr.stop()
+        for w in workers:
+            w.shutdown()
+
+    doc = json.loads(json.dumps(tr.to_chrome_trace()))
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    master_pid = os.getpid()
+    pids = {e["pid"] for e in xs}
+    assert master_pid in pids and len(pids) >= 3
+    # synthetic worker pids resolve to their 'name@addr' identities
+    pid_src = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+               if e["ph"] == "M" and e["name"] == "process_name"}
+    rtt_spans = [e for e in xs
+                 if e["name"] == "segment.remote_rtt"
+                 and e["pid"] == master_pid]
+    handles = [e for e in xs
+               if e["name"] == "ops.handle" and e["pid"] != master_pid]
+    # every request produced a digest: 2 segments x (prefill + 3 decodes)
+    assert len(handles) == len(rtt_spans) == 8
+    for h in handles:
+        addr = pid_src[h["pid"]].split("@")[1]
+        assert any(
+            s["args"]["addr"] == addr
+            and s["ts"] <= h["ts"]
+            and h["ts"] + h["dur"] <= s["ts"] + s["dur"]
+            for s in rtt_spans
+        ), f"worker span not nested in its remote-segment span: {h}"
+        assert h["args"]["trace_id"] == tr.trace_id
+        assert h["args"]["parent_span_id"] > 0
+    # sub-phase spans rode the same digests
+    names = {e["name"] for e in xs if e["pid"] != master_pid}
+    assert {"ops.handle", "ops.decode", "ops.forward", "ops.encode"} <= names
+    tr.clear()
+
+
+def test_cli_rejects_cluster_flags_without_topology(tmp_path):
+    """--top/--cluster-report aggregate across workers; a local run must
+    reject them loudly instead of silently ignoring them."""
+    from cake_tpu import cli
+
+    (tmp_path / "config.json").write_text(json.dumps(tiny().to_hf_dict()))
+    topo = tmp_path / "t.yml"
+    Topology.from_dict({
+        "w": {"host": "127.0.0.1:1", "layers": ["model.layers.0-3"]},
+    }).save(topo)
+    with pytest.raises(SystemExit, match="cluster-report|top"):
+        cli.main(["--model", str(tmp_path), "--top", "--cpu"])
+    with pytest.raises(SystemExit, match="straggler-factor"):
+        cli.main(["--model", str(tmp_path), "--straggler-factor", "0.5",
+                  "--topology", str(topo), "--cpu"])
