@@ -82,12 +82,26 @@ cluster-trace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_zcluster_obs.py -q \
 	  -k smoke
 
+# chaos smoke: seeded 2-worker loopback generation that survives one
+# injected worker-process kill (+restart inside --recover-deadline) and
+# one injected mid-frame stall longer than --op-timeout, with the token
+# stream bit-identical to the fault-free run and recovery counters /
+# flight flags reflecting each fault; plus the full fault matrix
+# (kill/stall/corrupt/truncate/blackhole/refuse at handshake, ping
+# plane, prefill, and decode) and the replica-failover loopback.
+# (the slow-marked CLI subprocess e2e stays out of the smoke chain —
+# `make test` runs it)
+chaos-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m 'not slow'
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
 # from the bench ledger path. Chains the cluster smoke: the trailer and
-# ping planes ride the same hot path the codec numbers come from.
-perf-smoke: cluster-trace-smoke
+# ping planes ride the same hot path the codec numbers come from — and
+# the chaos smoke: recovery machinery must keep surviving what the perf
+# work keeps touching.
+perf-smoke: cluster-trace-smoke chaos-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -106,4 +120,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke perf-smoke deploy clean

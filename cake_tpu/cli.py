@@ -204,6 +204,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "offset, straggler flags; plain escape-code "
                         "refresh, no curses; the token stream on stdout "
                         "stays clean)")
+    # -- failure domain (runtime/retry, testing/chaos) ----------------------
+    p.add_argument("--recover-deadline", type=float, default=None,
+                   dest="recover_deadline", metavar="S",
+                   help="master+topology runs: per-replica budget (seconds, "
+                        "default 30) for a mid-stream reconnect — retried "
+                        "with jittered exponential backoff, so a worker "
+                        "restarting for a few seconds no longer kills the "
+                        "stream; when a segment's topology entry lists "
+                        "replica addresses, expiry fails over to the next "
+                        "one and the context replay rebuilds its KV")
+    p.add_argument("--connect-retries", type=int, default=0,
+                   dest="connect_retries", metavar="N",
+                   help="master+topology runs: retry each worker's INITIAL "
+                        "handshake up to N times with backoff instead of "
+                        "failing on the first refused connect — the master "
+                        "can start before its workers (default 0: fail "
+                        "fast)")
+    p.add_argument("--op-timeout", type=float, default=None,
+                   dest="op_timeout", metavar="S",
+                   help="master+topology runs: per-op recv deadline "
+                        "(seconds) on every forward/STATS/PING exchange; a "
+                        "wedged worker then faults into reconnect+replay "
+                        "instead of hanging the decode loop forever. "
+                        "Default scales with segment size (120 + 2s/layer "
+                        "— generous: it catches wedged peers, not slow "
+                        "ones)")
+    p.add_argument("--chaos", default=None, metavar="SPEC",
+                   help="DEV: put a fault-injecting proxy "
+                        "(cake_tpu.testing.chaos) in front of every worker "
+                        "link. SPEC is comma-separated "
+                        "kind[@[r]FRAME][=PARAM] directives — kill, "
+                        "truncate, corrupt, stall (PARAM ms), blackhole, "
+                        "refuse (PARAM conns) — applied to successive "
+                        "connections per link, or seed=N for a "
+                        "seed-reproducible random schedule. E.g. "
+                        "--chaos kill@7 kills each link after its 7th "
+                        "request frame; --chaos seed=1337 reproduces "
+                        "exactly the run that failed under seed 1337")
     p.add_argument("--straggler-factor", type=float, default=2.0,
                    dest="straggler_factor", metavar="F",
                    help="flag a worker as straggler when its segment "
@@ -282,6 +320,21 @@ def _settings(args):
     )
 
 
+def _failure_domain_flags(args) -> list[str]:
+    """Names of the worker-link failure-domain flags the user actually set
+    — they only mean something on a host-addressed topology master."""
+    out = []
+    if args.recover_deadline is not None:
+        out.append("--recover-deadline")
+    if args.connect_retries:
+        out.append("--connect-retries")
+    if args.op_timeout is not None:
+        out.append("--op-timeout")
+    if args.chaos:
+        out.append("--chaos")
+    return out
+
+
 def run_worker(args) -> int:
     from cake_tpu.parallel.topology import Topology
     from cake_tpu.runtime.worker import Worker
@@ -296,6 +349,11 @@ def run_worker(args) -> int:
         sys.exit("error: --cluster-report/--top are master-side aggregation "
                  "views; pass them to the master process (they would "
                  "otherwise be silently ignored in worker mode)")
+    if _failure_domain_flags(args):
+        sys.exit("error: --recover-deadline/--connect-retries/--op-timeout/"
+                 "--chaos drive the master's side of the worker links; pass "
+                 "them to the master process (they would otherwise be "
+                 "silently ignored in worker mode)")
     config = _load_config(args)
     topology = Topology.from_path(args.topology)
 
@@ -352,6 +410,11 @@ def run_serve(args) -> int:
     if args.cluster_report or args.top:
         sys.exit("error: --cluster-report/--top aggregate across cross-host "
                  "workers (master/worker --topology runs); serving rides "
+                 "the mesh")
+    flags = _failure_domain_flags(args)
+    if flags:
+        sys.exit(f"error: {'/'.join(flags)} apply to cross-host worker "
+                 "links (master/worker --topology runs); serving rides "
                  "the mesh")
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
@@ -475,9 +538,20 @@ def run_master(args) -> int:
         sys.exit("error: --cluster-report/--top aggregate across cross-host "
                  "workers; they need a host-addressed --topology (they "
                  "would otherwise be silently ignored)")
+    _fd_flags = _failure_domain_flags(args)
+    if _fd_flags and (use_mesh or not args.topology):
+        sys.exit(f"error: {'/'.join(_fd_flags)} drive cross-host worker "
+                 "links; they need a host-addressed --topology (they "
+                 "would otherwise be silently ignored)")
     if args.straggler_factor <= 1.0:
         sys.exit("error: --straggler-factor must exceed 1.0 (a worker at "
                  "the median is not a straggler)")
+    if args.op_timeout is not None and args.op_timeout <= 0:
+        sys.exit("error: --op-timeout must exceed 0 (omit the flag for the "
+                 "segment-scaled default; there is no 'no deadline' mode — "
+                 "that is the hung-peer hole this flag closes)")
+    if args.recover_deadline is not None and args.recover_deadline <= 0:
+        sys.exit("error: --recover-deadline must exceed 0")
     if args.lookahead:
         # lookahead needs the fused-block programs (all-local path here,
         # BatchGenerator on the serving path); reject combinations that
@@ -579,10 +653,39 @@ def run_master(args) -> int:
                 quantize=args.quantize,
             )["layers"]
 
+        if args.chaos:
+            # DEV fault injection: one frame-aware chaos proxy per worker
+            # address, each running the same seeded/explicit schedule, and
+            # the topology rewired through them — any failure mode is
+            # reproducible from the spec (or its seed) alone.
+            from cake_tpu.testing import chaos as chaos_mod
+
+            try:
+                faults = chaos_mod.parse_spec(args.chaos)
+            except ValueError as e:
+                sys.exit(f"error: bad --chaos spec: {e}")
+            log.warning("chaos enabled: %s — faults WILL be injected on "
+                        "every worker link",
+                        ", ".join(str(f) for f in faults))
+            for node in topology:
+                wrapped = []
+                for a in (node.hosts or ([node.host] if node.host else [])):
+                    host, _, port = a.partition(":")
+                    proxy = chaos_mod.ChaosProxy(
+                        host, int(port or 10128), faults).start()
+                    wrapped.append(proxy.addr)
+                    log.info("chaos proxy %s -> %s", proxy.addr, a)
+                if wrapped:
+                    node.hosts = wrapped
+                    node.host = wrapped[0]
+
         try:
             runners = build_runners(config, topology, loader,
                                     max_seq=args.max_seq,
-                                    wire_codec=args.wire_codec or "none")
+                                    wire_codec=args.wire_codec or "none",
+                                    op_timeout_s=args.op_timeout,
+                                    connect_retries=args.connect_retries,
+                                    recover_deadline_s=args.recover_deadline)
         except RuntimeError as e:  # e.g. worker rejects the codec
             sys.exit(f"error: {e}")
         gen = DistributedGenerator(config, head, runners, tokenizer=tokenizer,

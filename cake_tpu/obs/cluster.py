@@ -37,16 +37,24 @@ DEFAULT_STRAGGLER_FACTOR = 2.0
 def runner_link(runner) -> dict:
     """Connection-level health the master measured itself: min-of-N
     ping RTT + clock offset (clock.ClockSync), falling back to the
-    handshake RTT for peers without the ping capability."""
+    handshake RTT for peers without the ping capability. For a runner
+    with a replica set, also WHICH replica is live (``"2/3"``) — after a
+    failover the cluster view must show where the segment actually
+    runs."""
     clock = getattr(runner, "clock", None)
     if clock is not None and clock.synced:
         snap = clock.snapshot()
-        return {"rtt_ms": snap["rtt_ms"],
+        link = {"rtt_ms": snap["rtt_ms"],
                 "clock_offset_ms": snap["offset_ms"]}
-    info = getattr(runner, "info", None)
-    rtt = getattr(info, "latency_ms", None) if info else None
-    return {"rtt_ms": round(rtt, 4) if rtt else None,
-            "clock_offset_ms": None}
+    else:
+        info = getattr(runner, "info", None)
+        rtt = getattr(info, "latency_ms", None) if info else None
+        link = {"rtt_ms": round(rtt, 4) if rtt else None,
+                "clock_offset_ms": None}
+    addrs = getattr(runner, "addrs", None)
+    if addrs and len(addrs) > 1:
+        link["replica"] = f"{runner._addr_idx + 1}/{len(addrs)}"
+    return link
 
 
 class WireSource:

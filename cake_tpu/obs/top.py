@@ -15,7 +15,7 @@ import sys
 import threading
 import time
 
-_HDR = (f"{'WORKER':<14} {'ST':<4} {'LAYERS':<10} {'p50ms':>8} "
+_HDR = (f"{'WORKER':<14} {'ST':<4} {'LAYERS':<10} {'repl':<5} {'p50ms':>8} "
         f"{'p99ms':>8} {'rtt':>7} {'offset':>8} {'ops':>8} {'MB in':>8} "
         f"{'MB out':>8}")
 
@@ -47,6 +47,9 @@ def render(report: dict) -> str:
         state = "SLOW" if w.get("straggler") else "ok"
         lines.append(
             f"{name:<14} {state:<4} {_runs(w.get('layer_runs')):<10} "
+            # which address of the segment's failover set is live ("2/3");
+            # single-address segments show "-"
+            f"{w.get('replica') or '-':<5} "
             f"{_fmt(w.get('forward_p50_ms')):>8} "
             f"{_fmt(w.get('forward_p99_ms')):>8} "
             f"{_fmt(w.get('rtt_ms')):>7} "

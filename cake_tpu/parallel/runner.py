@@ -73,6 +73,16 @@ class BlockRunner(ABC):
     def reset(self) -> None:
         """Fresh KV state for a new stream (cache.as_new, cache.rs:138-146)."""
 
+    def recover(self) -> bool:
+        """Bring this runner back after a transport fault: reconnect with
+        backoff under the recovery deadline, failing over to the next
+        replica when the live address's budget expires (RemoteRunner).
+        Returns True when the live address CHANGED (a failover — the
+        master counts those apart from plain recoveries). Local runners
+        just reset."""
+        self.reset()
+        return False
+
     def close(self) -> None:
         pass
 
@@ -132,9 +142,25 @@ class RemoteRunner(BlockRunner):
     # once the estimate is older than this (clock drift over a long run)
     CLOCK_PINGS = 5
     CLOCK_REFRESH_S = 30.0
+    # per-replica reconnect budget during mid-stream recovery; overridden
+    # by --recover-deadline
+    RECOVER_DEADLINE_S = 30.0
 
-    def __init__(self, host: str, start: int, stop: int, timeout_ms: int = 30000,
-                 max_seq: int | None = None, wire_codec: str = "none"):
+    def __init__(self, host: str | list[str], start: int, stop: int,
+                 timeout_ms: int = 30000,
+                 max_seq: int | None = None, wire_codec: str = "none",
+                 op_timeout_s: float | None = None,
+                 connect_retries: int = 0,
+                 recover_deadline_s: float | None = None):
+        """``host`` — one address, or the segment's replica set in
+        failover order (every replica must serve the same layers).
+        ``op_timeout_s`` bounds each forward/STATS wire round trip (a
+        wedged worker faults into reconnect+replay instead of hanging the
+        decode loop); the default scales with segment size since a longer
+        segment legitimately computes longer. ``connect_retries`` retries
+        the INITIAL handshake with backoff — a master may start before
+        its workers. ``recover_deadline_s`` is the per-replica reconnect
+        budget :meth:`recover` spends before failing over."""
         from cake_tpu.runtime import protocol, wire
         from cake_tpu.runtime.protocol import MsgType
 
@@ -143,11 +169,26 @@ class RemoteRunner(BlockRunner):
         self.start, self.stop = start, stop
         self._timeout_ms = timeout_ms
         self._expected_max_seq = max_seq
-        if ":" in host:
-            addr, port = host.rsplit(":", 1)
-        else:
-            addr, port = host, "10128"
-        self.addr = f"{addr}:{port}"
+        hosts = [host] if isinstance(host, str) else list(host)
+        if not hosts:
+            raise ValueError("RemoteRunner needs at least one address")
+
+        def _norm(h: str) -> str:
+            return h if ":" in h else f"{h}:10128"
+
+        self.addrs = [_norm(h) for h in hosts]
+        self._addr_idx = 0
+        # generous per-op deadline, scaled to segment size: the op is one
+        # forward over (stop-start) layers plus (worst case) a per-shape
+        # XLA compile; it exists to catch WEDGED peers, not slow ones
+        self.op_timeout_s = (
+            op_timeout_s if op_timeout_s is not None
+            else 120.0 + 2.0 * (stop - start)
+        )
+        self.recover_deadline_s = (
+            recover_deadline_s if recover_deadline_s is not None
+            else self.RECOVER_DEADLINE_S
+        )
         self.last_call = {}
         self._span_tag = f"{start}-{stop}"
         self._ser_hist = obs_metrics.histogram("wire.serialize_ms")
@@ -164,17 +205,49 @@ class RemoteRunner(BlockRunner):
         # must fault into the master's reconnect+replay instead of
         # tripping on a stale STATS frame
         self._poisoned: Exception | None = None
-        self._handshake()
+        if connect_retries > 0:
+            from cake_tpu.runtime import retry
+
+            # transport failures only: a deterministic handshake rejection
+            # (layer coverage, max_seq, codec — RuntimeError) must not be
+            # hammered against a correctly-refusing worker
+            retry.retry_call(
+                self._handshake,
+                retry.RetryPolicy(deadline_s=None,
+                                  max_attempts=connect_retries + 1,
+                                  base_s=0.2, cap_s=2.0),
+                retry_on=(OSError, wire.WireError),
+                describe=f"connect to {self.addr}",
+            )
+        else:
+            self._handshake()
+
+    @property
+    def addr(self) -> str:
+        """The LIVE address (current replica) — every log line, metric
+        label, and ident() reads this, so a failover is visible
+        everywhere at once."""
+        return self.addrs[self._addr_idx]
 
     def _handshake(self) -> None:
         """Connect + Hello/WorkerInfo exchange, recording RTT latency and
         verifying layer coverage (client.rs:41-47)."""
+        stale = getattr(self, "conn", None)
+        if stale is not None:  # retried handshake: drop the failed socket
+            stale.close()
+            self.conn = None
         addr, port = self.addr.rsplit(":", 1)
         t0 = time.perf_counter()
-        self.conn = self._wire.connect(addr, int(port),
-                                       timeout_ms=self._timeout_ms)
-        self.conn.send(self._MsgType.HELLO)
-        t, payload = self.conn.recv()
+        conn = self._wire.connect(addr, int(port),
+                                  timeout_ms=self._timeout_ms)
+        try:
+            conn.send(self._MsgType.HELLO)
+            t, payload = conn.recv()
+        except Exception:
+            # retried handshakes must not leak half-open sockets
+            conn.close()
+            raise
+        self.conn = conn
         if t != self._MsgType.WORKER_INFO:
             raise RuntimeError(f"handshake failed: got message type {t}")
         self.info = self._protocol.WorkerInfo.from_bytes(payload)
@@ -230,7 +303,9 @@ class RemoteRunner(BlockRunner):
         for _ in range(n):
             t0 = time.perf_counter()
             self.conn.send(self._MsgType.PING, struct.pack("<d", t0))
-            t, payload = self.conn.recv()
+            # a ping reply is a control frame, never behind model compute
+            # (the lock is held): a peer silent this long is wedged
+            t, payload = self.conn.recv(timeout=min(self.op_timeout_s, 15.0))
             t1 = self.conn.last_recv_t or time.perf_counter()
             if t != self._MsgType.PING or len(payload) < 16:
                 raise self._wire.WireError(
@@ -307,7 +382,12 @@ class RemoteRunner(BlockRunner):
                 with span("wire.send", bytes=req_len):
                     self.conn.send(self._MsgType.BATCH, req)
                 with span("wire.recv"):
-                    t, payload = self.conn.recv()
+                    # per-op deadline: a wedged worker (hung driver call,
+                    # blackholed link) faults as WireTimeout into the
+                    # master's reconnect+replay instead of blocking the
+                    # decode loop forever (the seed's settimeout(None)
+                    # hole, wire.py:287 pre-ISSUE-4)
+                    t, payload = self.conn.recv(timeout=self.op_timeout_s)
                 t_recv1 = self.conn.last_recv_t or time.perf_counter()
                 if t == self._MsgType.ERROR:
                     raise self._protocol.WorkerOpError(
@@ -388,7 +468,11 @@ class RemoteRunner(BlockRunner):
         with self._lock:
             try:
                 self.conn.send(self._MsgType.STATS)
-                t, payload = self.conn.recv()
+                # holding the lock means no forward is in flight; a STATS
+                # reply is assembled inline on the worker, so a long
+                # silence here is a wedged peer, not a busy one
+                t, payload = self.conn.recv(timeout=min(self.op_timeout_s,
+                                                        15.0))
             except Exception as e:
                 self._poisoned = e
                 raise self._wire.WireError(
@@ -416,10 +500,82 @@ class RemoteRunner(BlockRunner):
             self.clock = ClockSync()
             self._handshake()
 
+    def recover(self, rng=None, sleep=time.sleep) -> bool:
+        """Reconnect after a transport fault: retry the LIVE address's
+        handshake with full-jitter backoff under ``recover_deadline_s``
+        (a worker restarting for a couple of seconds must not kill the
+        stream — the seed raised on the first refused connect), then fail
+        over to the next replica in ``addrs``, each with its own budget.
+        Returns True when the surviving address differs from the one we
+        started on (the master counts that as a failover). Deterministic
+        handshake rejections (layer coverage, max_seq, codec) propagate
+        immediately — retrying a correctly-refusing worker is useless and
+        failing over to a MISCONFIGURED replica set deserves a loud
+        error, not a silent stream."""
+        from cake_tpu.runtime import retry
+
+        policy = retry.RetryPolicy(deadline_s=self.recover_deadline_s)
+        start_idx = self._addr_idx
+        last: Exception | None = None
+        # clamp the per-attempt CONNECT timeout to the recovery budget: a
+        # blackholed primary (SYN dropped, no RST) must not hold failover
+        # hostage for the full steady-state connect timeout
+        saved_timeout_ms = self._timeout_ms
+        self._timeout_ms = min(
+            saved_timeout_ms, max(100, int(self.recover_deadline_s * 1000))
+        )
+        try:
+            for k in range(len(self.addrs)):
+                self._addr_idx = (start_idx + k) % len(self.addrs)
+                try:
+                    retry.retry_call(
+                        self.reset, policy,
+                        retry_on=(OSError, self._wire.WireError),
+                        describe=f"reconnect to {self.addr} "
+                                 f"(layers {self.start}-{self.stop - 1})",
+                        rng=rng, sleep=sleep,
+                    )
+                    # the clamp above bounds CONNECT attempts only; the
+                    # surviving connection's steady-state default deadline
+                    # must be the configured one, not the recovery budget
+                    self.conn.timeout_s = (
+                        saved_timeout_ms / 1000
+                        if saved_timeout_ms and saved_timeout_ms > 0
+                        else None
+                    )
+                    if self._addr_idx != start_idx:
+                        log.warning(
+                            "failed over: layers %d-%d now served by %s "
+                            "(replica %d/%d)", self.start, self.stop - 1,
+                            self.addr, self._addr_idx + 1, len(self.addrs),
+                        )
+                    return self._addr_idx != start_idx
+                except (OSError, self._wire.WireError) as e:
+                    last = e
+                    if k + 1 < len(self.addrs):
+                        log.warning(
+                            "recovery deadline (%.1fs) for %s expired (%s); "
+                            "failing over to %s", self.recover_deadline_s,
+                            self.addr, e,
+                            self.addrs[(self._addr_idx + 1)
+                                       % len(self.addrs)],
+                        )
+        finally:
+            self._timeout_ms = saved_timeout_ms
+        self._addr_idx = start_idx  # next recovery starts from the primary
+        raise self._wire.WireError(
+            f"no replica for layers {self.start}-{self.stop - 1} "
+            f"recovered within {self.recover_deadline_s:.1f}s each "
+            f"(tried {', '.join(self.addrs)}): {last}"
+        ) from last
+
     def close(self) -> None:
         with self._lock:
+            conn = getattr(self, "conn", None)
+            if conn is None:  # a failed retried handshake left no socket
+                return
             try:
-                self.conn.send(self._MsgType.GOODBYE)
+                conn.send(self._MsgType.GOODBYE)
             except Exception:
                 pass
-            self.conn.close()
+            conn.close()

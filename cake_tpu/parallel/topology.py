@@ -44,13 +44,32 @@ def expand_layer_ranges(entries: list[str]) -> list[str]:
 
 @dataclasses.dataclass
 class Node:
-    """One worker's assignment (topology.rs:13-32)."""
+    """One worker's assignment (topology.rs:13-32).
+
+    ``host`` may be given in YAML as a single address OR a list of
+    addresses — the replica set for this segment, in failover order. The
+    master connects to the first and, when a mid-stream recovery deadline
+    for it expires, fails over to the next (every replica must serve the
+    same layers; the handshake enforces it). ``host`` always holds the
+    primary; ``hosts`` the full ordered set."""
 
     name: str
     host: str = ""
     description: str = ""
     layers: list[str] = dataclasses.field(default_factory=list)
     device: int | None = None  # TPU extension: mesh stage index
+    hosts: list[str] | None = None  # replica addresses (failover order)
+
+    def __post_init__(self):
+        if isinstance(self.host, (list, tuple)):  # YAML list under `host:`
+            self.hosts = [str(h) for h in self.host]
+            self.host = self.hosts[0] if self.hosts else ""
+        elif self.hosts is None:
+            self.hosts = [self.host] if self.host else []
+        elif self.host and self.host not in self.hosts:
+            self.hosts = [self.host] + list(self.hosts)
+        elif not self.host and self.hosts:
+            self.host = self.hosts[0]
 
     def is_layer_owner(self, full_name: str) -> bool:
         """Prefix match used by the splitter (topology.rs:25-32): does this
@@ -99,7 +118,11 @@ class Topology:
     def to_dict(self) -> dict:
         out = {}
         for name, n in self.nodes.items():
-            spec: dict = {"host": n.host, "description": n.description,
+            # round-trip the replica list when there is one; a single
+            # address stays the scalar form every pre-replica tool reads
+            host = (list(n.hosts) if n.hosts and len(n.hosts) > 1
+                    else n.host)
+            spec: dict = {"host": host, "description": n.description,
                           "layers": list(n.layers)}
             if n.device is not None:
                 spec["device"] = n.device

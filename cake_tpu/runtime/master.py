@@ -44,12 +44,22 @@ def build_runners(
     local_params_loader,  # callable (start, stop) -> stacked layers pytree
     max_seq: int | None = None,
     wire_codec: str = "none",
+    op_timeout_s: float | None = None,
+    connect_retries: int = 0,
+    recover_deadline_s: float | None = None,
 ) -> list[BlockRunner]:
     """Plan the block walk: one runner per contiguous same-owner segment.
     Unassigned layers run locally on the master (llama.rs:177-193: topology
     decides Client vs local Transformer per layer). ``wire_codec`` selects
     the activation encoding for every remote hop (negotiated against each
-    worker's advertised set at handshake)."""
+    worker's advertised set at handshake). The failure-domain knobs pass
+    straight through to every RemoteRunner: ``op_timeout_s``
+    (``--op-timeout``) bounds each wire round trip, ``connect_retries``
+    (``--connect-retries``) retries the initial handshake with backoff so
+    a master can start before its workers, ``recover_deadline_s``
+    (``--recover-deadline``) budgets each replica's mid-stream reconnect.
+    A topology node whose ``host`` is a LIST hands the whole replica set
+    to its runner (failover order)."""
     runners: list[BlockRunner] = []
     for seg in topology.segments(config.num_hidden_layers):
         if seg.owner is None:
@@ -62,9 +72,12 @@ def build_runners(
         else:
             node = topology[seg.owner]
             runner = RemoteRunner(
-                node.host, seg.start, seg.stop,
+                node.hosts or node.host, seg.start, seg.stop,
                 max_seq=max_seq or config.max_seq_len,
                 wire_codec=wire_codec,
+                op_timeout_s=op_timeout_s,
+                connect_retries=connect_retries,
+                recover_deadline_s=recover_deadline_s,
             )
             log.info("connected: %s", runner.info)
             runners.append(runner)
@@ -126,9 +139,11 @@ class DistributedGenerator(GeneratorBase):
         reg.publish(*self._seg_hist, *self._seg_warm)
         self._tokens_ctr = obs_metrics.counter("master.tokens_generated")
         self._recoveries_ctr = obs_metrics.counter("master.recoveries")
+        self._failovers_ctr = obs_metrics.counter("master.failovers")
         self._last_seg_ms: list[float] = []  # per-segment ms of the last walk
         self._last_sample_ms = 0.0
         self.recoveries = 0  # successful mid-stream reconnect+replay count
+        self.failovers = 0  # recoveries that landed on a different replica
         self._scraper = None  # lazy ClusterScraper (cluster_scraper())
         self._consec_recoveries = 0  # capped so a dead link can't loop forever
         self._timing_paused = False  # replay forwards are not decode samples
@@ -137,12 +152,33 @@ class DistributedGenerator(GeneratorBase):
 
     def _on_new_prompt(self) -> None:
         self._t_start = None
+        # the consecutive-recovery cap guards ONE stream's recovery loop;
+        # carrying the count across prompts would let a long session
+        # accumulate unrelated recoveries until a healthy stream trips
+        # MAX_CONSEC_RECOVERIES spuriously
+        self._consec_recoveries = 0
         # each prompt's first forward is a fresh prefill — re-classify it as
         # warm-up so avg_ms stays steady-state decode only
         for g in self._seg_warm:
             g.set(0.0)
-        for r in self.runners:
-            r.reset()
+        # recover(), not bare reset(): the per-prompt reconnect is the same
+        # failure domain as a mid-stream one (a worker restarting between
+        # prompts, a dead primary with a live replica) and must get the
+        # same backoff budget + failover instead of dying on the first
+        # refused connect
+        self._recover_runners()
+
+    def _recover_runners(self) -> None:
+        """Bring every runner back (reconnect with backoff, possibly
+        failing over to the next replica), keeping the failover counter
+        and the per-segment identities in sync — span tags and
+        runner_stats must show the live replica from the first
+        post-recovery token."""
+        for i, r in enumerate(self.runners):
+            if r.recover():
+                self.failovers += 1
+                self._failovers_ctr.inc()
+                self._seg_idents[i] = r.ident()
 
     # -- forward across runners --------------------------------------------
     def _forward(self, tokens: list[int], pos: int, last_index: int) -> jax.Array:
@@ -188,10 +224,13 @@ class DistributedGenerator(GeneratorBase):
         connection just ends the generation, client.rs:52-61): reconnect
         every segment — a fresh connection means a fresh worker-side KV
         cache (worker.rs:52-61) — and rebuild all segment caches by
-        replaying prompt + generated-so-far in one pass. Returns logits at
-        the last context position, ready to sample the next token."""
-        for r in self.runners:
-            r.reset()
+        replaying prompt + generated-so-far in one pass. Each remote
+        reconnect retries with backoff under the runner's recovery
+        deadline and may FAIL OVER to the segment's next replica (the
+        replay rebuilds KV there from scratch, so a replica needs no
+        state transfer). Returns logits at the last context position,
+        ready to sample the next token."""
+        self._recover_runners()
         ctx = self._prompt_tokens + self._generated
         n = len(ctx)
         if n > self.max_seq:
@@ -208,20 +247,51 @@ class DistributedGenerator(GeneratorBase):
         self._recoveries_ctr.inc()
         return logits
 
+    def _recover(self, e: Exception) -> jax.Array:
+        """Recovery driver: reconnect+replay until logits land or the
+        consecutive-recovery cap trips. The loop (rather than a single
+        attempt) covers the replay ITSELF faulting — a worker that dies
+        again mid-replay, or a replica that accepts the handshake and
+        then drops — each round burning one unit of the cap. Transport
+        failures only: a worker-reported op error
+        (protocol.WorkerOpError) is deterministic — replaying the context
+        would just re-run the same failing op at prefill cost."""
+        while True:
+            self._consec_recoveries += 1
+            if self._consec_recoveries > self.MAX_CONSEC_RECOVERIES:
+                raise RuntimeError(
+                    f"giving up after {self.MAX_CONSEC_RECOVERIES} "
+                    f"consecutive recovery attempts"
+                ) from e
+            log.warning("segment forward failed (%s); reconnecting "
+                        "and replaying %d-token context", e,
+                        len(self._prompt_tokens) + len(self._generated))
+            try:
+                return self._replay_context()
+            except (OSError, wire.WireError) as e2:
+                e = e2
+
     # -- Generator trait ----------------------------------------------------
     def next_token(self, index: int) -> Token:
         t_tok0 = time.perf_counter()
         recoveries0 = self.recoveries
+        failovers0 = self.failovers
         if index == 0:
             self._require_prompt()
             n = len(self._prompt_tokens)
             t_pad = _bucket(n, self.max_seq)
             with span("prefill", tokens=n):
-                logits = self._forward(
-                    self._prompt_tokens + [0] * (t_pad - n), 0, n - 1
-                )
+                # prefill recovers like decode (the seed only guarded
+                # decode steps): the replay context IS the prompt at this
+                # point, so _recover rebuilds exactly the prefill state
+                try:
+                    logits = self._forward(
+                        self._prompt_tokens + [0] * (t_pad - n), 0, n - 1
+                    )
+                    self._pos = n
+                except (OSError, wire.WireError) as e:
+                    logits = self._recover(e)
                 tok_id = self._sample(logits, index)
-            self._pos = n
         else:
             self._check_capacity()
             with span("decode.step", index=index):
@@ -229,22 +299,8 @@ class DistributedGenerator(GeneratorBase):
                     logits = self._forward([self._last_token], self._pos, 0)
                     self._pos += 1
                     self._consec_recoveries = 0
-                # Transport failures only: a worker-reported op error
-                # (protocol.WorkerOpError) is deterministic — replaying the
-                # context would just re-run the same failing op at prefill
-                # cost.
                 except (OSError, wire.WireError) as e:
-                    self._consec_recoveries += 1
-                    if self._consec_recoveries > self.MAX_CONSEC_RECOVERIES:
-                        raise RuntimeError(
-                            f"giving up after {self.MAX_CONSEC_RECOVERIES} "
-                            f"consecutive recovery attempts"
-                        ) from e
-                    log.warning("segment forward failed (%s); reconnecting "
-                                "and replaying %d-token context", e,
-                                len(self._prompt_tokens)
-                                + len(self._generated))
-                    logits = self._replay_context()
+                    logits = self._recover(e)
                 tok_id = self._sample(logits, index)
 
         if index == 0:
@@ -266,6 +322,7 @@ class DistributedGenerator(GeneratorBase):
                 segments_ms=[round(ms, 3) for ms in self._last_seg_ms],
                 sample_ms=round(self._last_sample_ms, 3),
                 recovery=self.recoveries > recoveries0,
+                failover=self.failovers > failovers0,
                 **{k: round(v, 3) if isinstance(v, float) else v
                    for k, v in wire_tot.items()},
             )
@@ -321,6 +378,11 @@ class DistributedGenerator(GeneratorBase):
             info = getattr(r, "info", None)
             if info is not None and getattr(info, "latency_ms", None):
                 entry["handshake_ms"] = round(info.latency_ms, 2)
+            # full failover set (runner_link below contributes "replica",
+            # the live-index view — one source of truth for its format)
+            addrs = getattr(r, "addrs", None)
+            if addrs and len(addrs) > 1:
+                entry["replicas"] = list(addrs)
             # same rtt/offset definition as the cluster report (ping
             # estimate, handshake-RTT fallback) — one source of truth
             entry.update({k: v for k, v in runner_link(r).items()
@@ -369,6 +431,7 @@ class DistributedGenerator(GeneratorBase):
         report["segments"] = self.runner_stats()
         report["tokens_per_sec"] = self.tokens_per_sec()
         report["recoveries"] = self.recoveries
+        report["failovers"] = self.failovers
         return report
 
     def close(self) -> None:

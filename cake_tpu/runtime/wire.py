@@ -85,6 +85,11 @@ def native_lib():
         lib.cw_local_port.argtypes = [ctypes.c_int]
         lib.cw_local_port.restype = ctypes.c_int
         lib.cw_close.argtypes = [ctypes.c_int]
+        if hasattr(lib, "cw_set_timeout"):
+            # absent only in a prebuilt pre-deadline .so shipped without
+            # sources; recv deadlines then degrade to blocking reads
+            lib.cw_set_timeout.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.cw_set_timeout.restype = ctypes.c_int
         lib.cw_send_msg.argtypes = [
             ctypes.c_int, ctypes.c_uint8,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
@@ -107,6 +112,13 @@ class WireError(Exception):
 
 class PeerClosed(WireError):
     pass
+
+
+class WireTimeout(WireError):
+    """A recv/send deadline expired mid-exchange. The connection is
+    unusable afterwards (the frame stream may be cut mid-frame); callers
+    recover by reconnecting — which is exactly what the master's
+    reconnect+replay machinery does with any WireError."""
 
 
 # Frame-level traffic series, counted in this wrapper so the native and
@@ -133,7 +145,10 @@ _ERRORS = {
     -8: "bad magic",
     -9: "crc mismatch",
     -10: "out of memory",
+    -11: "recv deadline expired",
 }
+
+_TIMEOUTS = _metrics.counter("wire.timeouts")
 
 
 def _raise(code: int):
@@ -141,16 +156,49 @@ def _raise(code: int):
         _CRC_FAILURES.inc()
     if code == -2:
         raise PeerClosed(_ERRORS[-2])
+    if code == -11:
+        _TIMEOUTS.inc()
+        raise WireTimeout(_ERRORS[-11])
     raise WireError(_ERRORS.get(code, f"wire error {code}"))
+
+
+def _set_keepalive(sock: socket.socket) -> None:
+    """TCP keepalive on the Python transport (the native lib arms its own
+    in cw_connect/cw_accept): a peer that vanished without a FIN must
+    eventually fault the connection instead of pinning a blocked recv —
+    and, worker-side, that connection's KV caches — forever."""
+    sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+    for opt, val in (("TCP_KEEPIDLE", 60), ("TCP_KEEPINTVL", 10),
+                     ("TCP_KEEPCNT", 3)):
+        if hasattr(socket, opt):  # Linux; other platforms keep OS defaults
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, getattr(socket, opt), val)
+            except OSError:
+                pass
+
+
+# recv(timeout=...) sentinel: "use the connection's default deadline"
+# (None must stay expressible as an explicit block-forever)
+_DEFAULT = object()
 
 
 class Connection:
     """One framed duplex connection (native fd or Python socket)."""
 
-    def __init__(self, fd: int | None = None, sock: socket.socket | None = None):
+    def __init__(self, fd: int | None = None, sock: socket.socket | None = None,
+                 timeout_s: float | None = None):
         self._fd = fd
         self._sock = sock
         self._lib = native_lib() if fd is not None else None
+        # Default recv/send deadline (seconds; None = block forever).
+        # Outbound connections default this to their CONNECT timeout — a
+        # peer that accepted the connection but then wedged (worker hung in
+        # a driver call, half-open socket) faults instead of blocking the
+        # caller forever (the seed's settimeout(None) hole). Accepted
+        # connections keep None: a worker legitimately waits indefinitely
+        # for the master's next request, and keepalive covers dead peers.
+        self.timeout_s = timeout_s
+        self._applied_s: float | None = None  # deadline currently on the fd
         # perf_counter stamped as each frame lands — the clock-offset
         # estimator's t1 (reading it inside recv() keeps Python-side
         # dispatch jitter out of the RTT the offset error is bounded by)
@@ -159,6 +207,23 @@ class Connection:
     @property
     def is_native(self) -> bool:
         return self._fd is not None
+
+    def _apply_timeout(self, t: float | None) -> None:
+        """Arm deadline ``t`` on the fd if it differs from what's already
+        set (one syscall per change, not per recv)."""
+        if t == self._applied_s:
+            return
+        # only None disables the deadline; 0/negative clamp to a minimal
+        # 1 ms one on BOTH transports (0 would mean "no timeout" to
+        # SO_RCVTIMEO but non-blocking mode to settimeout — neither is
+        # what a caller asking for a deadline meant)
+        if self._fd is not None:
+            if hasattr(self._lib, "cw_set_timeout"):
+                ms = 0 if t is None else max(1, int(t * 1000))
+                self._lib.cw_set_timeout(self._fd, ms)
+        else:
+            self._sock.settimeout(None if t is None else max(t, 1e-3))
+        self._applied_s = t
 
     # -- send/recv ----------------------------------------------------------
     def send(self, msg_type: int, payload=b"") -> None:
@@ -175,6 +240,10 @@ class Connection:
         plen = sum(len(p) for p in parts)
         if plen > MAX_PAYLOAD:
             raise WireError(_ERRORS[-7])
+        # a blocked send is the same failure domain as a blocked recv (a
+        # blackholed peer stops draining and the socket buffer fills), so
+        # the connection's default deadline bounds it too
+        self._apply_timeout(self.timeout_s)
         if self._fd is not None:
             # the native ABI takes one contiguous buffer; join only here
             buf = None
@@ -190,8 +259,11 @@ class Connection:
                 crc = zlib.crc32(p, crc)
             header = _HEADER.pack(MAGIC, msg_type, plen)
             trailer = struct.pack("<I", crc)
-            self._send_parts([memoryview(header), *parts,
-                              memoryview(trailer)])
+            try:
+                self._send_parts([memoryview(header), *parts,
+                                  memoryview(trailer)])
+            except TimeoutError:
+                _raise(-11)
         # counted only after the frame went out whole, so the series never
         # exceeds what the peer could have seen (a failed mid-stream send
         # would otherwise skew bytes_out vs the peer's bytes_in in exactly
@@ -214,7 +286,16 @@ class Connection:
             if parts and sent:
                 parts[0] = parts[0][sent:]
 
-    def recv(self) -> tuple[int, bytes]:
+    def recv(self, timeout=_DEFAULT) -> tuple[int, bytes]:
+        """Receive one frame. ``timeout`` (seconds) is a QUIESCENCE
+        deadline — SO_RCVTIMEO semantics, armed per socket read, so it
+        fires when the peer goes silent that long (the wedged-peer case),
+        not as a total-transfer bound for a slow-but-moving frame.
+        Omitted it falls back to the connection's default deadline
+        (``timeout_s``); ``None`` explicitly blocks forever. Expiry
+        raises :class:`WireTimeout` and poisons the connection (the frame
+        stream may be cut mid-frame) — reconnect to keep using the peer."""
+        self._apply_timeout(self.timeout_s if timeout is _DEFAULT else timeout)
         if self._fd is not None:
             out = ctypes.POINTER(ctypes.c_uint8)()
             ln = ctypes.c_uint32()
@@ -231,14 +312,17 @@ class Connection:
             _BYTES_IN.inc(len(data))
             return rc, data
         else:
-            header = self._read_exact(_HEADER.size)
-            magic, msg_type, plen = _HEADER.unpack(header)
-            if magic != MAGIC:
-                _raise(-8)
-            if plen > MAX_PAYLOAD:
-                _raise(-7)
-            payload = self._read_exact(plen) if plen else b""
-            (want_crc,) = struct.unpack("<I", self._read_exact(4))
+            try:
+                header = self._read_exact(_HEADER.size)
+                magic, msg_type, plen = _HEADER.unpack(header)
+                if magic != MAGIC:
+                    _raise(-8)
+                if plen > MAX_PAYLOAD:
+                    _raise(-7)
+                payload = self._read_exact(plen) if plen else b""
+                (want_crc,) = struct.unpack("<I", self._read_exact(4))
+            except TimeoutError:
+                _raise(-11)
             self.last_recv_t = time.perf_counter()
             crc = zlib.crc32(bytes([msg_type]))
             crc = zlib.crc32(payload, crc)
@@ -276,16 +360,22 @@ class Connection:
 
 def connect(host: str, port: int, timeout_ms: int = 10000,
             force_python: bool = False) -> Connection:
+    """Connect with ``timeout_ms`` bounding the TCP connect AND serving as
+    the connection's default per-recv deadline (a hung peer then faults as
+    :class:`WireTimeout` instead of blocking forever); callers with slower
+    exchanges pass a larger per-call ``recv(timeout=...)``."""
+    default_s = timeout_ms / 1000 if timeout_ms and timeout_ms > 0 else None
     lib = None if force_python else native_lib()
     if lib is not None:
         fd = lib.cw_connect(host.encode(), port, timeout_ms)
         if fd >= 0:
-            return Connection(fd=fd)
+            return Connection(fd=fd, timeout_s=default_s)
         _raise(fd)
     sock = socket.create_connection((host, port), timeout=timeout_ms / 1000)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    _set_keepalive(sock)
     sock.settimeout(None)
-    return Connection(sock=sock)
+    return Connection(sock=sock, timeout_s=default_s)
 
 
 class Listener:
@@ -316,6 +406,10 @@ class Listener:
             return Connection(fd=fd)
         conn, _ = self._sock.accept()
         conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _set_keepalive(conn)
+        # accepted side keeps no default recv deadline: a server waits
+        # indefinitely for the peer's next request; keepalive bounds the
+        # dead-peer case
         return Connection(sock=conn)
 
     def close(self) -> None:

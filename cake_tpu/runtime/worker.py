@@ -424,9 +424,30 @@ class Worker:
                     t_window = time.perf_counter()
                     ops_done = 0
                     bytes_in = bytes_out = 0
+        # A handler thread must never die silently: per-op failures are
+        # answered with ERROR replies above, so anything arriving here is
+        # connection-level (a master that vanished mid-reply, a poisoned
+        # frame stream) or a genuine bug — log it and fall through to the
+        # cleanup either way.
+        except wire.PeerClosed:
+            # abrupt close without GOODBYE (health probe, killed master):
+            # routine from the server's side, not worth a warning
+            log.debug("%s: peer closed without GOODBYE", self.name)
+        except (wire.WireError, OSError) as e:
+            log.warning("%s: connection lost (%s); dropping it", self.name, e)
+        except Exception:
+            log.exception("%s: connection handler crashed; dropping the "
+                          "connection", self.name)
         finally:
             with self._stat_lock:
                 self._conns_live -= 1
+            # Drop this connection's KV caches NOW: the exception paths
+            # above can keep the handler frame alive in traceback refs,
+            # and HBM-backed cache buffers must not stay pinned until GC
+            # gets around to them (a crash-looping client would otherwise
+            # accumulate dead caches).
+            if caches:
+                caches.clear()
             conn.close()
 
     def _run_ops(

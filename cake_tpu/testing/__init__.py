@@ -1,0 +1,13 @@
+"""Deterministic failure-injection utilities (chaos harness).
+
+Test-support code that ships in the package (not under tests/) because
+the CLI's ``--chaos`` dev flag and external integration suites drive the
+same proxy the unit tests do.
+"""
+
+from cake_tpu.testing.chaos import (  # noqa: F401
+    ChaosProxy,
+    Fault,
+    parse_spec,
+    schedule_from_seed,
+)

@@ -62,6 +62,10 @@ int read_full(int fd, uint8_t* buf, size_t len) {
     if (n == 0) return -2;  // peer closed
     if (n < 0) {
       if (errno == EINTR) continue;
+      // SO_RCVTIMEO armed via cw_set_timeout: a wedged peer surfaces as a
+      // distinct timeout code, not a generic io error, so the Python layer
+      // can raise WireTimeout into the master's reconnect+replay recovery
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return -11;
       return -1;
     }
     got += static_cast<size_t>(n);
@@ -75,11 +79,29 @@ int write_full(int fd, const uint8_t* buf, size_t len) {
     ssize_t n = ::send(fd, buf + sent, len - sent, MSG_NOSIGNAL);
     if (n < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return -11;
       return -1;
     }
     sent += static_cast<size_t>(n);
   }
   return 0;
+}
+
+// Keepalive on every connection (both directions of the failure domain):
+// a peer that vanished without a FIN — host power-cut, NAT timeout, cable
+// pull — otherwise leaves recv() blocked forever and, on the worker side,
+// pins that connection's KV caches. Aggressive-ish probing (60s idle,
+// 3x10s probes) because the sockets carry per-token decode traffic, not
+// long-idle control channels.
+void set_keepalive(int fd) {
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof one);
+#ifdef TCP_KEEPIDLE
+  int idle = 60, intvl = 10, cnt = 3;
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof idle);
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof intvl);
+  setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof cnt);
+#endif
 }
 
 }  // namespace
@@ -109,13 +131,14 @@ int cw_connect(const char* host, uint16_t port, int timeout_ms) {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
     if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) {
-      // The timeout only bounds connect(); established-connection reads may
-      // legitimately block for a long time (e.g. the peer is inside an XLA
-      // compile), so clear it — matching the Python fallback's
-      // settimeout(None) after connect.
+      // The timeout passed here only bounds connect(); per-op recv/send
+      // deadlines are armed by the caller via cw_set_timeout (the Python
+      // Connection applies its default — the connect timeout — lazily on
+      // first use), so clear it for a known starting state.
       struct timeval zero = {0, 0};
       setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &zero, sizeof zero);
       setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &zero, sizeof zero);
+      set_keepalive(fd);
       break;
     }
     ::close(fd);
@@ -165,7 +188,18 @@ int cw_accept(int listen_fd) {
   if (fd < 0) return -1;
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  set_keepalive(fd);
   return fd;
+}
+
+// Arm (or clear, ms=0) the recv/send deadline on an established
+// connection. Reads/writes that block past it fail with -11 instead of
+// hanging — the hook behind the Python layer's per-op recv deadlines.
+int cw_set_timeout(int fd, int timeout_ms) {
+  struct timeval tv = {timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+  if (setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv) < 0) return -1;
+  if (setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv) < 0) return -1;
+  return 0;
 }
 
 // Local port of a bound socket (for port-0 auto-assign in tests).
@@ -203,7 +237,8 @@ int cw_send_msg(int fd, uint8_t msg_type, const uint8_t* payload,
 
 // Receive a frame. On success (*payload) is malloc'd (caller frees with
 // cw_free), *len set, returns msg_type (>=0). Negative on error:
-//  -1 io, -2 closed, -8 bad magic, -7 oversized, -9 crc mismatch.
+//  -1 io, -2 closed, -8 bad magic, -7 oversized, -9 crc mismatch,
+//  -11 deadline (cw_set_timeout) expired mid-recv.
 int cw_recv_msg(int fd, uint8_t** payload, uint32_t* len) {
   uint8_t header[9];
   int rc = read_full(fd, header, sizeof header);

@@ -27,6 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) and the smoke targets",
+    )
+
+
 @pytest.fixture(scope="session")
 def tiny_config():
     from cake_tpu.models.config import tiny

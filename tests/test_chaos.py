@@ -1,0 +1,553 @@
+"""Chaos matrix: fault injection at every protocol state must be survivable.
+
+The reference dies on any link fault (SURVEY §5, client.rs:52-61). Here a
+seeded frame-aware proxy (cake_tpu.testing.chaos) kills/stalls/corrupts/
+truncates/blackholes the master<->worker stream at exact frames — at
+handshake, in the ping plane, at the prefill op, and at decode — and every
+greedy stream must come out BIT-IDENTICAL to the fault-free local run (the
+recovery replay is deterministic), or fail with a clear error inside the
+deadline. Plus: replica failover, the hung-peer ``recv`` deadline at the
+wire level, and the consecutive-recovery reset satellites.
+"""
+
+import threading
+import time
+
+import jax
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import flight
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.parallel.runner import RemoteRunner
+from cake_tpu.parallel.topology import Topology
+from cake_tpu.runtime import wire
+from cake_tpu.runtime.generator import LlamaGenerator
+from cake_tpu.runtime.master import DistributedGenerator, build_runners
+from cake_tpu.runtime.retry import RetryPolicy, retry_call
+from cake_tpu.runtime.worker import Worker
+from cake_tpu.testing.chaos import ChaosProxy, parse_spec, schedule_from_seed
+
+CFG = tiny(max_seq_len=64)
+SETTINGS = dict(temperature=0.0, repeat_penalty=1.1)
+PROMPT = [5, 9, 2]
+N_TOK = 7
+
+# request-frame numbers on one master connection (1-based): HELLO, then
+# the CLOCK_PINGS-ping clock exchange, then the first BATCH (prefill)
+PREFILL_F = 2 + RemoteRunner.CLOCK_PINGS
+DECODE_F = PREFILL_F + 1
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _reset_fault_counters():
+    """The injected faults deliberately trip the process-global wire/
+    recovery counters (CRC failures, timeouts, recoveries); later test
+    modules assert those start at zero, so put them back when this
+    module's chaos is over."""
+    from cake_tpu.obs import metrics as obs_metrics
+
+    yield
+    for name in ("wire.crc_failures", "wire.timeouts", "master.recoveries",
+                 "master.failovers", "recover.backoff_ms"):
+        obs_metrics.registry().counter(name).reset()
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(3))
+
+
+def _loader(params):
+    return lambda lo, hi: jax.tree.map(lambda a: a[lo:hi], params["layers"])
+
+
+def _head(params):
+    return {k: params[k] for k in ("embed", "norm_f", "lm_head")}
+
+
+@pytest.fixture(scope="module")
+def golden(params):
+    """Fault-free greedy stream — every chaos case must reproduce it."""
+    g = LlamaGenerator(CFG, params, settings=SamplerSettings(**SETTINGS))
+    g.set_prompt(PROMPT)
+    return [g.next_token(i).id for i in range(N_TOK)]
+
+
+@pytest.fixture(scope="module")
+def worker(params):
+    """One worker serving all layers, shared by the matrix cases (workers
+    are stateless across connections; each case brings its own proxy).
+    Warmed through one fault-free exchange so the tight-op-timeout cases
+    measure a WEDGED peer, never a cold XLA compile."""
+    w = Worker("w", CFG, Topology.from_dict(
+        {"w": {"layers": ["model.layers.0-3"]}}), _loader(params),
+        address="127.0.0.1:0", max_seq=CFG.max_seq_len)
+    w.serve_in_background()
+    g = _gen(f"127.0.0.1:{w.port}", params)
+    g.set_prompt(PROMPT)
+    for i in range(2):  # prefill + decode shapes compiled
+        g.next_token(i)
+    g.close()
+    yield w
+    w.shutdown()
+
+
+def _gen(addr_or_addrs, params, **runner_kw):
+    hosts = ([addr_or_addrs] if isinstance(addr_or_addrs, str)
+             else list(addr_or_addrs))
+    topo = Topology.from_dict({
+        "w": {"host": hosts, "layers": ["model.layers.0-3"]},
+    })
+    runner_kw.setdefault("recover_deadline_s", 5.0)
+    runners = build_runners(CFG, topo, _loader(params), **runner_kw)
+    return DistributedGenerator(CFG, _head(params), runners,
+                                settings=SamplerSettings(**SETTINGS))
+
+
+# -- the matrix --------------------------------------------------------------
+# (spec, runner kwargs, min recoveries) — spec directives apply to
+# successive connections: conn 0 is the build_runners handshake, conn 1 the
+# set_prompt reconnect that carries prefill + decode.
+MATRIX = [
+    # handshake state: killed / refused connects, healed by --connect-retries
+    ("kill@1", dict(connect_retries=2), 0),
+    ("refuse=2", dict(connect_retries=3), 0),
+    # connections absorbed by a multi-connect refuse must NOT consume the
+    # faults scheduled after it: the schedule continues with the build
+    # handshake that finally got through (`none`) and the kill still
+    # fires on the set_prompt connection after it
+    (f"refuse=2,none,kill@{DECODE_F}", dict(connect_retries=3), 1),
+    # ping plane: die mid clock exchange at handshake
+    ("kill@3", dict(connect_retries=2), 0),
+    # prefill op: connection dropped right after the op went out
+    (f"none,kill@{PREFILL_F}", {}, 1),
+    # decode op: drop, cut mid-frame, flip payload bytes (worker-side CRC),
+    # flip reply bytes (master-side CRC)
+    (f"none,kill@{DECODE_F}", {}, 1),
+    (f"none,truncate@{DECODE_F}", {}, 1),
+    (f"none,corrupt@{DECODE_F}", {}, 1),
+    (f"none,corrupt@r{DECODE_F}", {}, 1),
+    # hung peer: reply held past --op-timeout / swallowed forever
+    (f"none,stall@{DECODE_F}=900", dict(op_timeout_s=0.3), 1),
+    (f"none,blackhole@{DECODE_F}", dict(op_timeout_s=0.3), 1),
+]
+
+
+@pytest.mark.parametrize("spec,kw,min_rec", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_chaos_matrix_stream_survives_bit_identical(
+        worker, params, golden, spec, kw, min_rec):
+    with ChaosProxy("127.0.0.1", worker.port, parse_spec(spec)) as proxy:
+        g = _gen(proxy.addr, params, **kw)
+        g.set_prompt(PROMPT)
+        got = [g.next_token(i).id for i in range(N_TOK)]
+        assert got == golden, f"stream diverged under chaos {spec}"
+        assert g.recoveries >= min_rec
+        assert proxy.events, "the scheduled fault never fired"
+        g.close()
+
+
+def test_chaos_failure_inside_deadline(worker, params):
+    """When recovery CANNOT succeed (every reconnect refused), the stream
+    must fail with the give-up error within the configured budgets — not
+    hang, not loop forever."""
+    # conn 0 clean handshake, conn 1 killed at decode, every later
+    # connect refused
+    faults = parse_spec(f"none,kill@{DECODE_F},refuse=1000")
+    with ChaosProxy("127.0.0.1", worker.port, faults) as proxy:
+        g = _gen(proxy.addr, params, recover_deadline_s=0.3)
+        g.set_prompt(PROMPT)
+        g.next_token(0)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="consecutive recovery"):
+            for i in range(1, N_TOK):
+                g.next_token(i)
+        # cap * per-replica budget, plus slack for the jittered backoff
+        assert time.monotonic() - t0 < 10.0
+        g.close()
+
+
+def test_chaos_seed_reproducible():
+    """The acceptance contract: a failure seen under ``--chaos seed=N`` is
+    reproducible from N alone."""
+    assert schedule_from_seed(1337) == schedule_from_seed(1337)
+    assert schedule_from_seed(1337, n=4) == schedule_from_seed(1337, n=4)
+    assert schedule_from_seed(1337) != schedule_from_seed(7331)
+    # specs round-trip through their string form (events log those)
+    fs = parse_spec("kill@7,stall@2=500,corrupt@r3")
+    assert parse_spec(",".join(str(f) for f in fs)) == fs
+
+
+# -- replica failover --------------------------------------------------------
+
+def test_replica_failover_mid_stream(params, golden):
+    """Topology `host:` lists are a failover order: when the primary's
+    recovery deadline expires mid-stream, the segment moves to the next
+    replica, the replay rebuilds its KV, and the greedy stream stays
+    bit-identical. Counters + stats must show the move."""
+    node = Topology.from_dict({"w": {"layers": ["model.layers.0-3"]}})
+    wa = Worker("w", CFG, node, _loader(params), address="127.0.0.1:0",
+                max_seq=CFG.max_seq_len)
+    wb = Worker("w", CFG, node, _loader(params), address="127.0.0.1:0",
+                max_seq=CFG.max_seq_len)
+    wa.serve_in_background()
+    wb.serve_in_background()
+    rec = flight.recorder()
+    rec.clear()
+    rec.enable()
+    try:
+        g = _gen([f"127.0.0.1:{wa.port}", f"127.0.0.1:{wb.port}"], params,
+                 recover_deadline_s=0.4)
+        g.set_prompt(PROMPT)
+        got = [g.next_token(i).id for i in range(3)]
+        wa.shutdown()  # primary gone for good
+        got += [g.next_token(i).id for i in range(3, N_TOK)]
+        assert got == golden
+        assert g.recoveries >= 1 and g.failovers == 1
+        (entry,) = g.runner_stats()
+        assert entry["replica"] == "2/2"
+        assert entry["ident"] == f"127.0.0.1:{wb.port}"
+        assert any(r.get("failover") for r in rec.records())
+        assert any(r.get("recovery") for r in rec.records())
+        g.close()
+    finally:
+        rec.disable()
+        wb.shutdown()
+        wa.shutdown()
+
+
+# -- hung peer at the wire level (satellite) ---------------------------------
+
+@pytest.mark.parametrize("force_py", [False, True],
+                         ids=["native", "python"])
+def test_recv_deadline_fires_on_silent_peer(force_py):
+    """Connection.recv defaults its deadline to the connect timeout (the
+    seed set settimeout(None) and a wedged peer blocked forever); expiry
+    raises WireTimeout, on both transports, in bounded time."""
+    lst = wire.Listener("127.0.0.1", 0, force_python=force_py)
+    try:
+        conn = wire.connect("127.0.0.1", lst.port, timeout_ms=400,
+                            force_python=force_py)
+        assert conn.timeout_s == pytest.approx(0.4)
+        t0 = time.monotonic()
+        with pytest.raises(wire.WireTimeout):
+            conn.recv()  # default deadline = connect timeout
+        assert 0.2 < time.monotonic() - t0 < 5.0
+        conn.close()
+    finally:
+        lst.close()
+
+
+def test_connections_have_keepalive():
+    """TCP keepalive on both ends so a vanished peer (no FIN) eventually
+    faults a blocked recv instead of pinning it — and, worker-side, the
+    connection's KV caches — forever."""
+    import socket as pysocket
+
+    lst = wire.Listener("127.0.0.1", 0, force_python=True)
+    try:
+        server_side = {}
+
+        def srv():
+            server_side["conn"] = lst.accept()
+
+        th = threading.Thread(target=srv, daemon=True)
+        th.start()
+        conn = wire.connect("127.0.0.1", lst.port, force_python=True)
+        th.join(timeout=5)
+        for c in (conn, server_side["conn"]):
+            assert c._sock.getsockopt(pysocket.SOL_SOCKET,
+                                      pysocket.SO_KEEPALIVE) == 1
+        conn.close()
+        server_side["conn"].close()
+    finally:
+        lst.close()
+
+
+def test_native_connection_has_keepalive():
+    import os
+    import socket as pysocket
+
+    if wire.native_lib() is None:
+        pytest.skip("no native wire lib")
+    lst = wire.Listener("127.0.0.1", 0)
+    try:
+        threading.Thread(target=lst.accept, daemon=True).start()
+        conn = wire.connect("127.0.0.1", lst.port)
+        assert conn.is_native
+        probe = pysocket.socket(fileno=os.dup(conn._fd))
+        try:
+            assert probe.getsockopt(pysocket.SOL_SOCKET,
+                                    pysocket.SO_KEEPALIVE) == 1
+        finally:
+            probe.close()
+        conn.close()
+    finally:
+        lst.close()
+
+
+# -- retry/backoff policy (satellite) ----------------------------------------
+
+def test_retry_policy_deadline_budget():
+    """retry_call spends at most the deadline, sleeps with full jitter,
+    and re-raises the LAST transport error on exhaustion."""
+    import random
+
+    calls = {"n": 0}
+    slept = []
+
+    def always_fails():
+        calls["n"] += 1
+        raise OSError(f"down {calls['n']}")
+
+    with pytest.raises(OSError, match="down"):
+        retry_call(always_fails, RetryPolicy(deadline_s=0.5, base_s=0.01),
+                   rng=random.Random(0), sleep=slept.append,
+                   clock=_FakeClock(slept).read)
+    assert calls["n"] >= 2
+    assert all(s <= 2.0 for s in slept)  # cap_s honored
+    # non-transport errors are never retried
+    def config_error():
+        calls["n"] += 1
+        raise RuntimeError("does not serve layers")
+
+    calls["n"] = 0
+    with pytest.raises(RuntimeError):
+        retry_call(config_error, RetryPolicy(deadline_s=5.0))
+    assert calls["n"] == 1
+
+
+class _FakeClock:
+    """Monotonic clock driven by the recorded sleeps (no real waiting)."""
+
+    def __init__(self, slept: list):
+        self._slept = slept
+
+    def read(self) -> float:
+        return sum(self._slept)
+
+
+def test_retry_attempt_cap():
+    calls = {"n": 0}
+
+    def fails():
+        calls["n"] += 1
+        raise OSError("nope")
+
+    with pytest.raises(OSError):
+        retry_call(fails, RetryPolicy(deadline_s=None, max_attempts=3,
+                                      base_s=0.001, cap_s=0.001))
+    assert calls["n"] == 3
+
+
+# -- worker-side failure domain (satellite) ----------------------------------
+
+def test_worker_logs_and_drops_connection_on_stream_fault(worker, caplog):
+    """A connection-level fault in the worker's handler (here: a frame
+    whose CRC check fires) must not kill the thread silently: it is
+    logged, the socket closed, the live-connection count restored — and
+    the worker keeps serving new connections."""
+    import logging
+    import struct
+
+    from cake_tpu.runtime.protocol import MsgType
+
+    live0 = worker._conns_live  # stale handlers from earlier cases may linger
+    conn = wire.connect("127.0.0.1", worker.port, force_python=True)
+    conn.send(MsgType.HELLO)
+    t, _ = conn.recv()
+    assert t == MsgType.WORKER_INFO
+    with caplog.at_level(logging.WARNING, logger="cake_tpu.worker"):
+        # frame with a deliberately wrong CRC trailer: recv() on the
+        # worker raises WireError outside the per-op handler
+        hdr = wire._HEADER.pack(wire.MAGIC, int(MsgType.BATCH), 4)
+        conn._sock.sendall(hdr + b"abcd" + struct.pack("<I", 0xDEADBEEF))
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and worker._conns_live > live0:
+            time.sleep(0.05)
+    assert worker._conns_live <= live0
+    assert any("connection lost" in r.message for r in caplog.records)
+    conn.close()
+    # the worker is still accepting and serving
+    c2 = wire.connect("127.0.0.1", worker.port)
+    c2.send(MsgType.HELLO)
+    t, _ = c2.recv()
+    assert t == MsgType.WORKER_INFO
+    c2.send(MsgType.GOODBYE)
+    c2.close()
+
+
+# -- consecutive-recovery reset (satellite) ----------------------------------
+
+def test_consec_recoveries_reset_per_prompt(worker, params):
+    """The MAX_CONSEC_RECOVERIES cap guards one stream's recovery loop; a
+    long session's recoveries must not accumulate across prompts until a
+    healthy stream trips it spuriously."""
+    g = _gen(f"127.0.0.1:{worker.port}", params)
+    g.set_prompt(PROMPT)
+    g.next_token(0)
+    g._consec_recoveries = DistributedGenerator.MAX_CONSEC_RECOVERIES
+    g.set_prompt(PROMPT)
+    assert g._consec_recoveries == 0
+    g.next_token(0)  # and the fresh stream generates fine
+    g.close()
+
+
+# -- CLI plumbing (make chaos-smoke; slow: subprocess model loads) -----------
+
+@pytest.mark.slow
+def test_cli_chaos_flag_end_to_end(tmp_path):
+    """`--chaos kill@N` on a real master CLI run: the fault fires on the
+    proxied link, recovery replays, and stdout carries the same token ids
+    as the fault-free run."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    from cake_tpu.utils.weights import save_llama_params
+
+    repo = Path(__file__).resolve().parents[1]
+    d = tmp_path / "model"
+    d.mkdir()
+    params = llama.init_params(CFG, jax.random.PRNGKey(0), dtype="float32")
+    save_llama_params(params, d)
+    (d / "config.json").write_text(json.dumps(CFG.to_hf_dict()))
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    topo = tmp_path / "topo.yml"
+    topo.write_text(
+        f"w:\n  host: 127.0.0.1:{port}\n"
+        f"  layers: [model.layers.0-3]\n"
+    )
+    env = dict(os.environ, PYTHONPATH=str(repo), JAX_PLATFORMS="cpu")
+    base = [sys.executable, "-m", "cake_tpu.cli", "--model", str(d),
+            "--topology", str(topo), "--prompt-ids", "5,9,2", "-n", "6",
+            "--temperature", "0.0", "--cpu", "--max-seq", "64"]
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "cake_tpu.cli", "--model", str(d),
+         "--topology", str(topo), "--mode", "worker", "--name", "w",
+         "--cpu", "--address", f"127.0.0.1:{port}", "--max-seq", "64"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:  # wait for the worker to listen
+            try:
+                socket.create_connection(("127.0.0.1", port), 1).close()
+                break
+            except OSError:
+                time.sleep(0.3)
+        clean = subprocess.run(base, env=env, cwd=repo, capture_output=True,
+                               text=True, timeout=240)
+        assert clean.returncode == 0, clean.stderr
+        chaotic = subprocess.run(
+            base + ["--chaos", f"none,kill@{DECODE_F}",
+                    "--recover-deadline", "10"],
+            env=env, cwd=repo, capture_output=True, text=True, timeout=240)
+        assert chaotic.returncode == 0, chaotic.stderr
+        assert "chaos enabled" in chaotic.stderr
+        assert "reconnecting and replaying" in chaotic.stderr
+        assert chaotic.stdout.strip() == clean.stdout.strip()
+    finally:
+        worker.terminate()
+        worker.wait(timeout=10)
+
+
+# -- acceptance smoke (make chaos-smoke) -------------------------------------
+
+def test_chaos_smoke_kill_and_stall_acceptance(params, tmp_path):
+    """ISSUE-4 acceptance: a seeded 2-worker loopback generation survives
+    (a) a worker process kill+restart inside --recover-deadline and (b) a
+    mid-frame stall longer than --op-timeout, with a token stream
+    identical to the fault-free run, counters and flight-record flags
+    reflecting each injected fault, and the seed reproducing the
+    schedule."""
+    topo_a = Topology.from_dict({"a": {"layers": ["model.layers.0-1"]}})
+    topo_b = Topology.from_dict({"b": {"layers": ["model.layers.2-3"]}})
+    wa = Worker("a", CFG, topo_a, _loader(params), address="127.0.0.1:0",
+                max_seq=CFG.max_seq_len)
+    wb = Worker("b", CFG, topo_b, _loader(params), address="127.0.0.1:0",
+                max_seq=CFG.max_seq_len)
+    wa.serve_in_background()
+    wb.serve_in_background()
+    b_port = wb.port
+    restarted: list = []
+
+    # fault-free 2-worker golden stream first (also warms both workers'
+    # XLA compiles — the warm-up run keeps the GENEROUS default op
+    # timeout; only the chaos run below tightens it, to catch the stall)
+    def two_worker_gen(a_addr, op_timeout_s=None):
+        topo = Topology.from_dict({
+            "a": {"host": a_addr, "layers": ["model.layers.0-1"]},
+            "b": {"host": f"127.0.0.1:{b_port}",
+                  "layers": ["model.layers.2-3"]},
+        })
+        return DistributedGenerator(
+            CFG, _head(params),
+            build_runners(CFG, topo, _loader(params),
+                          op_timeout_s=op_timeout_s,
+                          recover_deadline_s=10.0),
+            settings=SamplerSettings(**SETTINGS))
+
+    g0 = two_worker_gen(f"127.0.0.1:{wa.port}")
+    g0.set_prompt(PROMPT)
+    golden2 = [g0.next_token(i).id for i in range(N_TOK)]
+    g0.close()
+
+    # (b) mid-frame stall on worker a's link, longer than --op-timeout.
+    # The schedule is data, reproducible from its string (or seed) form —
+    # the same law schedule_from_seed obeys. The 2s op timeout is tight
+    # enough to catch the 8s stall fast but leaves the restarted worker
+    # room to recompile its jit (a fresh Worker instance pays the XLA
+    # trace again) without burning MAX_CONSEC_RECOVERIES on timeouts.
+    faults = parse_spec(f"none,stall@{DECODE_F}=8000")
+    assert schedule_from_seed(1337) == schedule_from_seed(1337)  # seed law
+    rec = flight.recorder()
+    rec.clear()
+    rec.enable(path=str(tmp_path / "flight.jsonl"))
+    from cake_tpu.obs import metrics as obs_metrics
+
+    recov_ctr = obs_metrics.registry().counter("master.recoveries")
+    recov0 = recov_ctr.value
+    try:
+        with ChaosProxy("127.0.0.1", wa.port, faults) as proxy:
+            g = two_worker_gen(proxy.addr, op_timeout_s=2.0)
+            g.set_prompt(PROMPT)
+            got = [g.next_token(i).id for i in range(3)]  # rides the stall
+            assert g.recoveries >= 1, "stall > op-timeout must recover"
+
+            # (a) kill worker b's PROCESS and restart it on the same port
+            # inside the recovery deadline (the restart races the backoff
+            # loop, which keeps retrying the refused connect)
+            wb.shutdown()
+
+            def restart():
+                time.sleep(0.5)
+                w2 = Worker("b", CFG, topo_b, _loader(params),
+                            address=f"127.0.0.1:{b_port}",
+                            max_seq=CFG.max_seq_len)
+                w2.serve_in_background()
+                restarted.append(w2)
+
+            th = threading.Thread(target=restart, daemon=True)
+            th.start()
+            got += [g.next_token(i).id for i in range(3, N_TOK)]
+            th.join(timeout=10)
+
+            assert got == golden2, "stream diverged across kill + stall"
+            assert g.recoveries >= 2  # one per injected fault
+            assert g.failovers == 0  # no replicas involved: same addresses
+            assert recov_ctr.value - recov0 == g.recoveries
+            recs = rec.records()
+            assert sum(1 for r in recs if r.get("recovery")) >= 2
+            assert proxy.events  # the stall actually fired
+            g.close()
+    finally:
+        rec.close()
+        for w in [wa] + restarted:
+            w.shutdown()

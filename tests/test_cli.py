@@ -288,6 +288,50 @@ def test_missing_config_errors(tmp_path):
     assert "config.json not found" in r.stderr
 
 
+def test_failure_domain_flags_need_host_topology(model_dir):
+    """--recover-deadline/--connect-retries/--op-timeout/--chaos drive
+    cross-host worker links; anywhere else they must error loudly instead
+    of being silently ignored (in-process: the exit fires right after
+    config load)."""
+    from cake_tpu import cli
+
+    for flags, frag in (
+        (["--op-timeout", "5"], "--op-timeout"),
+        (["--chaos", "kill@1"], "--chaos"),
+        (["--connect-retries", "2", "--recover-deadline", "9"],
+         "--connect-retries"),
+    ):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--prompt-ids", "1",
+                      "--cpu", "-n", "1"] + flags)
+        assert frag in str(e.value) and "topology" in str(e.value)
+
+
+def test_op_timeout_zero_rejected(model_dir, tmp_path):
+    """--op-timeout 0 is NOT a 'no deadline' mode (0 would mean disabled
+    to SO_RCVTIMEO but non-blocking to settimeout) — reject it before it
+    can silently reopen the hung-peer hole."""
+    from cake_tpu import cli
+
+    topo = tmp_path / "t.yml"
+    topo.write_text("w:\n  host: 127.0.0.1:1\n  layers: [model.layers.0-3]\n")
+    for flag, val in (("--op-timeout", "0"), ("--recover-deadline", "-1")):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--topology", str(topo),
+                      "--prompt-ids", "1", "--cpu", "-n", "1", flag, val])
+        assert "must exceed 0" in str(e.value)
+
+
+def test_failure_domain_flags_rejected_in_worker_mode(model_dir):
+    from cake_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--model", str(model_dir), "--mode", "worker", "--name",
+                  "w", "--topology", "whatever.yml", "--cpu",
+                  "--chaos", "seed=1"])
+    assert "master process" in str(e.value)
+
+
 def test_string_prompt_without_tokenizer_errors(model_dir):
     r = _run_cli([
         "--model", str(model_dir), "--prompt", "hello", "-n", "1", "--cpu",

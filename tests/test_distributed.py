@@ -321,8 +321,11 @@ def test_worker_down_for_good_still_fails(params):
         "w": {"host": f"127.0.0.1:{w.port}", "layers": ["model.layers.0-3"]},
     })
     settings = SamplerSettings(temperature=0.0)
+    # short recovery budget: this test asserts the permanent-failure path,
+    # not the (default 30s/replica) reconnect patience
     g = DistributedGenerator(CFG, _head_params(params),
-                             build_runners(CFG, topo, _loader(params)),
+                             build_runners(CFG, topo, _loader(params),
+                                           recover_deadline_s=0.3),
                              settings=settings)
     g.set_prompt([1, 2, 3])
     g.next_token(0)

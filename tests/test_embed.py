@@ -165,7 +165,11 @@ def test_c_host_serves_op_end_to_end(bundle, tmp_path):
         x = np.zeros((1, 1, CFG.hidden_size), np.float32)
         conn.send(MsgType.BATCH,
                   protocol.encode_ops(x, [("model.layers.0", 0)]))
-        t, payload = conn.recv()
+        # the connection's default recv deadline is the 1s connect timeout
+        # (fine for the instant HELLO reply above); the first op compiles
+        # in the embedded interpreter, so give it the op-scale headroom a
+        # real master would (--op-timeout semantics)
+        t, payload = conn.recv(timeout=180.0)
         assert t == MsgType.TENSOR
         assert protocol.decode_tensor(payload).shape == x.shape
         conn.close()

@@ -495,7 +495,7 @@ def test_failed_stats_fetch_poisons_stream_and_recovers(params):
         g.set_prompt([3, 5, 7])
         g.next_token(0)
         real_recv = r.conn.recv
-        r.conn.recv = lambda: (_ for _ in ()).throw(
+        r.conn.recv = lambda *a, **k: (_ for _ in ()).throw(
             OSError("simulated recv timeout mid-stats"))
         from cake_tpu.runtime import wire
         with pytest.raises(wire.WireError, match="mid-exchange"):
