@@ -94,14 +94,27 @@ cluster-trace-smoke:
 chaos-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_chaos.py -q -m 'not slow'
 
+# serving smoke: the HTTP request-serving plane (cake_tpu/serve) on a
+# tiny random-weight model — >= 4 concurrent SSE clients with per-stream
+# output identical to their solo runs, a mid-run arrival admitted without
+# stalling running streams, a disconnected client's slot reused, 429 +
+# Retry-After under saturation, drain finishing in-flight work, serve.*
+# series in /metrics, the tokenizer-less prompt_ids path, and the loadgen
+# driver — then the CAKE_BENCH_SERVE end-to-end HTTP tok/s + TTFT row.
+serve-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_serve.py -q -m 'not slow'
+	CAKE_BENCH_SERVE=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
 # from the bench ledger path. Chains the cluster smoke: the trailer and
-# ping planes ride the same hot path the codec numbers come from — and
-# the chaos smoke: recovery machinery must keep surviving what the perf
-# work keeps touching.
-perf-smoke: cluster-trace-smoke chaos-smoke
+# ping planes ride the same hot path the codec numbers come from — the
+# chaos smoke: recovery machinery must keep surviving what the perf
+# work keeps touching — and the serve smoke: the network plane sits on
+# the same engine hot path.
+perf-smoke: cluster-trace-smoke chaos-smoke serve-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -120,4 +133,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke perf-smoke deploy clean

@@ -24,6 +24,11 @@ Knobs (env):
                      flight recorder) through the generator hot path;
                      emits the overhead percentage (`make perf-smoke`
                      bounds the disabled-path micro-cost).
+  CAKE_BENCH_SERVE=1 end-to-end HTTP serving: loadgen clients against the
+                     --mode serve plane (cake_tpu/serve) over the same
+                     engine — aggregate tok/s through the socket plus
+                     TTFT p50/p95, next to the in-process serving rows
+                     (CAKE_BENCH_BATCH sets the client count).
 """
 
 from __future__ import annotations
@@ -622,6 +627,70 @@ def _run_obs_overhead(config, params, preset, quant, dev, steps) -> int:
     return 0
 
 
+def _run_serve_http(config, params, preset, quant, dev, batch,
+                    steps) -> int:
+    """CAKE_BENCH_SERVE=1: END-TO-END HTTP serving — the full network
+    plane (cake_tpu/serve: HTTP accept, JSON/SSE framing, scheduler
+    fan-out) over the same BatchGenerator the in-process serving rows
+    measure. The figure of merit is aggregate tok/s THROUGH the socket
+    plus TTFT p50/p95 as a loadgen client sees them; the gap to the
+    in-process CAKE_BENCH_BATCH/CHURN rows is the serving plane's own
+    overhead. Closed loop at CAKE_BENCH_BATCH concurrency (default floors
+    at 2), 2 requests per client, CAKE_BENCH_STEPS tokens per request."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    kv_quant = _kv_quant()
+    batch = max(2, batch)
+    max_tokens = max(4, min(steps, config.max_seq_len - 16))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = BatchGenerator(config, params, settings=settings,
+                         kv_quant=kv_quant)
+    sched = Scheduler(gen, queue_depth=4 * batch)
+    sched.start(max_concurrent=batch, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        # warm pass: first requests pay decode/admission compiles
+        loadgen.run_load(url, batch, concurrency=batch, max_tokens=4,
+                         prompt_lens=[8], vocab=config.vocab_size - 1,
+                         seed=1)
+        stats = loadgen.run_load(
+            url, 2 * batch, concurrency=batch, max_tokens=max_tokens,
+            prompt_lens=[8], vocab=config.vocab_size - 1, seed=2)
+    finally:
+        srv.close()
+        sched.close()
+    if stats["completed"] != 2 * batch or stats["errors"]:
+        sys.stderr.write(f"serve bench failed: {stats}\n")
+        return 1
+    model_gb = _param_bytes(params) / 1e9
+    roofline = _hbm_gbps(dev) / model_gb
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": (f"serve_http_tokens_per_sec_{_mtag(preset)}_{wtag}_"
+                   f"1chip_c{batch}"),
+        "value": stats["tok_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(stats["tok_s"] / roofline, 4),
+    }, dev,
+        baseline=f"single_stream_hbm_roofline_{roofline:.1f}tok/s",
+        ttft_p50_ms=stats["ttft_ms"]["p50"],
+        ttft_p95_ms=stats["ttft_ms"]["p95"],
+        tpot_p50_ms=stats["tpot_ms"]["p50"],
+        requests=stats["requests"], max_tokens=max_tokens)
+    sys.stderr.write(
+        f"device={dev.device_kind} clients={batch} "
+        f"requests={stats['requests']} http_tok_s={stats['tok_s']} "
+        f"ttft_p50={stats['ttft_ms']['p50']}ms "
+        f"ttft_p95={stats['ttft_ms']['p95']}ms\n"
+    )
+    return 0
+
+
 def _run_churn(config, params, preset, quant, dev, batch, steps,
                multistep) -> int:
     """CAKE_BENCH_CHURN=1: serving under arrival churn. Streams that reach
@@ -1101,6 +1170,9 @@ def main() -> int:
         return _run_ttft(config, params, preset, quant, dev)
     if os.environ.get("CAKE_BENCH_OBS") == "1":
         return _run_obs_overhead(config, params, preset, quant, dev, steps)
+    if os.environ.get("CAKE_BENCH_SERVE") == "1":
+        return _run_serve_http(config, params, preset, quant, dev, batch,
+                               steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

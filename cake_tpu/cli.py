@@ -51,7 +51,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--refetch", action="store_true",
                    help="with --fetch: re-copy/re-download even if --model "
                         "already holds a complete checkpoint")
-    p.add_argument("--mode", choices=["master", "worker"], default="master")
+    p.add_argument("--mode", choices=["master", "worker", "serve"],
+                   default="master",
+                   help="master: one-shot generation (default); worker: "
+                        "serve topology-assigned layers over the wire; "
+                        "serve: network-facing request serving — an HTTP "
+                        "API (POST /v1/completions with SSE streaming, "
+                        "/v1/models, /healthz, plus the / + /metrics "
+                        "status surface) over the continuous-batching "
+                        "engine, with admission queueing, backpressure, "
+                        "cancellation, and graceful SIGTERM drain")
     p.add_argument("--name", default=None, help="worker name in the topology")
     p.add_argument("--address", default="127.0.0.1:10128",
                    help="worker bind address")
@@ -248,6 +257,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "forward p99 exceeds the median of its peers' "
                         "p99s by this factor (cluster report / --top / "
                         "cluster.* gauges; default 2.0)")
+    # -- request serving (--mode serve: cake_tpu/serve) ---------------------
+    p.add_argument("--serve-port", type=int, default=None, dest="serve_port",
+                   metavar="PORT",
+                   help="--mode serve: HTTP port for the serving API "
+                        "(default 8080; 0 = ephemeral). The same port "
+                        "serves / + /metrics, so one scrape sees traffic "
+                        "and observability")
+    p.add_argument("--serve-bind", default=None, dest="serve_bind",
+                   metavar="ADDR",
+                   help="--mode serve: bind interface (default 127.0.0.1 "
+                        "— serving beyond the host is an explicit "
+                        "decision, same policy as --status-bind)")
+    p.add_argument("--max-concurrent", type=int, default=None,
+                   dest="max_concurrent", metavar="N",
+                   help="--mode serve: concurrently decoding streams — "
+                        "the engine's batch slots (default 8; a "
+                        "host-addressed --topology serializes at 1, the "
+                        "single-stream wire path)")
+    p.add_argument("--queue-depth", type=int, default=None,
+                   dest="queue_depth", metavar="N",
+                   help="--mode serve: bounded admission queue; a submit "
+                        "past the bound answers 429 with a Retry-After "
+                        "derived from observed tokens/sec (default 64)")
+    p.add_argument("--request-timeout", type=float, default=None,
+                   dest="request_timeout", metavar="S",
+                   help="--mode serve: per-request deadline from arrival "
+                        "(seconds, default 300): expired requests are "
+                        "refused while queued (504) or retired mid-stream "
+                        "(finish_reason 'timeout'), freeing the slot")
     p.add_argument("--log-level", default="info", dest="log_level",
                    choices=["debug", "info", "warning", "error"],
                    help="root log level for this process (master or worker "
@@ -488,6 +526,281 @@ def run_serve(args) -> int:
     return 0
 
 
+def _serve_flags(args) -> list[str]:
+    """Names of the --mode serve flags the user actually set — they mean
+    nothing on the one-shot master/worker paths."""
+    out = []
+    if args.serve_port is not None:
+        out.append("--serve-port")
+    if args.serve_bind is not None:
+        out.append("--serve-bind")
+    if args.max_concurrent is not None:
+        out.append("--max-concurrent")
+    if args.queue_depth is not None:
+        out.append("--queue-depth")
+    if args.request_timeout is not None:
+        out.append("--request-timeout")
+    return out
+
+
+def run_http_serve(args) -> int:
+    """--mode serve: the network-facing request-serving plane
+    (cake_tpu/serve) — an HTTP API + SLO-aware scheduler over the
+    continuous-batching engine. Runs over every execution path the
+    one-shot master supports: all-local and mesh (--stages/--tp/--sp/--ep
+    or a device-indexed topology) ride BatchGenerator; a host-addressed
+    --topology rides the single-stream wire master behind a one-slot
+    engine adapter (requests serialize, every failure-domain knob still
+    applies)."""
+    import signal
+    import threading
+
+    from cake_tpu import __version__, obs
+    from cake_tpu.obs import metrics as obs_metrics
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.utils.memory import memory_report
+
+    serve_port = args.serve_port if args.serve_port is not None else 8080
+    serve_bind = args.serve_bind or "127.0.0.1"
+    max_concurrent = (args.max_concurrent
+                      if args.max_concurrent is not None else 8)
+    queue_depth = args.queue_depth if args.queue_depth is not None else 64
+    request_timeout = (args.request_timeout
+                       if args.request_timeout is not None else 300.0)
+    if max_concurrent < 1:
+        sys.exit("error: --max-concurrent must be >= 1")
+    if queue_depth < 1:
+        sys.exit("error: --queue-depth must be >= 1")
+    if request_timeout <= 0:
+        sys.exit("error: --request-timeout must exceed 0 (every request "
+                 "needs a deadline; raise it instead of disabling it)")
+    if args.prompts_file or args.prompt_ids:
+        sys.exit("error: --mode serve takes prompts over HTTP "
+                 "(POST /v1/completions); --prompts-file/--prompt-ids "
+                 "belong to the one-shot paths")
+    if args.cluster_report or args.top:
+        sys.exit("error: --cluster-report/--top report on a one-shot "
+                 "master run; --mode serve exposes the same data live on "
+                 "/ and /metrics instead (they would otherwise be "
+                 "silently ignored)")
+    if args.prefill_chunks > 1:
+        sys.exit("error: --prefill-chunks is not supported with --mode "
+                 "serve (arrivals prefill chunk-by-chunk through the "
+                 "admission path instead; it would otherwise be silently "
+                 "ignored)")
+
+    config = _load_config(args)
+    tokenizer = _load_tokenizer(args.model)
+    settings = _settings(args)
+    t0 = time.perf_counter()
+
+    # topology: device-indexed drives the mesh plan, host-addressed the
+    # cross-host wire path (same split as run_master)
+    topology = None
+    topo_mesh = False
+    if args.topology:
+        from cake_tpu.parallel.topology import Topology
+
+        topology = Topology.from_path(args.topology)
+        with_dev = [n.name for n in topology if n.device is not None]
+        without = [n.name for n in topology if n.device is None]
+        if with_dev and without:
+            sys.exit(
+                f"error: topology mixes mesh nodes (device: {with_dev}) "
+                f"with host-addressed workers ({without}); a deployment is "
+                "one or the other"
+            )
+        topo_mesh = bool(with_dev)
+
+    if topology is not None and not topo_mesh:
+        # host-addressed workers: the single-stream wire master behind the
+        # one-slot engine adapter. Concurrency serializes at 1.
+        from cake_tpu.serve.engine import SingleStreamEngine
+
+        if args.stages > 1 or args.tp > 1 or args.sp > 1 or args.ep > 1:
+            sys.exit("error: --stages/--tp/--sp/--ep (single-program mesh) "
+                     "and a host-addressed --topology are mutually "
+                     "exclusive in serve mode too")
+        if args.speculate:
+            sys.exit("error: --speculate is not supported on the "
+                     "host-topology serve path")
+        if args.decode_block is not None or args.lookahead:
+            sys.exit("error: --decode-block/--lookahead need the batched "
+                     "mesh engine; the host-topology serve path "
+                     "single-steps the wire master (they would otherwise "
+                     "be silently ignored)")
+        if max_concurrent > 1:
+            log.warning("--max-concurrent %d: a host-addressed --topology "
+                        "serves over the single-stream wire master; "
+                        "requests serialize through 1 slot",
+                        max_concurrent)
+        engine = SingleStreamEngine(_build_distributed_gen(
+            args, config, topology, tokenizer, settings))
+        warm_len = None
+    else:
+        from cake_tpu.parallel.mesh import MeshPlan
+        from cake_tpu.runtime.batch_generator import BatchGenerator
+
+        flags = _failure_domain_flags(args)
+        if flags:
+            sys.exit(f"error: {'/'.join(flags)} apply to cross-host worker "
+                     "links (a host-addressed --topology); this serve "
+                     "deployment rides the mesh")
+        if args.wire_codec not in (None, "none"):
+            sys.exit("error: --wire-codec applies to cross-host worker "
+                     "hops; this serve deployment rides the mesh")
+        if args.sp > 1 and args.speculate:
+            sys.exit("error: --speculate requires --sp 1 on the serving "
+                     "path")
+        if args.lookahead and args.decode_block == 1:
+            sys.exit("error: --lookahead needs fused blocks to pipeline; "
+                     "it requires --decode-block > 1")
+        try:
+            if topo_mesh:
+                plan = MeshPlan.from_topology(config, topology, tp=args.tp,
+                                              sp=args.sp, ep=args.ep)
+            else:
+                plan = MeshPlan.build(config, num_stages=args.stages,
+                                      tp=args.tp, dp=args.dp, sp=args.sp,
+                                      ep=args.ep)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        params = _mesh_params(args, config, plan)
+        try:
+            engine = BatchGenerator(
+                config, params, plan=plan, tokenizer=tokenizer,
+                settings=settings, max_seq=args.max_seq,
+                block_size=(args.decode_block
+                            if args.decode_block is not None else 8),
+                lookahead=args.lookahead, kv_quant=args.kv_quant,
+                spec_k=args.speculate)
+        except ValueError as e:
+            sys.exit(f"error: {e}")
+        # compile the admission path outside the serving window (requests
+        # of any length share the chunked program for this bucket)
+        warm_len = min(64, engine.max_seq // 2)
+
+    scheduler = Scheduler(engine, queue_depth=queue_depth,
+                          request_timeout_s=request_timeout)
+    scheduler.start(max_concurrent=max_concurrent, warm_prompt_len=warm_len)
+
+    def serve_status():
+        return {
+            "role": "serve",
+            "version": __version__,
+            "model": str(args.model),
+            "scheduler": scheduler.stats(),
+            "metrics": obs_metrics.registry().snapshot(),
+        }
+
+    server = start_api_server(scheduler, status_fn=serve_status,
+                              bind=serve_bind, port=serve_port,
+                              model_id=Path(args.model).name or "cake-tpu")
+    status_httpd = None
+    if args.status_port is not None:
+        # optional standalone status page (byte-identical surface; the API
+        # port already serves / + /metrics)
+        from cake_tpu.obs import statusd
+
+        status_httpd, bound = statusd.start_status_server(
+            serve_status, bind=args.status_bind, port=args.status_port)
+        log.info("status page on http://%s:%d/", args.status_bind, bound)
+    log.info("model loaded in %.1fs (%s); serving on http://%s:%d/ "
+             "(%d slots, queue %d, %ss deadline)",
+             time.perf_counter() - t0, memory_report(), serve_bind,
+             server.port, scheduler.max_concurrent, queue_depth,
+             request_timeout)
+
+    # graceful drain: SIGTERM/SIGINT stop admission, in-flight streams
+    # finish, artifacts flush (the obs handlers/atexit installed in main()
+    # cover --metrics-out/--flight-log; flush_artifacts is also called
+    # explicitly below so a plain serve run still lands them)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info("signal %d: draining (no new admissions; in-flight "
+                 "streams finish)", signum)
+        stop.set()
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        server.drain(timeout_s=request_timeout)
+        if status_httpd is not None:
+            status_httpd.shutdown()
+            status_httpd.server_close()
+        scheduler.close()
+        obs.flush_artifacts()
+        log.info("drained; bye")
+    return 0
+
+
+def _build_distributed_gen(args, config, topology, tokenizer, settings):
+    """Cross-host master over a host-addressed topology (shared by the
+    one-shot master and --mode serve's single-stream engine path): head
+    params + per-segment loaders, optional --chaos proxy wiring, runner
+    handshakes with the failure-domain knobs."""
+    from cake_tpu.runtime.master import DistributedGenerator, build_runners
+    from cake_tpu.utils.weights import load_llama_params
+
+    if args.kv_quant:
+        sys.exit("error: --kv-quant on the master applies to the local "
+                 "and mesh paths; pass it to each worker process "
+                 "instead (workers own their layers' caches)")
+    head = load_llama_params(
+        args.model, config.num_hidden_layers, dtype=config.dtype,
+        layer_range=(0, 0), quantize=args.quantize,
+    )
+
+    def loader(lo, hi):
+        return load_llama_params(
+            args.model, config.num_hidden_layers, dtype=config.dtype,
+            layer_range=(lo, hi), include_embed=False, include_head=False,
+            quantize=args.quantize,
+        )["layers"]
+
+    if args.chaos:
+        # DEV fault injection: one frame-aware chaos proxy per worker
+        # address, each running the same seeded/explicit schedule, and
+        # the topology rewired through them — any failure mode is
+        # reproducible from the spec (or its seed) alone.
+        from cake_tpu.testing import chaos as chaos_mod
+
+        try:
+            faults = chaos_mod.parse_spec(args.chaos)
+        except ValueError as e:
+            sys.exit(f"error: bad --chaos spec: {e}")
+        log.warning("chaos enabled: %s — faults WILL be injected on "
+                    "every worker link",
+                    ", ".join(str(f) for f in faults))
+        for node in topology:
+            wrapped = []
+            for a in (node.hosts or ([node.host] if node.host else [])):
+                host, _, port = a.partition(":")
+                proxy = chaos_mod.ChaosProxy(
+                    host, int(port or 10128), faults).start()
+                wrapped.append(proxy.addr)
+                log.info("chaos proxy %s -> %s", proxy.addr, a)
+            if wrapped:
+                node.hosts = wrapped
+                node.host = wrapped[0]
+
+    try:
+        runners = build_runners(config, topology, loader,
+                                max_seq=args.max_seq,
+                                wire_codec=args.wire_codec or "none",
+                                op_timeout_s=args.op_timeout,
+                                connect_retries=args.connect_retries,
+                                recover_deadline_s=args.recover_deadline)
+    except RuntimeError as e:  # e.g. worker rejects the codec
+        sys.exit(f"error: {e}")
+    return DistributedGenerator(config, head, runners, tokenizer=tokenizer,
+                                settings=settings, max_seq=args.max_seq)
+
+
 def run_master(args) -> int:
     from cake_tpu.utils.memory import memory_report
     from cake_tpu.utils.weights import load_llama_params
@@ -635,61 +948,8 @@ def run_master(args) -> int:
         except ValueError as e:
             sys.exit(f"error: {e}")
     elif args.topology:
-        from cake_tpu.runtime.master import DistributedGenerator, build_runners
-
-        if args.kv_quant:
-            sys.exit("error: --kv-quant on the master applies to the local "
-                     "and mesh paths; pass it to each worker process "
-                     "instead (workers own their layers' caches)")
-        head = load_llama_params(
-            args.model, config.num_hidden_layers, dtype=config.dtype,
-            layer_range=(0, 0), quantize=args.quantize,
-        )
-
-        def loader(lo, hi):
-            return load_llama_params(
-                args.model, config.num_hidden_layers, dtype=config.dtype,
-                layer_range=(lo, hi), include_embed=False, include_head=False,
-                quantize=args.quantize,
-            )["layers"]
-
-        if args.chaos:
-            # DEV fault injection: one frame-aware chaos proxy per worker
-            # address, each running the same seeded/explicit schedule, and
-            # the topology rewired through them — any failure mode is
-            # reproducible from the spec (or its seed) alone.
-            from cake_tpu.testing import chaos as chaos_mod
-
-            try:
-                faults = chaos_mod.parse_spec(args.chaos)
-            except ValueError as e:
-                sys.exit(f"error: bad --chaos spec: {e}")
-            log.warning("chaos enabled: %s — faults WILL be injected on "
-                        "every worker link",
-                        ", ".join(str(f) for f in faults))
-            for node in topology:
-                wrapped = []
-                for a in (node.hosts or ([node.host] if node.host else [])):
-                    host, _, port = a.partition(":")
-                    proxy = chaos_mod.ChaosProxy(
-                        host, int(port or 10128), faults).start()
-                    wrapped.append(proxy.addr)
-                    log.info("chaos proxy %s -> %s", proxy.addr, a)
-                if wrapped:
-                    node.hosts = wrapped
-                    node.host = wrapped[0]
-
-        try:
-            runners = build_runners(config, topology, loader,
-                                    max_seq=args.max_seq,
-                                    wire_codec=args.wire_codec or "none",
-                                    op_timeout_s=args.op_timeout,
-                                    connect_retries=args.connect_retries,
-                                    recover_deadline_s=args.recover_deadline)
-        except RuntimeError as e:  # e.g. worker rejects the codec
-            sys.exit(f"error: {e}")
-        gen = DistributedGenerator(config, head, runners, tokenizer=tokenizer,
-                                   settings=settings, max_seq=args.max_seq)
+        gen = _build_distributed_gen(args, config, topology, tokenizer,
+                                     settings)
     else:
         params = load_llama_params(args.model, config.num_hidden_layers,
                                    dtype=config.dtype, quantize=args.quantize)
@@ -893,9 +1153,15 @@ def main(argv=None) -> int:
             fetch_checkpoint(args.fetch, args.model, force=args.refetch)
         except Exception as e:
             sys.exit(f"error: fetch from {args.fetch} failed: {e}")
+    if args.mode != "serve" and _serve_flags(args):
+        sys.exit(f"error: {'/'.join(_serve_flags(args))} configure the "
+                 "HTTP serving plane; they require --mode serve (they "
+                 "would otherwise be silently ignored)")
     try:
         if args.mode == "worker":
             return run_worker(args)
+        if args.mode == "serve":
+            return run_http_serve(args)
         if args.prompts_file:
             return run_serve(args)
         return run_master(args)

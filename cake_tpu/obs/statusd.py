@@ -2,10 +2,12 @@
 
 One handler shape for every process that exposes itself over HTTP — the
 worker's ``--status-port`` page (the headless stand-in for the reference's
-worker GUI) and, since the cluster-observability pass, the master's own
-``--status-port`` (whose registry additionally carries the merged
-``cluster.*`` series). ``status_fn`` supplies the JSON body; ``/metrics``
-always serves the process-global registry in Prometheus text exposition.
+worker GUI), the master's own ``--status-port`` (whose registry additionally
+carries the merged ``cluster.*`` series), and the serving plane's API port
+(``cake_tpu.serve.api`` mounts these two routes next to its traffic
+endpoints, so one port serves both requests and observability).
+``status_fn`` supplies the JSON body; ``/metrics`` always serves the
+process-global registry in Prometheus text exposition.
 
 Binding defaults to loopback: a status page leaks identity, layer
 assignments, and traffic counters, so exposing it beyond the host is an
@@ -24,6 +26,19 @@ from cake_tpu.obs import metrics as _metrics
 log = logging.getLogger("cake_tpu.obs.statusd")
 
 
+def status_response(status_fn, path: str) -> tuple[bytes, str]:
+    """Body + content type for one status-surface GET: ``/metrics`` is the
+    process-global registry in Prometheus text exposition, anything else is
+    ``status_fn()`` as JSON (which embeds the same registry snapshot under
+    ``metrics``). The ONE place the bytes are built — every server that
+    exposes the surface (``start_status_server`` here, ``serve.api``'s
+    mounted routes) calls this, so their output stays byte-identical."""
+    if path.rstrip("/") == "/metrics":
+        return (_metrics.registry().to_prometheus().encode(),
+                "text/plain; version=0.0.4")
+    return json.dumps(status_fn(), indent=1).encode(), "application/json"
+
+
 def start_status_server(status_fn, bind: str = "127.0.0.1", port: int = 0):
     """Serve ``status_fn()`` as JSON on ``/`` and the metrics registry as
     Prometheus text on ``/metrics``. Returns ``(httpd, bound_port)``;
@@ -32,14 +47,7 @@ def start_status_server(status_fn, bind: str = "127.0.0.1", port: int = 0):
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):  # noqa: N802 (stdlib casing)
-            if self.path.rstrip("/") == "/metrics":
-                # Prometheus text exposition of the same registry the
-                # JSON page embeds under "metrics"
-                body = _metrics.registry().to_prometheus().encode()
-                ctype = "text/plain; version=0.0.4"
-            else:
-                body = json.dumps(status_fn(), indent=1).encode()
-                ctype = "application/json"
+            body, ctype = status_response(status_fn, self.path)
             self.send_response(200)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))

@@ -144,12 +144,18 @@ def moe_swiglu(
     x2d = x.reshape(b * t, h)
     combine, w_topk, idx = router_topk(x2d, router_w, top_k)
 
+    e_local = (w_gate.q if isinstance(w_gate, QuantizedLinear)
+               else w_gate).shape[0]
     if ep_axis is not None and ep_size is None:
-        ep_size = jax.lax.axis_size(ep_axis)
+        # Static ep width from the shapes already in hand: the router
+        # scores the GLOBAL expert set ([H, E_global]) while the weight
+        # arrays hold this rank's local slice ([E_local, ...]), so the
+        # shard count is their ratio. Shape-derived rather than
+        # jax.lax.axis_size so it works on jax versions without that API
+        # (and it must be a Python int — it gates the strategy below).
+        ep_size = combine.shape[1] // e_local
     axes: tuple[str, ...] = ()
     if ep_axis is not None and ep_size > 1:
-        e_local = (w_gate.q if isinstance(w_gate, QuantizedLinear)
-                   else w_gate).shape[0]
         lo = jax.lax.axis_index(ep_axis) * e_local
         combine_local = jax.lax.dynamic_slice_in_dim(combine, lo, e_local, 1)
         out = _moe_dense(x2d, combine_local, w_gate, w_up, w_down)

@@ -54,6 +54,7 @@ from cake_tpu.parallel.mesh import (
     MeshPlan,
     cache_specs,
     param_specs,
+    shard_map,
 )
 
 
@@ -319,7 +320,7 @@ def build_sharded_decode(
 
         in_specs.append(P(DP) if per_row else P())  # index0
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=tuple(in_specs),
@@ -556,7 +557,7 @@ def build_interleaved_decode(
             return toks[0], KVCache(k=ck, v=cv), history, hist_slot
         return toks, KVCache(k=ck, v=cv), history, hist_slot
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=(
@@ -625,7 +626,7 @@ def build_admit_prefill(config: LlamaConfig, plan: MeshPlan,
         logits = _head_logits(params, x_last, config)
         return logits, KVCache(k=ck, v=cv)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=(
@@ -677,7 +678,7 @@ def build_sharded_verify(config: LlamaConfig, plan: MeshPlan,
         logits = _head_logits(params, x, config)  # [T, vocab] f32
         return logits, KVCache(k=ck, v=cv)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=(
@@ -728,7 +729,7 @@ def build_sharded_verify_rows(config: LlamaConfig, plan: MeshPlan,
         logits = _head_logits(params, x, config)
         return logits, KVCache(k=ck, v=cv)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=(
@@ -847,7 +848,7 @@ def build_interleaved_verify_rows(config: LlamaConfig, plan: MeshPlan,
         logits = jax.lax.all_gather(logits, TP, axis=-1, tiled=True)
         return logits, KVCache(k=ck, v=cv)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=(
@@ -963,7 +964,7 @@ def build_sharded_prefill(config: LlamaConfig, plan: MeshPlan,
     ]
     if with_offset:
         in_specs.append(P())
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=plan.mesh,
         in_specs=tuple(in_specs),

@@ -90,7 +90,7 @@ from cake_tpu.parallel.pipeline import (
     build_sharded_decode,
     build_sharded_prefill,
 )
-from cake_tpu.runtime.generator import Token, _bucket
+from cake_tpu.runtime.generator import Token, _bucket, encode_prompt
 from cake_tpu.utils.token_stream import TokenOutputStream
 
 
@@ -515,34 +515,10 @@ class BatchGenerator:
 
     # -- prompt intake -------------------------------------------------------
     def _encode(self, p) -> list[int]:
-        """Tokenize/validate one prompt (the single-stream set_prompt rules:
-        BOS prepend, non-empty, fits the window, ids in vocab range)."""
-        if isinstance(p, str):
-            if self.tokenizer is None:
-                raise ValueError("string prompt requires a tokenizer")
-            enc = self.tokenizer.encode(p)
-            ids = list(getattr(enc, "ids", enc))
-            if self.config.bos_token_id is not None and (
-                not ids or ids[0] != self.config.bos_token_id
-            ):
-                ids = [self.config.bos_token_id] + ids
-        else:
-            ids = list(p)
-        if not ids:
-            raise ValueError("empty prompt")
-        if len(ids) >= self.max_seq:
-            raise ValueError(
-                f"prompt length {len(ids)} >= max_seq {self.max_seq}"
-            )
-        bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
-        if bad:
-            # out-of-range ids would clamp in the embed gather and silently
-            # corrupt just this stream — fail like single-stream set_prompt
-            raise ValueError(
-                f"prompt token ids out of range "
-                f"[0, {self.config.vocab_size}): {bad[:5]}"
-            )
-        return ids
+        """Tokenize/validate one prompt (the shared single-stream
+        set_prompt rules: BOS prepend, non-empty, fits the window, ids in
+        vocab range — ``generator.encode_prompt``)."""
+        return encode_prompt(p, self.tokenizer, self.config, self.max_seq)
 
     def set_prompts(
         self,
@@ -1003,6 +979,35 @@ class BatchGenerator:
         base_new = ((len(ids) - 1) // self._prefix_block) * self._prefix_block
         if base_new >= max(1, self._prefix_share_min):
             self._store_prefix(ids[:base_new], st["cache"])
+
+    def finish(self, stream_id: int) -> bool:
+        """Retire the stream with this ``stream_id`` at ANY point in its
+        lifecycle. Live: it stops emitting and its slot (batch row + KV
+        rows) becomes admissible to the next ``enqueue``/``admit`` arrival
+        — the admission splice overwrites the row in place, so retirement
+        IS the KV free on the batch plane. Still queued in the arrival
+        FIFO, or mid-admission in the staging cache: the arrival is
+        dropped before it can splice in (a server cancelling a request
+        whose prefill never finished must not leak an ownerless stream
+        into a slot). The public serving-side retirement API (a server
+        ending a stream at its token budget, client disconnect, or
+        deadline); EOS/window exhaustion retire streams the same way
+        internally. Returns False when the id is unknown (already done,
+        or never admitted) — retirement races are normal for a server,
+        not errors. Tokens the device already computed for the stream
+        (buffered fused-block rows, an in-flight lookahead block, banked
+        speculation runs) are discarded at emission like any other
+        past-EOS overrun."""
+        for s in self.streams:
+            if s.active and not s.done and s.stream_id == stream_id:
+                s.done = True
+                return True
+        if self._staging is not None and self._staging["sid"] == stream_id:
+            self._staging = None  # staged KV row is dropped with it
+            return True
+        n0 = len(self._arrivals)
+        self._arrivals = [a for a in self._arrivals if a[1] != stream_id]
+        return len(self._arrivals) != n0
 
     def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
         """Admit a new prompt into a finished slot of a RUNNING batch,

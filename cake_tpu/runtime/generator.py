@@ -55,6 +55,37 @@ class Token:
     is_end_of_stream: bool
 
 
+def encode_prompt(prompt, tokenizer, config, max_seq: int) -> list[int]:
+    """THE prompt-intake rules, shared by every serving surface (the
+    single-stream generators, the batch engine, and the HTTP plane's
+    adapters): strings tokenize with a BOS prepend, id lists pass through
+    as-is; reject empty prompts, prompts that fill the window, and
+    out-of-range ids (which would clamp in the embed gather and silently
+    corrupt just this stream)."""
+    if isinstance(prompt, str):
+        if tokenizer is None:
+            raise ValueError("string prompt requires a tokenizer")
+        enc = tokenizer.encode(prompt)
+        ids = list(getattr(enc, "ids", enc))
+        if config.bos_token_id is not None and (
+            not ids or ids[0] != config.bos_token_id
+        ):
+            ids = [config.bos_token_id] + ids
+    else:
+        ids = list(prompt)
+    if not ids:
+        raise ValueError("empty prompt")
+    if len(ids) >= max_seq:
+        raise ValueError(f"prompt length {len(ids)} >= max_seq {max_seq}")
+    bad = [t for t in ids if not (0 <= t < config.vocab_size)]
+    if bad:
+        raise ValueError(
+            f"prompt token ids out of range [0, {config.vocab_size}): "
+            f"{bad[:5]}"
+        )
+    return ids
+
+
 def _bucket(n: int, max_seq: int, floor: int = 16) -> int:
     b = floor
     while b < n:
@@ -177,26 +208,8 @@ class GeneratorBase:
 
     # -- prompt handling ----------------------------------------------------
     def set_prompt(self, prompt: str | list[int]) -> None:
-        if isinstance(prompt, str):
-            if self.tokenizer is None:
-                raise ValueError("string prompt requires a tokenizer")
-            ids = self.tokenizer.encode(prompt)
-            ids = getattr(ids, "ids", ids)  # HF tokenizers Encoding vs list
-            if self.config.bos_token_id is not None and (
-                not ids or ids[0] != self.config.bos_token_id
-            ):
-                ids = [self.config.bos_token_id] + list(ids)
-        else:
-            ids = list(prompt)
-        if not ids:
-            raise ValueError("empty prompt")
-        if len(ids) >= self.max_seq:
-            raise ValueError(f"prompt length {len(ids)} >= max_seq {self.max_seq}")
-        bad = [t for t in ids if not (0 <= t < self.config.vocab_size)]
-        if bad:
-            raise ValueError(
-                f"prompt token ids out of range [0, {self.config.vocab_size}): {bad[:5]}"
-            )
+        ids = encode_prompt(prompt, self.tokenizer, self.config,
+                            self.max_seq)
         self._prompt_tokens = ids
         # Reset all per-stream state so a generator can serve a new prompt
         # (the stale KV beyond the new prompt is invisible under the causal

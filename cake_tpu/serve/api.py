@@ -1,0 +1,305 @@
+"""Network-facing serving API: completions over HTTP, stdlib-only.
+
+A ``ThreadingHTTPServer`` (the ``obs/statusd.py`` shape) in front of the
+scheduler:
+
+- ``POST /v1/completions`` — JSON body: ``prompt`` (text, needs the
+  engine's tokenizer) or ``prompt_ids`` (the CLI ``--prompt-ids`` escape
+  hatch), ``max_tokens``, ``stream``. Sampler knobs (``temperature`` /
+  ``top_k`` / ``top_p`` / ``seed``) are accepted only when they match the
+  settings the server was started with — the engine compiles ONE sampler
+  into its programs, and silently ignoring a mismatch would be worse than
+  refusing it. ``stream: true`` answers Server-Sent Events, one event per
+  token (text incrementally detokenized by the engine's
+  ``TokenOutputStream``), final event carrying the usage stats;
+  ``stream: false`` answers one JSON object.
+- ``GET /v1/models`` / ``GET /healthz`` — discovery and liveness.
+- ``GET /`` + ``GET /metrics`` — the exact statusd surface
+  (``obs.statusd.status_response``), so one port serves traffic AND
+  observability and stays byte-identical with a standalone
+  ``--status-port`` page.
+
+Backpressure: a full admission queue answers ``429`` with a
+``Retry-After`` derived from observed tokens/sec; a draining server
+answers ``503``. Handler threads never touch the engine — they hand
+sessions to the scheduler and pump its event queues, so a slow client
+can only ever stall its own stream.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import logging
+import threading
+
+from cake_tpu.obs import statusd as _statusd
+from cake_tpu.serve.scheduler import Draining, QueueFull
+from cake_tpu.serve.session import Session, sse_event
+
+log = logging.getLogger("cake_tpu.serve.api")
+
+_SAMPLER_KNOBS = ("temperature", "top_k", "top_p", "seed")
+
+
+def _parse_request(body: dict, scheduler) -> Session:
+    """Validate one completions body into a Session (raises ValueError
+    with a client-facing message)."""
+    if not isinstance(body, dict):
+        raise ValueError("body must be a JSON object")
+    prompt = body.get("prompt")
+    prompt_ids = body.get("prompt_ids")
+    if (prompt is None) == (prompt_ids is None):
+        raise ValueError("exactly one of 'prompt' or 'prompt_ids' required")
+    if prompt is not None:
+        if not isinstance(prompt, str):
+            raise ValueError("'prompt' must be a string")
+        ids = scheduler.encode_prompt(prompt)
+    else:
+        if (not isinstance(prompt_ids, list)
+                or not all(isinstance(t, int) for t in prompt_ids)):
+            raise ValueError("'prompt_ids' must be a list of ints")
+        ids = scheduler.encode_prompt(prompt_ids)
+    max_tokens = body.get("max_tokens", 16)
+    if not isinstance(max_tokens, int) or max_tokens < 1:
+        raise ValueError("'max_tokens' must be a positive int")
+    stream = body.get("stream", False)
+    if not isinstance(stream, bool):
+        raise ValueError("'stream' must be a boolean")
+    settings = scheduler.engine.settings
+    for knob in _SAMPLER_KNOBS:
+        if knob in body and body[knob] != getattr(settings, knob):
+            raise ValueError(
+                f"per-request '{knob}' is not supported: the engine "
+                f"compiles one sampler (server runs {knob}="
+                f"{getattr(settings, knob)!r}); omit it or match the "
+                "server's value"
+            )
+    timeout = body.get("timeout_s", scheduler.request_timeout_s)
+    if timeout is not None and (
+        not isinstance(timeout, (int, float)) or timeout <= 0
+    ):
+        raise ValueError("'timeout_s' must be a positive number")
+    return Session(ids, max_tokens=max_tokens, stream=stream,
+                   timeout_s=timeout)
+
+
+class ApiServer:
+    """The serving front end; ``start_api_server`` is the entry point."""
+
+    def __init__(self, scheduler, status_fn=None, bind: str = "127.0.0.1",
+                 port: int = 0, model_id: str = "cake-tpu"):
+        self.scheduler = scheduler
+        self.model_id = model_id
+        if status_fn is None:
+            def status_fn():
+                from cake_tpu.obs import metrics as obs_metrics
+
+                return {"role": "serve", "model": model_id,
+                        "scheduler": scheduler.stats(),
+                        "metrics": obs_metrics.registry().snapshot()}
+        self.status_fn = status_fn
+        handler = _make_handler(self)
+        self.httpd = http.server.ThreadingHTTPServer((bind, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.bind = bind
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True, name="cake-serve-http")
+
+    def start(self) -> "ApiServer":
+        self._thread.start()
+        return self
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Graceful shutdown: stop admitting, let in-flight streams finish
+        (bounded by ``timeout_s``), then stop the listener."""
+        self.scheduler.stop(drain=True, timeout_s=timeout_s)
+        self.close()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def start_api_server(scheduler, status_fn=None, bind: str = "127.0.0.1",
+                     port: int = 0, model_id: str = "cake-tpu") -> ApiServer:
+    """Build + start an :class:`ApiServer`; returns it with ``.port``
+    bound (``port=0`` picks an ephemeral one)."""
+    return ApiServer(scheduler, status_fn=status_fn, bind=bind, port=port,
+                     model_id=model_id).start()
+
+
+def _make_handler(server: ApiServer):
+    scheduler = server.scheduler
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug("api: " + fmt, *args)
+
+        # -- small reply helpers ------------------------------------------
+        def _json(self, status: int, obj: dict,
+                  headers: dict | None = None) -> None:
+            body = json.dumps(obj, indent=1).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, status: int, message: str,
+                   headers: dict | None = None) -> None:
+            self._json(status, {"error": message}, headers)
+
+        # -- GET: health, discovery, status surface -----------------------
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            path = self.path.rstrip("/") or "/"
+            if path == "/healthz":
+                st = scheduler.stats()
+                # a draining server must fail the probe at the STATUS
+                # level: balancers route on the code, not the body
+                self._json(200 if not st["draining"] else 503, {
+                    "ok": not st["draining"],
+                    "draining": st["draining"],
+                    "queued": st["queued"],
+                    "running": st["running"],
+                })
+            elif path == "/v1/models":
+                eng = scheduler.engine
+                self._json(200, {"object": "list", "data": [{
+                    "id": server.model_id,
+                    "object": "model",
+                    "max_seq": eng.max_seq,
+                    "max_concurrent": scheduler.max_concurrent,
+                    "tokenizer": eng.tokenizer is not None,
+                }]})
+            elif path in ("/", "/metrics"):
+                # byte-identical with a standalone statusd page: both
+                # build through obs.statusd.status_response
+                body, ctype = _statusd.status_response(server.status_fn,
+                                                       path)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._error(404, f"no route for GET {self.path}")
+
+        # -- POST: completions --------------------------------------------
+        def do_POST(self):  # noqa: N802 (stdlib casing)
+            if self.path.rstrip("/") != "/v1/completions":
+                self._error(404, f"no route for POST {self.path}")
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._error(400, f"bad JSON body: {e}")
+                return
+            try:
+                sess = _parse_request(body, scheduler)
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            try:
+                scheduler.submit(sess)
+            except QueueFull as e:
+                # never block the accept loop: full queue answers 429 with
+                # the observed-throughput Retry-After hint
+                self._error(429, str(e), headers={
+                    "Retry-After": str(max(1, round(e.retry_after_s)))})
+                return
+            except Draining:
+                self._error(503, "server is draining")
+                return
+            if sess.stream:
+                self._stream_response(sess)
+            else:
+                self._unary_response(sess)
+
+        def _next_event(self, sess):
+            """Block on the session queue, but never past a dead engine
+            thread (its _abort_all is what normally wakes us)."""
+            import queue as _q
+
+            while True:
+                try:
+                    return sess.events.get(timeout=0.5)
+                except _q.Empty:
+                    t = scheduler._thread
+                    if t is None or not t.is_alive():
+                        return ("error", 503, "engine thread died")
+
+        def _stream_response(self, sess) -> None:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            index = 0
+            try:
+                while True:
+                    ev = self._next_event(sess)
+                    if ev[0] == "token":
+                        _, tok_id, text = ev
+                        self.wfile.write(sse_event(
+                            {"index": index, "token": tok_id,
+                             "text": text}))
+                        index += 1
+                    elif ev[0] == "done":
+                        _, reason, usage, tail = ev
+                        self.wfile.write(sse_event(
+                            {"id": sess.id, "done": True,
+                             "finish_reason": reason, "usage": usage,
+                             "text": tail}))
+                        self.wfile.write(sse_event("[DONE]"))
+                        self.wfile.flush()
+                        return
+                    else:  # error
+                        _, status, message = ev
+                        self.wfile.write(sse_event(
+                            {"id": sess.id, "error": message,
+                             "status": status}))
+                        self.wfile.flush()
+                        return
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                # the client went away mid-stream: retire the stream so
+                # its slot and KV row go back to the admission queue
+                scheduler.cancel(sess)
+
+        def _unary_response(self, sess) -> None:
+            texts: list[str] = []
+            while True:
+                ev = self._next_event(sess)
+                if ev[0] == "token":
+                    if ev[2]:
+                        texts.append(ev[2])
+                elif ev[0] == "done":
+                    _, reason, usage, tail = ev
+                    if tail:
+                        texts.append(tail)
+                    out = {
+                        "id": sess.id,
+                        "model": server.model_id,
+                        "finish_reason": reason,
+                        "usage": usage,
+                        "token_ids": list(sess.generated),
+                    }
+                    if scheduler.engine.tokenizer is not None:
+                        out["text"] = "".join(texts)
+                    try:
+                        self._json(200, out)
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass
+                    return
+                else:
+                    _, status, message = ev
+                    try:
+                        self._error(status, message)
+                    except (BrokenPipeError, ConnectionResetError, OSError):
+                        pass
+                    return
+
+    return Handler

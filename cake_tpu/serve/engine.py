@@ -1,0 +1,122 @@
+"""Single-stream engine adapter: the BatchGenerator surface over one slot.
+
+The scheduler (``serve/scheduler.py``) speaks only the ``BatchGenerator``
+serving API — ``streams`` / ``enqueue`` / ``step`` / ``finish`` /
+``pending_admissions`` / ``stats``. That keeps it engine-agnostic, and this
+adapter is what buys "serve over every execution path the one-shot master
+supports": a single-stream generator (``LlamaGenerator``,
+``MeshGenerator``, or the cross-host ``DistributedGenerator`` — anything
+built on ``runtime.generator.GeneratorBase``) is presented as a one-slot
+batch engine, so ``--mode serve`` works on a host-addressed ``--topology``
+deployment too. Requests serialize through the single slot (admission
+waits for the running stream to retire); the batched mesh paths go through
+``BatchGenerator`` directly and never touch this file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from cake_tpu.runtime.generator import Token, encode_prompt
+from cake_tpu.utils.token_stream import TokenOutputStream
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Mirror of ``batch_generator._Stream``'s serving-visible fields."""
+
+    stream_id: int
+    prompt: list[int]
+    generated: list[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    active: bool = True
+    detok: TokenOutputStream | None = None
+
+
+class SingleStreamEngine:
+    """One-slot ``BatchGenerator`` facade over a ``GeneratorBase``."""
+
+    def __init__(self, gen):
+        self.gen = gen
+        self.config = gen.config
+        self.tokenizer = gen.tokenizer
+        self.settings = gen.settings
+        self.max_seq = gen.max_seq
+        self._eos_ids = set(self.config.eos_ids())
+        # the slot starts retired: nothing is admitted until the first
+        # arrival, exactly like a primed batch engine's done slots
+        self.streams: list[_Slot] = [_Slot(stream_id=-1, prompt=[],
+                                           done=True)]
+        self._arrivals: list[tuple[list[int], int]] = []
+        self._index = 0
+        self._n_emitted = 0
+        self._t_start = time.perf_counter()
+
+    # -- BatchGenerator API subset -------------------------------------------
+    def _encode(self, p) -> list[int]:
+        """The shared prompt-intake rules (``generator.encode_prompt``),
+        without mutating generator state."""
+        return encode_prompt(p, self.tokenizer, self.config, self.max_seq)
+
+    def enqueue(self, prompt, stream_id: int) -> None:
+        self._arrivals.append((self._encode(prompt), stream_id))
+
+    def pending_admissions(self) -> int:
+        return len(self._arrivals)
+
+    def finish(self, stream_id: int) -> bool:
+        """Retire by id at any lifecycle point — live in the slot, or
+        still waiting in the arrival queue (same contract as
+        ``BatchGenerator.finish``)."""
+        s = self.streams[0]
+        if s.active and not s.done and s.stream_id == stream_id:
+            s.done = True
+            return True
+        n0 = len(self._arrivals)
+        self._arrivals = [a for a in self._arrivals if a[1] != stream_id]
+        return len(self._arrivals) != n0
+
+    def step(self) -> list[Token | None]:
+        """Advance the slot one token; admit the next queued arrival when
+        the slot is free (its prefill runs inside the wrapped generator's
+        ``set_prompt``/first ``next_token``, which also resets the
+        generator's KV state — retirement IS the KV free here too)."""
+        s = self.streams[0]
+        if s.done and self._arrivals:
+            ids, sid = self._arrivals.pop(0)
+            self.gen.set_prompt(ids)
+            s = _Slot(stream_id=sid, prompt=ids, detok=self.gen.stream)
+            self.streams[0] = s
+            self._index = 0
+        if s.done:
+            return [None]
+        tok = self.gen.next_token(self._index)
+        self._index += 1
+        s.generated.append(tok.id)
+        window_full = len(s.prompt) + len(s.generated) >= self.max_seq
+        s.done = tok.is_end_of_stream or window_full
+        self._n_emitted += 1
+        return [Token(id=tok.id, text=tok.text,
+                      is_end_of_stream=s.done)]
+
+    def drain(self) -> None:
+        pass  # single-step path: nothing buffered device-side
+
+    def stats(self) -> dict:
+        wall = time.perf_counter() - self._t_start
+        s = self.streams[0]
+        return {
+            "streams_live": int(s.active and not s.done),
+            "streams_done": int(s.active and s.done and s.prompt != []),
+            "pending_admissions": len(self._arrivals),
+            "tokens_emitted": self._n_emitted,
+            "wall_s": round(wall, 3),
+            "aggregate_tok_s": (
+                round(self._n_emitted / wall, 2) if wall > 0 else None
+            ),
+        }
+
+    def close(self) -> None:
+        if hasattr(self.gen, "close"):
+            self.gen.close()

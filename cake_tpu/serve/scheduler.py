@@ -1,0 +1,353 @@
+"""SLO-aware request scheduler: ONE engine-owner thread over the batch plane.
+
+The continuous-batching engine (``runtime.batch_generator.BatchGenerator``)
+is single-threaded by design — every ``step()`` mutates device state. The
+scheduler is the concurrency boundary that turns it into a service: HTTP
+handler threads only ``submit``/``cancel`` sessions through a lock, and one
+engine thread — the only caller of the engine, ever — admits queued
+arrivals into free slots (``enqueue``; the engine interleaves each
+arrival's prefill with the running batch's decode), runs ``step()``
+continuously while work exists, idle-parks on a condition variable
+otherwise, fans each emitted row out to per-session event queues, and
+retires streams on EOS, ``max_tokens``, client disconnect, or deadline
+expiry (``finish`` frees the slot and its KV row for the next arrival).
+
+Backpressure is explicit, never blocking: the admission queue is bounded
+(``queue_depth``); a submit past the bound raises :class:`QueueFull`
+carrying a ``Retry-After`` estimate derived from the observed aggregate
+tokens/sec (outstanding token budget / recent throughput) — the API layer
+turns it into a ``429`` without ever stalling the accept loop.
+
+Iteration-level scheduling is the Orca lesson and continuous batching the
+vLLM one; both live in the engine already — this layer adds what a service
+needs around them: admission, fairness (FIFO arrival order), deadlines,
+cancellation, and drain.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+
+from cake_tpu.serve import session as _session
+from cake_tpu.serve.session import Session
+
+log = logging.getLogger("cake_tpu.serve.scheduler")
+
+
+class QueueFull(Exception):
+    """Admission queue at capacity; ``retry_after_s`` is the backpressure
+    hint (seconds until a slot is plausibly free, from observed tok/s)."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(f"admission queue full; retry in {retry_after_s:g}s")
+        self.retry_after_s = retry_after_s
+
+
+class Draining(Exception):
+    """The scheduler stopped admitting (SIGTERM drain in progress)."""
+
+
+class Scheduler:
+    """Own the engine; serve sessions.
+
+    ``engine`` is a ``BatchGenerator`` (or anything with its serving API —
+    see ``serve.engine.SingleStreamEngine`` for the single-stream paths).
+    ``start()`` primes it and launches the engine thread; ``stop()`` drains
+    or aborts. Thread contract: public methods are handler-safe; everything
+    touching the engine runs on the engine thread only.
+    """
+
+    def __init__(self, engine, queue_depth: int = 64,
+                 request_timeout_s: float | None = None):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.engine = engine
+        self.queue_depth = queue_depth
+        self.request_timeout_s = request_timeout_s
+        self.max_concurrent = 0  # set by start() (dp may pad the batch up)
+        self._queue: deque[Session] = deque()
+        self._by_sid: dict[int, Session] = {}
+        self._next_sid = 0
+        self._cond = threading.Condition()
+        self._thread: threading.Thread | None = None
+        self._stopping = False
+        self._draining = False
+        # observed-throughput window for the Retry-After estimate
+        self._rate_tokens = 0
+        self._rate_t0 = time.perf_counter()
+        self._tok_s = 0.0
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, max_concurrent: int = 4,
+              warm_prompt_len: int | None = None) -> None:
+        """Prime the engine with ``max_concurrent`` retired slots and start
+        the engine thread. A batch engine needs a live batch before
+        ``enqueue`` can splice arrivals into it, so priming runs one
+        minimal ``set_prompts`` and retires every slot immediately — every
+        real request then rides the continuous-admission path. With
+        ``warm_prompt_len``, the admission-prefill program is compiled here
+        too, outside the serving window (``warm_admission``)."""
+        if self._thread is not None:
+            raise RuntimeError("scheduler already started")
+        if max_concurrent < 1:
+            raise ValueError(
+                f"max_concurrent must be >= 1, got {max_concurrent}")
+        if not self.engine.streams:
+            cfg = self.engine.config
+            tok = cfg.bos_token_id if cfg.bos_token_id is not None else 0
+            self.engine.set_prompts([[tok]] * max_concurrent)
+            for s in self.engine.streams:
+                s.done = True
+        # dp padding may have grown the batch; padded rows are admissible
+        # slots too, so serve them rather than leaving them dummy rows
+        self.max_concurrent = len(self.engine.streams)
+        self._next_sid = self.max_concurrent  # clear of the priming ids
+        if warm_prompt_len and hasattr(self.engine, "warm_admission"):
+            self.engine.warm_admission(warm_prompt_len)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cake-serve-engine")
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop serving. ``drain=True`` (the SIGTERM path): stop admitting
+        — queued-but-unadmitted sessions are refused with a 503 — finish
+        every in-flight stream, then park the thread. ``drain=False``:
+        abort in-flight streams with an error event."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                self._stopping = True
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None:
+            deadline = time.monotonic() + timeout_s
+            while t.is_alive() and time.monotonic() < deadline:
+                t.join(timeout=0.1)
+            if t.is_alive():
+                # in-flight streams outlived the budget: hard-stop
+                with self._cond:
+                    self._stopping = True
+                    self._cond.notify_all()
+                t.join(timeout=5.0)
+
+    def close(self) -> None:
+        self.stop(drain=False, timeout_s=5.0)
+        if hasattr(self.engine, "close"):
+            self.engine.close()
+
+    # -- handler-side API -----------------------------------------------------
+    def encode_prompt(self, prompt) -> list[int]:
+        """Engine intake rules (tokenize, BOS, window/vocab bounds) without
+        touching engine state — safe from handler threads (the tokenizer
+        is stateless per encode)."""
+        return self.engine._encode(prompt)
+
+    def submit(self, sess: Session) -> None:
+        """Queue a session FIFO (raises :class:`QueueFull` past the bound,
+        :class:`Draining` during shutdown). Never blocks on the engine."""
+        with self._cond:
+            if self._draining:
+                raise Draining()
+            # admission is asynchronous, so a submit destined for a free
+            # slot sits in the queue for one engine-thread pass; the bound
+            # is therefore on WAITING requests — total outstanding is
+            # capped at max_concurrent + queue_depth
+            free = max(0, self.max_concurrent - len(self._by_sid))
+            if len(self._queue) >= self.queue_depth + free:
+                _session.REJECTED.inc()
+                raise QueueFull(self.retry_after_s())
+            if self.request_timeout_s and sess.deadline is None:
+                sess.deadline = sess.t_submit + self.request_timeout_s
+            self._queue.append(sess)
+            _session.QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+
+    def cancel(self, sess: Session) -> None:
+        """Flag a session whose client went away; the engine thread frees
+        its slot (or drops it from the queue) at the next loop pass."""
+        sess.cancelled.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def retry_after_s(self) -> float:
+        """Backpressure hint: outstanding token budget over the observed
+        aggregate tokens/sec, clamped to something a client can act on."""
+        with self._cond:
+            remaining = sum(
+                max(1, s.max_tokens - len(s.generated))
+                for s in self._by_sid.values()
+            ) + sum(s.max_tokens for s in self._queue)
+        rate = self._tok_s
+        if rate <= 0:
+            return 2.0
+        return min(max(remaining / rate, 1.0), 120.0)
+
+    def stats(self) -> dict:
+        with self._cond:
+            queued = len(self._queue)
+            running = len(self._by_sid)
+        return {
+            "queued": queued,
+            "running": running,
+            "max_concurrent": self.max_concurrent,
+            "queue_depth": self.queue_depth,
+            "draining": self._draining,
+            "observed_tok_s": round(self._tok_s, 2),
+            "engine": self.engine.stats(),
+        }
+
+    # -- engine thread --------------------------------------------------------
+    def _has_work_locked(self) -> bool:
+        return bool(self._queue or self._by_sid
+                    or self.engine.pending_admissions())
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                self._expire_queued_locked()
+                while not self._stopping and not self._has_work_locked():
+                    if self._draining:
+                        break  # drained dry: park
+                    self._cond.wait(timeout=0.1)
+                    self._expire_queued_locked()
+                if self._stopping or (self._draining
+                                      and not self._has_work_locked()):
+                    break
+            try:
+                self._admit()
+                row = self.engine.step()
+                self._deliver(row)
+                self._retire()
+            except Exception as e:  # engine fault: fail every session
+                log.exception("engine thread fault: %s", e)
+                with self._cond:
+                    # flip to draining BEFORE aborting: a dead engine must
+                    # refuse new work (submit -> 503, /healthz -> 503) —
+                    # otherwise submissions queue behind a thread that
+                    # will never serve them and the balancer keeps
+                    # routing traffic here
+                    self._draining = True
+                self._abort_all(f"engine failure: {e}")
+                return
+        self._abort_all("server shutting down")
+
+    def _expire_queued_locked(self) -> None:
+        """Refuse queued sessions past their arrival deadline (and drop
+        cancelled ones) without spending engine work on them. During a
+        drain, everything still queued is refused."""
+        now = time.perf_counter()
+        keep: deque[Session] = deque()
+        for s in self._queue:
+            if s.cancelled.is_set():
+                _session.CANCELLED.inc()
+            elif self._draining:
+                s.fail(503, "server is draining; retry against a peer")
+            elif s.deadline is not None and now > s.deadline:
+                _session.TIMEOUTS.inc()
+                s.fail(504, "deadline expired while queued")
+            else:
+                keep.append(s)
+        if len(keep) != len(self._queue):
+            self._queue = keep
+            _session.QUEUE_DEPTH.set(len(self._queue))
+
+    def _admit(self) -> None:
+        """Move queued sessions into the engine while slots are spoken
+        for < max_concurrent (the engine interleaves each arrival's
+        prefill with decode; its own FIFO keeps arrival order)."""
+        while True:
+            with self._cond:
+                if not self._queue or len(self._by_sid) >= self.max_concurrent:
+                    return
+                sess = self._queue.popleft()
+                _session.QUEUE_DEPTH.set(len(self._queue))
+                sid = self._next_sid
+                self._next_sid += 1
+            try:
+                self.engine.enqueue(sess.prompt_ids, sid)
+            except ValueError as e:  # encode raced the window, etc.
+                sess.fail(400, str(e))
+                continue
+            sess.stream_id = sid
+            with self._cond:
+                self._by_sid[sid] = sess
+
+    def _deliver(self, row) -> None:
+        """Fan one emitted row out to its sessions' event queues."""
+        n = 0
+        for slot, tok in enumerate(row):
+            if tok is None:
+                continue
+            sess = self._by_sid.get(self.engine.streams[slot].stream_id)
+            if sess is None:
+                continue  # priming/dummy slot, or already aborted
+            sess.on_token(tok.id, tok.text)
+            n += 1
+            if tok.is_end_of_stream:
+                sess.finish_reason = (
+                    "stop" if tok.id in getattr(self.engine, "_eos_ids", ())
+                    else "length"  # window exhausted
+                )
+        if n:
+            self._rate_tokens += n
+            dt = time.perf_counter() - self._rate_t0
+            if dt >= 0.5:
+                # sliding half-life blend: recent throughput dominates
+                inst = self._rate_tokens / dt
+                self._tok_s = inst if self._tok_s == 0 else (
+                    0.5 * self._tok_s + 0.5 * inst)
+                self._rate_tokens = 0
+                self._rate_t0 = time.perf_counter()
+
+    def _slot_of(self, sid: int) -> int | None:
+        for i, s in enumerate(self.engine.streams):
+            if s.stream_id == sid:
+                return i
+        return None
+
+    def _retire(self) -> None:
+        """Close out sessions that ended this pass: engine EOS/window,
+        token budget, client disconnect, deadline. ``finish(stream_id)``
+        is the slot/KV free; the detok tail is flushed into the terminal
+        event so streamed text matches the full decode."""
+        now = time.perf_counter()
+        for sid, sess in list(self._by_sid.items()):
+            reason = None
+            if sess.finish_reason in ("stop", "length"):
+                reason = sess.finish_reason
+            elif len(sess.generated) >= sess.max_tokens:
+                reason = "length"
+            elif sess.cancelled.is_set():
+                reason = "cancelled"
+            elif sess.deadline is not None and now > sess.deadline:
+                reason = "timeout"
+            if reason is None:
+                continue
+            self.engine.finish(sid)
+            slot = self._slot_of(sid)
+            tail = None
+            if slot is not None:
+                detok = self.engine.streams[slot].detok
+                if detok is not None and reason != "cancelled":
+                    tail = detok.decode_rest()
+            if reason == "cancelled":
+                _session.CANCELLED.inc()
+            elif reason == "timeout":
+                _session.TIMEOUTS.inc()
+            sess.finish(reason, tail_text=tail)
+            with self._cond:
+                self._by_sid.pop(sid, None)
+
+    def _abort_all(self, message: str) -> None:
+        with self._cond:
+            queued = list(self._queue)
+            self._queue.clear()
+            running = list(self._by_sid.values())
+            self._by_sid.clear()
+            _session.QUEUE_DEPTH.set(0)
+        for s in queued + running:
+            if s.finish_reason is None:
+                s.fail(503, message)

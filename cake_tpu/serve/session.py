@@ -1,0 +1,133 @@
+"""Per-request serving state: encode, stream, measure.
+
+A :class:`Session` is one HTTP request's life in the serving plane — its
+prompt (text through the engine's tokenizer, or a ``prompt_ids`` escape
+hatch mirroring the CLI's ``--prompt-ids``), its token budget and arrival
+deadline, the queue the scheduler fans its tokens into, and its own
+latency record (TTFT = submit to first token, TPOT = inter-token gap).
+
+Latencies feed the registry histograms below, so serving traffic shows up
+everywhere the obs layer already looks: ``/metrics`` Prometheus text,
+``--metrics-out`` snapshots, and — via a per-request flight record tagged
+``kind="serve.request"`` — ``--flight-log``/``--trace`` artifacts and the
+cluster views built on them.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+import uuid
+
+from cake_tpu.obs import flight as obs_flight
+from cake_tpu.obs import metrics as obs_metrics
+
+# Process-global serving instruments (get-or-create: the scheduler and the
+# API handler share these series without import-order coupling).
+TTFT_MS = obs_metrics.histogram("serve.ttft_ms")
+TPOT_MS = obs_metrics.histogram("serve.tpot_ms")
+QUEUE_DEPTH = obs_metrics.gauge("serve.queue_depth")
+REJECTED = obs_metrics.counter("serve.rejected")
+CANCELLED = obs_metrics.counter("serve.cancelled")
+TIMEOUTS = obs_metrics.counter("serve.timeouts")
+COMPLETED = obs_metrics.counter("serve.completed")
+
+
+def sse_event(data) -> bytes:
+    """One Server-Sent-Events frame: ``data: <json>\\n\\n`` (strings pass
+    through raw — the ``[DONE]`` sentinel is not JSON)."""
+    payload = data if isinstance(data, str) else json.dumps(data)
+    return f"data: {payload}\n\n".encode()
+
+
+class Session:
+    """One request's serving state. Built by the API layer, admitted and
+    advanced by the scheduler's engine thread (the only writer of token
+    events), drained by the API handler thread via :attr:`events`."""
+
+    def __init__(self, prompt_ids: list[int], max_tokens: int,
+                 stream: bool = True, timeout_s: float | None = None,
+                 request_id: str | None = None):
+        self.id = request_id or uuid.uuid4().hex[:12]
+        self.prompt_ids = list(prompt_ids)
+        self.max_tokens = int(max_tokens)
+        self.stream = bool(stream)
+        self.timeout_s = timeout_s
+        # scheduler-owned identity/state
+        self.stream_id: int | None = None  # engine stream id once admitted
+        self.finish_reason: str | None = None
+        self.generated: list[int] = []
+        # handler -> scheduler: the client went away (write failed); the
+        # engine thread retires the stream at its next loop pass
+        self.cancelled = threading.Event()
+        # scheduler -> handler: ("token", id, text) | ("done", reason,
+        # usage, tail_text) | ("error", http_status, message)
+        self.events: queue.Queue = queue.Queue()
+        now = time.perf_counter()
+        self.t_submit = now
+        self.deadline = now + timeout_s if timeout_s else None
+        self._t_last: float | None = None
+        self.ttft_ms: float | None = None
+        self._tpot_sum_ms = 0.0
+
+    # -- engine-thread side ---------------------------------------------------
+    def on_token(self, tok_id: int, text: str | None) -> None:
+        """Record one emitted token (engine thread): latency samples land
+        in the registry, the event lands in the handler's queue."""
+        now = time.perf_counter()
+        if self._t_last is None:
+            self.ttft_ms = (now - self.t_submit) * 1e3
+            TTFT_MS.observe(self.ttft_ms)
+        else:
+            gap_ms = (now - self._t_last) * 1e3
+            self._tpot_sum_ms += gap_ms
+            TPOT_MS.observe(gap_ms)
+        self._t_last = now
+        self.generated.append(tok_id)
+        self.events.put(("token", tok_id, text))
+
+    def finish(self, reason: str, tail_text: str | None = None) -> None:
+        """Close the session (engine thread): one terminal event carrying
+        the usage stats, plus the flight record that makes the request
+        visible to --flight-log/--trace consumers."""
+        self.finish_reason = reason
+        if reason in ("stop", "length"):
+            # cancelled/timed-out requests land in their own counters;
+            # completed means the request actually got its tokens
+            COMPLETED.inc()
+        rec = obs_flight.recorder()
+        if rec.enabled:
+            rec.record(kind="serve.request", request=self.id,
+                       prompt_tokens=len(self.prompt_ids),
+                       completion_tokens=len(self.generated),
+                       ttft_ms=round(self.ttft_ms, 3)
+                       if self.ttft_ms is not None else None,
+                       tpot_ms=round(self.tpot_ms, 3)
+                       if self.tpot_ms is not None else None,
+                       reason=reason)
+        self.events.put(("done", reason, self.usage(), tail_text))
+
+    def fail(self, status: int, message: str) -> None:
+        """Reject/abort the session with an HTTP-statused error event."""
+        self.finish_reason = "error"
+        self.events.put(("error", status, message))
+
+    # -- stats ----------------------------------------------------------------
+    @property
+    def tpot_ms(self) -> float | None:
+        n = len(self.generated) - 1
+        return self._tpot_sum_ms / n if n > 0 else None
+
+    def usage(self) -> dict:
+        u = {
+            "prompt_tokens": len(self.prompt_ids),
+            "completion_tokens": len(self.generated),
+            "total_tokens": len(self.prompt_ids) + len(self.generated),
+        }
+        if self.ttft_ms is not None:
+            u["ttft_ms"] = round(self.ttft_ms, 3)
+        if self.tpot_ms is not None:
+            u["tpot_ms"] = round(self.tpot_ms, 3)
+        return u

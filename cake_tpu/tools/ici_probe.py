@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from cake_tpu.parallel.mesh import STAGE, make_mesh
+from cake_tpu.parallel.mesh import STAGE, make_mesh, shard_map
 
 
 def _build_ring(mesh, n: int, reps: int):
@@ -46,7 +46,7 @@ def _build_ring(mesh, n: int, reps: int):
         out, _ = jax.lax.scan(step, x, None, length=reps)
         return out
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         body, mesh=mesh, in_specs=P(STAGE), out_specs=P(STAGE),
         check_vma=False,
     ))

@@ -1,0 +1,229 @@
+"""HTTP load generator for the serving plane (stdlib-only).
+
+Drives ``--mode serve``'s ``POST /v1/completions`` with N concurrent
+clients, either closed-loop (each client fires its next request the moment
+the previous completes — the saturation view) or open-loop (Poisson
+arrivals at ``--rate`` req/s regardless of completions — the latency-
+under-load view; open loop is the honest one for tail latencies, since a
+closed loop self-throttles when the server slows down). Prompts draw from
+a ``--prompt-len`` mix of random in-vocab token ids (``prompt_ids`` path:
+no tokenizer needed on either side), or from ``--prompt`` literals.
+
+Prints TTFT / TPOT / end-to-end percentiles and aggregate token
+throughput; used by ``make serve-smoke`` and the ``CAKE_BENCH_SERVE=1``
+bench row.
+
+Usage:
+  python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
+      -n 32 -c 4 --max-tokens 64 --prompt-len 8,32,128
+  python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
+      -n 64 --rate 8 --max-tokens 32        # open loop, 8 req/s Poisson
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    s = sorted(xs)
+    i = min(len(s) - 1, max(0, int(q * (len(s) - 1) + 0.5)))
+    return s[i]
+
+
+def _one_request(url: str, body: dict, timeout: float) -> dict:
+    """Fire one streaming completions request; measure TTFT (first SSE
+    token event), per-token gaps, and end-to-end wall. Returns a result
+    dict ({"error"/"status": ...} on failure)."""
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    t0 = time.perf_counter()
+    out: dict = {"tokens": 0, "ttft_s": None, "gaps_s": [], "ids": []}
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            if not body.get("stream"):
+                payload = json.loads(resp.read())
+                out["tokens"] = payload["usage"]["completion_tokens"]
+                out["ids"] = payload.get("token_ids", [])
+                out["ttft_s"] = (payload["usage"].get("ttft_ms", 0)
+                                 or 0) / 1e3
+                out["wall_s"] = time.perf_counter() - t0
+                return out
+            t_last = None
+            for raw in resp:
+                raw = raw.strip()
+                if not raw.startswith(b"data: "):
+                    continue
+                data = raw[len(b"data: "):]
+                if data == b"[DONE]":
+                    break
+                ev = json.loads(data)
+                if "token" in ev:
+                    now = time.perf_counter()
+                    if t_last is None:
+                        out["ttft_s"] = now - t0
+                    else:
+                        out["gaps_s"].append(now - t_last)
+                    t_last = now
+                    out["tokens"] += 1
+                    out["ids"].append(ev["token"])
+                elif "error" in ev:
+                    out["error"] = ev["error"]
+                    break
+            out["wall_s"] = time.perf_counter() - t0
+            return out
+    except urllib.error.HTTPError as e:
+        return {"status": e.code,
+                "retry_after": e.headers.get("Retry-After"),
+                "wall_s": time.perf_counter() - t0}
+    except Exception as e:  # connection refused/reset, timeout, ...
+        return {"error": str(e), "wall_s": time.perf_counter() - t0}
+
+
+def _make_prompts(n: int, lens: list[int], vocab: int, seed: int,
+                  literals: list[str]) -> list[dict]:
+    """One request-body fragment per planned request: a literal text
+    prompt round-robin, or random in-vocab ids from the length mix."""
+    rng = random.Random(seed)
+    frags = []
+    for i in range(n):
+        if literals:
+            frags.append({"prompt": literals[i % len(literals)]})
+        else:
+            ln = lens[i % len(lens)]
+            frags.append({"prompt_ids": [rng.randrange(1, max(2, vocab))
+                                         for _ in range(ln)]})
+    return frags
+
+
+def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
+             prompt_lens: list[int] | None = None, vocab: int = 256,
+             rate: float | None = None, seed: int = 0,
+             prompts: list[str] | None = None, stream: bool = True,
+             timeout: float = 300.0) -> dict:
+    """Run the load; returns aggregate stats (also the in-process entry
+    the bench row and tests use)."""
+    frags = _make_prompts(n, prompt_lens or [8], vocab, seed, prompts or [])
+    results: list[dict] = [None] * n  # type: ignore[list-item]
+    t_start = time.perf_counter()
+
+    def fire(i: int) -> None:
+        body = dict(frags[i], max_tokens=max_tokens, stream=stream)
+        results[i] = _one_request(url, body, timeout)
+
+    if rate:
+        # open loop: Poisson arrivals, one thread per in-flight request
+        rng = random.Random(seed + 1)
+        threads = []
+        t_next = time.perf_counter()
+        for i in range(n):
+            t_next += rng.expovariate(rate)
+            delay = t_next - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            th = threading.Thread(target=fire, args=(i,), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=timeout)
+    else:
+        # closed loop: `concurrency` clients, each back-to-back
+        it = iter(range(n))
+        lock = threading.Lock()
+
+        def worker() -> None:
+            while True:
+                with lock:
+                    i = next(it, None)
+                if i is None:
+                    return
+                fire(i)
+
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(concurrency)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=timeout)
+    wall = time.perf_counter() - t_start
+
+    done = [r for r in results if r and r.get("tokens")]
+    rejected = [r for r in results if r and r.get("status") == 429]
+    errors = [r for r in results if r and (
+        "error" in r or ("status" in r and r["status"] != 429))]
+    ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
+    gaps = [g for r in done for g in r.get("gaps_s", ())]
+    total_tokens = sum(r["tokens"] for r in done)
+    return {
+        "requests": n,
+        "completed": len(done),
+        "rejected_429": len(rejected),
+        "errors": len(errors),
+        "wall_s": round(wall, 3),
+        "tokens": total_tokens,
+        "tok_s": round(total_tokens / wall, 2) if wall > 0 else 0.0,
+        "ttft_ms": {
+            "p50": round(_percentile(ttfts, 0.5) * 1e3, 1),
+            "p95": round(_percentile(ttfts, 0.95) * 1e3, 1),
+        },
+        "tpot_ms": {
+            "p50": round(_percentile(gaps, 0.5) * 1e3, 2),
+            "p95": round(_percentile(gaps, 0.95) * 1e3, 2),
+        },
+        "results": results,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cake-loadgen",
+        description="closed/open-loop HTTP load generator for --mode serve",
+    )
+    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8080")
+    p.add_argument("-n", "--requests", type=int, default=16)
+    p.add_argument("-c", "--concurrency", type=int, default=4,
+                   help="closed-loop client count (ignored with --rate)")
+    p.add_argument("--rate", type=float, default=None,
+                   help="open-loop Poisson arrival rate (req/s); omit for "
+                        "closed loop")
+    p.add_argument("--max-tokens", type=int, default=32, dest="max_tokens")
+    p.add_argument("--prompt-len", default="8", dest="prompt_len",
+                   help="comma-separated prompt-length mix for random "
+                        "prompt_ids requests (cycled per request)")
+    p.add_argument("--vocab", type=int, default=256,
+                   help="vocab bound for the random prompt ids")
+    p.add_argument("--prompt", action="append", default=[],
+                   help="literal text prompt (repeatable; needs a "
+                        "server-side tokenizer; overrides --prompt-len)")
+    p.add_argument("--no-stream", action="store_true",
+                   help="unary JSON responses instead of SSE")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+    lens = [int(x) for x in args.prompt_len.split(",") if x.strip()]
+    stats = run_load(
+        args.url, args.requests, concurrency=args.concurrency,
+        max_tokens=args.max_tokens, prompt_lens=lens, vocab=args.vocab,
+        rate=args.rate, seed=args.seed, prompts=args.prompt,
+        stream=not args.no_stream, timeout=args.timeout,
+    )
+    stats = dict(stats)
+    stats.pop("results")
+    print(json.dumps(stats, indent=1))
+    return 0 if stats["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,7 +48,7 @@ def main() -> None:
         if step == 4:
             # a request arrives mid-run: it reuses the cached prefix row
             # and prefills only its remainder, interleaved with decode
-            gen.streams[2].done = True  # pretend stream 2 finished
+            gen.finish(stream_id=2)  # pretend stream 2 finished
             gen.enqueue(system_prompt + [2, 6, 4], stream_id=3)
             print("step 5: stream 2 retired, arrival enqueued")
         if gen.pending_admissions() == 0 and step == 8:
@@ -63,6 +63,13 @@ def main() -> None:
     for i, s in enumerate(gen.streams):
         if s.active:
             print(f"stream {i} (id {s.stream_id}): {s.generated}")
+
+    # Everything above is the in-process engine API. The same engine
+    # serves over the network: `python -m cake_tpu.cli --model ... --mode
+    # serve` puts an HTTP front end (POST /v1/completions with SSE
+    # streaming, admission queue, backpressure) on top of exactly these
+    # enqueue/step/finish calls, and `python -m cake_tpu.tools.loadgen`
+    # drives it with concurrent clients — see README "Serving over HTTP".
 
 
 if __name__ == "__main__":

@@ -42,7 +42,7 @@ def main() -> None:
 
     # continuous batching: retire a stream, admit an arrival in its slot —
     # the running batch never stalls behind the new prompt's prefill
-    gen.streams[0].done = True
+    gen.finish(stream_id=0)
     gen.enqueue([4, 4, 2, 9, 1, 3], stream_id=99)
     for _ in range(14):
         gen.step()

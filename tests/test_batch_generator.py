@@ -322,6 +322,61 @@ def test_enqueue_waits_for_free_slot_and_drains_fifo(params):
     assert sorted(s.stream_id for s in g.streams) == [5, 6]
 
 
+def test_finish_retires_stream_and_frees_slot(params):
+    """The public retirement API (the serving plane's slot free): finish()
+    stops the stream's emission, makes its slot admissible to the next
+    arrival, and reports retirement races honestly (False on an unknown or
+    already-done id — normal for a server, not an error)."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1)
+    g.set_prompts(PROMPTS[:2])
+    g.step()
+    assert g.finish(0) is True
+    assert g.streams[0].done
+    assert g.finish(0) is False  # already retired
+    assert g.finish(42) is False  # never admitted
+    row = g.step()
+    assert row[0] is None and row[1] is not None  # retired slot is silent
+    g.enqueue([2, 8, 1], stream_id=5)
+    g.step()
+    assert g.pending_admissions() == 0  # admitted into the freed slot
+    assert g.streams[0].stream_id == 5
+    # the neighbor stream was never perturbed
+    neighbor = [r[1].id for r in [row] if r[1] is not None]
+    assert neighbor == _single_stream(params, PROMPTS[1], 2, settings)[1:2]
+
+
+def test_finish_cancels_queued_and_staging_arrivals(params):
+    """finish() covers the arrival's WHOLE lifecycle: an id still waiting
+    in the FIFO, or mid-admission in the staging cache, is dropped before
+    it can splice in — a server cancelling a request whose prefill never
+    completed must not leak an ownerless stream into a slot."""
+    settings = SamplerSettings(**GREEDY)
+    g = BG(CFG, params, settings=settings, dp=1, admit_chunk=4)
+    g.set_prompts(PROMPTS[:2])
+    g.step()
+    # queued, never started: no slot free, the arrival sits in the FIFO
+    g.enqueue([2, 8, 1, 7], stream_id=9)
+    assert g.pending_admissions() == 1
+    assert g.finish(9) is True
+    assert g.pending_admissions() == 0
+    # mid-staging: free a slot, let one 4-token chunk of an 8-token
+    # arrival dispatch, then retire it before the final chunk
+    g.finish(0)
+    g.enqueue([2, 8, 1, 7, 6, 5, 4, 3], stream_id=10)
+    g.step()  # chunk 1 of 2 into the staging cache
+    assert g.pending_admissions() == 1  # in flight
+    assert g.finish(10) is True
+    assert g.pending_admissions() == 0
+    for _ in range(3):
+        g.step()
+    assert all(s.stream_id != 10 for s in g.streams)  # never spliced
+    # the freed slot still serves the next arrival
+    g.enqueue([4, 4, 4], stream_id=11)
+    g.step()
+    assert any(s.stream_id == 11 for s in g.streams)
+
+
 def test_admit_chunk_must_divide_max_seq(params):
     """A chunk that doesn't divide the window is rejected at construction:
     a near-window prompt would round up PAST max_seq and the final chunk's

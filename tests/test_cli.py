@@ -332,6 +332,96 @@ def test_failure_domain_flags_rejected_in_worker_mode(model_dir):
     assert "master process" in str(e.value)
 
 
+def test_serve_flags_need_serve_mode(model_dir):
+    """--serve-port/--max-concurrent/... configure the HTTP serving plane;
+    on the one-shot master/worker paths they must error loudly instead of
+    being silently ignored (and --mode serve refuses the one-shot prompt
+    sources, which arrive over HTTP instead)."""
+    from cake_tpu import cli
+
+    for flags, frag in (
+        (["--serve-port", "8080"], "--serve-port"),
+        (["--max-concurrent", "4", "--queue-depth", "8"],
+         "--max-concurrent"),
+        (["--request-timeout", "30"], "--request-timeout"),
+    ):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--prompt-ids", "1",
+                      "--cpu", "-n", "1"] + flags)
+        assert frag in str(e.value) and "--mode serve" in str(e.value)
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--model", str(model_dir), "--mode", "serve", "--cpu",
+                  "--prompt-ids", "1"])
+    assert "over HTTP" in str(e.value)
+    for flags in (["--prefill-chunks", "2"], ["--top"]):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--mode", "serve",
+                      "--cpu"] + flags)
+        assert "silently ignored" in str(e.value)
+    for flag, val in (("--max-concurrent", "0"), ("--queue-depth", "0"),
+                      ("--request-timeout", "0")):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--mode", "serve",
+                      "--cpu", flag, val])
+        assert "must" in str(e.value)
+
+
+@pytest.mark.slow
+def test_serve_mode_e2e_with_drain(model_dir):
+    """--mode serve end to end through the real CLI: SSE completion over
+    HTTP, then SIGTERM drains and exits 0 (the serving plane's acceptance
+    loop; the in-process surface is covered by tests/test_serve.py)."""
+    import signal
+    import socket
+    import time
+    import urllib.request
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cake_tpu.cli", "--model", str(model_dir),
+         "--mode", "serve", "--cpu", "--max-seq", "32",
+         "--serve-port", str(port), "--max-concurrent", "2",
+         "--queue-depth", "4", "--request-timeout", "60",
+         "--temperature", "0"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    try:
+        for _ in range(240):
+            if proc.poll() is not None:
+                pytest.fail(f"serve died rc={proc.returncode}: "
+                            f"{proc.stderr.read().decode()[-2000:]}")
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=1)
+                break
+            except OSError:
+                time.sleep(0.5)
+        else:
+            pytest.fail("serve never came up")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt_ids": [3, 5, 7], "max_tokens": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            body = r.read()
+        assert body.count(b"data: ") == 6  # 4 tokens + done + [DONE]
+        assert b"[DONE]" in body
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+        assert b"drained" in proc.stderr.read()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 def test_string_prompt_without_tokenizer_errors(model_dir):
     r = _run_cli([
         "--model", str(model_dir), "--prompt", "hello", "-n", "1", "--cpu",

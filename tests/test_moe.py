@@ -105,7 +105,7 @@ def test_expert_parallel_matches_single_device(ep):
     routing) and numerically."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    shard_map = jax.shard_map
+    from cake_tpu.parallel.mesh import shard_map
 
     x, rw, wg, wu, wd = _fixtures(n=4, e=4)
     devs = jax.devices()[:ep]

@@ -12,6 +12,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from cake_tpu.ops import ring
+from cake_tpu.parallel.mesh import shard_map
 from cake_tpu.ops.attention import _attend_xla
 
 
@@ -74,7 +75,7 @@ def test_ring_attention_parity(sp):
         )
 
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
             check_vma=False,
         )
@@ -96,7 +97,7 @@ def test_ring_attention_restores_kv_layout():
         return out, k
 
     _, k_after = jax.jit(
-        jax.shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
+        shard_map(f, mesh=mesh, in_specs=(spec,) * 3,
                       out_specs=(spec, spec), check_vma=False)
     )(q, k, v)
     np.testing.assert_array_equal(np.asarray(k_after), np.asarray(k))
@@ -118,7 +119,7 @@ def test_sp_decode_parity(pos):
         return ring.sp_decode_attend(q, k, v, pos, "sp", my * s_l)
 
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(P(None), kv_spec, kv_spec),
             out_specs=P(None),
@@ -145,7 +146,7 @@ def test_sp_cache_write_owner_only(pos):
         return ring.sp_cache_write(kc, vc, kn, vn, pos, my * s_l)
 
     kc, vc = jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(kv_spec, kv_spec, P(None), P(None)),
             out_specs=(kv_spec, kv_spec),
