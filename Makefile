@@ -9,10 +9,16 @@ PY ?= python
 test:
 	$(PY) -m pytest tests/ -x -q
 
+# lint = syntax + (optional) pyflakes + cakelint, the project-invariant
+# AST checker suite (cake_tpu/analysis): metric-series catalog, engine
+# ownership, _GUARDED_BY lock discipline, jit trace purity, wire/resource
+# safety. Fails on any finding not grandfathered (with a justification)
+# in analysis-baseline.json. See README "Static analysis".
 lint:
 	$(PY) -m compileall -q cake_tpu tests bench.py __graft_entry__.py
 	@if $(PY) -c 'import pyflakes' 2>/dev/null; then \
 	  $(PY) -m pyflakes cake_tpu tests bench.py __graft_entry__.py; fi
+	$(PY) -m cake_tpu.analysis --baseline analysis-baseline.json
 
 native: native/libcakewire.so native/libcakeembed.so native/cake_host_demo
 
@@ -113,8 +119,10 @@ serve-smoke:
 # ping planes ride the same hot path the codec numbers come from — the
 # chaos smoke: recovery machinery must keep surviving what the perf
 # work keeps touching — and the serve smoke: the network plane sits on
-# the same engine hot path.
-perf-smoke: cluster-trace-smoke chaos-smoke serve-smoke
+# the same engine hot path. Lint runs first: an invariant violation
+# fails faster than any smoke, and the smokes exercise exactly the
+# invariants cakelint pins (ownership, deadlines, lock discipline).
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \

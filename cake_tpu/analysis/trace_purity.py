@@ -1,0 +1,158 @@
+"""CK-JIT: bodies handed to jit/shard_map/pallas_call must be trace-pure.
+
+The classic JAX bug class: a host-side effect inside a traced function —
+``time.perf_counter()``, ``random.random()``, a registry counter, a
+``print`` — runs ONCE at trace time and never again, so the timing is
+a constant, the "random" draw repeats forever, the counter undercounts
+by a factor of the step count, and the print goes silent after the first
+call. Nothing crashes; the numbers are just wrong.
+
+This checker finds functions that flow into ``jax.jit`` / ``shard_map``
+/ ``pl.pallas_call`` — as direct arguments, through ``partial(...)``,
+through nested wrapping (``jax.jit(shard_map(f, ...))``), as lambdas, or
+via decorators (``@jax.jit``, ``@partial(jax.jit, ...)``) — and flags
+host-impure calls in their bodies:
+
+- ``time.*`` and ``datetime.*`` (trace-time constants),
+- ``random.*`` / ``np.random.*`` (``jax.random`` is fine — keyed and
+  functional),
+- ``print`` / ``logging`` / ``log.*`` (fires once; ``jax.debug.print``
+  is the traced alternative and is allowed),
+- metrics-registry calls (``obs_metrics.*``, instrument ``.inc()`` /
+  ``.observe()`` / ``.set()`` on module-level ALL_CAPS instruments).
+
+Resolution is one module deep (a Name argument resolves to a function
+defined in the same file); helpers it calls are not recursed into — the
+checker catches the direct-body class of bug, reviewers the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cake_tpu.analysis import core
+
+_TRACERS = {"jit", "shard_map", "pallas_call"}
+_IMPURE_ROOTS = {"time", "random", "datetime", "logging"}
+_LOGGER_NAMES = {"log", "logger"}
+_METRIC_MODULES = {"obs_metrics", "_metrics", "metrics"}
+_INSTRUMENT_METHODS = {"inc", "observe", "set"}
+
+
+def _is_tracer_call(call: ast.Call) -> bool:
+    chain = core.attr_chain(call.func)
+    if not chain:
+        return False
+    last = chain[-1]
+    if last not in _TRACERS:
+        return False
+    # jax.jit / jit / mesh.shard_map / pl.pallas_call / pallas_call —
+    # but not e.g. somedict.jit; require a plausible root
+    return len(chain) == 1 or chain[0] in ("jax", "pl", "pltpu", "self") \
+        or "shard" in last or last == "pallas_call"
+
+
+class TracePurityChecker(core.Checker):
+    id = "CK-JIT"
+    name = "trace-purity"
+    description = ("functions traced by jax.jit/shard_map/pallas_call must "
+                   "not call impure host APIs (time, random, print, "
+                   "logging, metrics)")
+
+    def check_module(self, mod: core.Module):
+        defs = self._defs_by_name(mod.tree)
+        targets: dict[int, tuple[ast.AST, str]] = {}  # id -> (fn node, via)
+
+        def add(fn_node, via: str):
+            if fn_node is not None:
+                targets.setdefault(id(fn_node), (fn_node, via))
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and _is_tracer_call(node):
+                if node.args:
+                    add(self._resolve(node.args[0], defs),
+                        core.call_name(node))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._decorator_traces(dec):
+                        add(node, "decorator")
+        for fn_node, via in targets.values():
+            yield from self._check_body(mod, fn_node, via)
+
+    # -- resolution --------------------------------------------------------
+    @staticmethod
+    def _defs_by_name(tree) -> dict[str, ast.AST]:
+        return {
+            n.name: n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+    def _resolve(self, arg: ast.AST, defs) -> ast.AST | None:
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return defs.get(arg.id)
+        if isinstance(arg, ast.Call):
+            name = core.call_name(arg)
+            if "partial" in name and arg.args:
+                return self._resolve(arg.args[0], defs)
+            if _is_tracer_call(arg) and arg.args:  # jit(shard_map(f, ...))
+                return self._resolve(arg.args[0], defs)
+        return None
+
+    @staticmethod
+    def _decorator_traces(dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            name = core.call_name(dec)
+            if "partial" in name and dec.args:
+                inner = dec.args[0]
+                return core.attr_chain(inner)[-1:] == ["jit"] or (
+                    isinstance(inner, ast.Call) and _is_tracer_call(inner))
+            return _is_tracer_call(dec)
+        return core.attr_chain(dec)[-1:] == ["jit"] and (
+            core.attr_chain(dec)[0] in ("jax",)
+            or len(core.attr_chain(dec)) == 1)
+
+    # -- purity walk -------------------------------------------------------
+    def _check_body(self, mod, fn_node, via: str):
+        fn_name = getattr(fn_node, "name", "<lambda>")
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            impure = self._impurity(node)
+            if impure is None:
+                continue
+            yield self.finding(
+                mod, node,
+                f"impure host call '{impure}' inside '{fn_name}' which is "
+                f"traced (via {via}) — it fires once at trace time, not "
+                "per step",
+                hint="hoist the effect to the host-side caller (record "
+                     "around the dispatch), or use jax.debug.print / "
+                     "jax.random for traced equivalents",
+                key=f"{fn_name}:{impure}",
+            )
+
+    @staticmethod
+    def _impurity(call: ast.Call) -> str | None:
+        chain = core.attr_chain(call.func)
+        if not chain:
+            return None
+        root, last = chain[0], chain[-1]
+        dotted = ".".join(chain)
+        if chain == ["print"]:
+            return "print"
+        if root in _IMPURE_ROOTS and len(chain) > 1:
+            return dotted
+        if root in _LOGGER_NAMES and len(chain) == 2 and last in (
+                "debug", "info", "warning", "error", "exception", "critical",
+                "log"):
+            return dotted
+        if root in ("np", "numpy") and len(chain) > 2 and chain[1] == "random":
+            return dotted
+        if root in _METRIC_MODULES and len(chain) > 1:
+            return dotted
+        if (last in _INSTRUMENT_METHODS and len(chain) == 2
+                and root.isupper()):
+            return dotted  # module-level instrument: REJECTED.inc()
+        return None

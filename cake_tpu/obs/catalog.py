@@ -1,0 +1,119 @@
+"""Declared catalog of every metrics-registry series this tree emits.
+
+The registry (:mod:`cake_tpu.obs.metrics`) is string-keyed and
+get-or-create by design — independent modules share series without
+import-order coupling. The cost of that convenience is that a typo'd
+name silently forks a series: ``wire.bytes_out`` and ``wire.byte_out``
+would both exist, each half-populated, and every dashboard built on the
+real name goes quietly wrong. This catalog is the fix: one declaration
+per series (name, kind, meaning), enforced two ways —
+
+- statically, by the ``metrics-catalog`` checker in
+  :mod:`cake_tpu.analysis` (``make lint``): every series-name literal at
+  a ``counter()``/``gauge()``/``histogram()``/instrument-constructor
+  call site must appear here;
+- optionally at runtime: ``CAKE_OBS_STRICT=1`` (or
+  ``registry().strict = True``) makes the registry refuse to create an
+  undeclared series, for test rigs that want the invariant hot.
+
+Dynamic families (per-segment, per-worker) are declared as patterns with
+``*`` standing for exactly the formatted field an f-string interpolates;
+the checker derives the same pattern from the f-string AST and requires
+an exact match, so even dynamic names can't drift.
+
+Adding a series is a two-line change: the call site and one entry here.
+The entry is the review surface — a reviewer sees the new name, its
+kind, and what it means, in one place.
+"""
+
+from __future__ import annotations
+
+from fnmatch import fnmatchcase
+
+COUNTER = "counter"
+GAUGE = "gauge"
+HISTOGRAM = "histogram"
+
+# name -> (kind, meaning). Grouped by owning subsystem; keep each group
+# sorted so diffs stay reviewable.
+SERIES: dict[str, tuple[str, str]] = {
+    # -- generator (local single-stream decode) --------------------------
+    "generator.decode_ms": (HISTOGRAM, "per-token decode latency"),
+    "generator.prefill_ms": (HISTOGRAM, "prompt prefill latency"),
+    # -- master (distributed decode walk) --------------------------------
+    "master.failovers": (COUNTER, "recoveries that landed on a replica"),
+    "master.recoveries": (COUNTER, "successful mid-stream reconnect+replay"),
+    "master.tokens_generated": (COUNTER, "tokens emitted by the master"),
+    # -- recovery/backoff plane ------------------------------------------
+    "recover.backoff_ms": (COUNTER, "total backoff sleep during recovery"),
+    # -- serving plane (HTTP API + scheduler) ----------------------------
+    "serve.admit_chunk_ms": (HISTOGRAM, "admission prefill chunk dispatch"),
+    "serve.cancelled": (COUNTER, "requests cancelled (client went away)"),
+    "serve.completed": (COUNTER, "requests that got their tokens"),
+    "serve.decode_dispatch_ms": (HISTOGRAM, "batched decode dispatch"),
+    "serve.queue_depth": (GAUGE, "requests waiting for admission"),
+    "serve.rejected": (COUNTER, "submissions refused at the queue bound"),
+    "serve.timeouts": (COUNTER, "requests expired (queued or mid-stream)"),
+    "serve.tokens_emitted": (COUNTER, "tokens emitted by the batch engine"),
+    "serve.tpot_ms": (HISTOGRAM, "inter-token gap per serving request"),
+    "serve.ttft_ms": (HISTOGRAM, "submit-to-first-token per request"),
+    # -- wire transport ---------------------------------------------------
+    "wire.bytes_in": (COUNTER, "frame payload bytes received"),
+    "wire.bytes_out": (COUNTER, "frame payload bytes sent"),
+    "wire.codec_bytes_encoded": (COUNTER, "activation bytes after codec"),
+    "wire.codec_bytes_raw": (COUNTER, "activation bytes before codec"),
+    "wire.crc_failures": (COUNTER, "frames dropped on CRC mismatch"),
+    "wire.deserialize_ms": (HISTOGRAM, "reply tensor decode time"),
+    "wire.frame_bytes": (HISTOGRAM, "payload size distribution"),
+    "wire.frames_in": (COUNTER, "frames received"),
+    "wire.frames_out": (COUNTER, "frames sent"),
+    "wire.serialize_ms": (HISTOGRAM, "request tensor encode time"),
+    "wire.timeouts": (COUNTER, "recv/send deadlines expired"),
+    # -- worker (remote segment server) ----------------------------------
+    "worker.bytes_in": (COUNTER, "op payload bytes received"),
+    "worker.bytes_out": (COUNTER, "op payload bytes sent"),
+    "worker.forward_ms": (HISTOGRAM, "steady-state decode forward time"),
+    "worker.ops": (COUNTER, "ops handled"),
+    "worker.prefill_ms": (HISTOGRAM, "prefill/replay forward time"),
+    "worker.warmup_ms": (GAUGE, "per-shape XLA compile warmup"),
+    # -- cluster aggregation (master-side merged view) -------------------
+    "cluster.forward_p99_median_ms": (GAUGE, "median of worker p99s"),
+    "cluster.stragglers": (GAUGE, "workers currently flagged"),
+    "cluster.workers_up": (GAUGE, "workers answering scrapes"),
+}
+
+# Dynamic families: ``*`` stands for exactly one interpolated field. The
+# static checker requires an f-string series name to reduce to one of
+# these patterns verbatim; fnmatch covers literal names that happen to
+# land inside a family.
+DYNAMIC: dict[str, tuple[str, str]] = {
+    "master.segment*.decode_ms": (
+        HISTOGRAM, "per-segment steady-state forward time"),
+    "master.segment*.warmup_ms": (
+        GAUGE, "per-segment first-call compile+prefill"),
+    "cluster.*.*": (
+        GAUGE, "per-worker merged health/traffic fields (ClusterScraper)"),
+}
+
+
+def is_declared(name: str) -> bool:
+    """True if ``name`` — a concrete series name OR a ``*`` pattern
+    derived from an f-string — is covered by the catalog."""
+    if name in SERIES or name in DYNAMIC:
+        return True
+    return any(fnmatchcase(name, pat) for pat in DYNAMIC)
+
+
+def kind_of(name: str) -> str | None:
+    """Declared kind for a concrete name (None if undeclared)."""
+    if name in SERIES:
+        return SERIES[name][0]
+    for pat, (kind, _) in DYNAMIC.items():
+        if fnmatchcase(name, pat):
+            return kind
+    return None
+
+
+def all_names() -> list[str]:
+    """Every declared name and pattern (sorted) — the docs/table view."""
+    return sorted(SERIES) + sorted(DYNAMIC)

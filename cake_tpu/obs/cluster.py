@@ -162,8 +162,16 @@ class ClusterScraper:
         gauges, recompute straggler flags, return the report dict."""
         workers: dict[str, dict] = {}
         for src in self.sources:
-            st = src.fetch()
-            link = src.link()
+            # one bad source must not kill the pass: the sources' own
+            # fetch() already swallows transport errors into None, but a
+            # third-party source (or a link() racing a failover) may
+            # still raise — report that worker down and keep scraping
+            try:
+                st = src.fetch()
+                link = src.link()
+            except Exception as e:
+                log.warning("scrape of %s failed: %s", src.addr, e)
+                st, link = None, {"rtt_ms": None, "clock_offset_ms": None}
             name = src.name
             if st is None:
                 workers[name] = {"addr": src.addr, "up": False, **link}

@@ -45,6 +45,7 @@ class Counter:
     """Monotonic counter. ``inc`` is thread-safe."""
 
     __slots__ = ("name", "_lock", "_value")
+    _GUARDED_BY = {"_value": "_lock"}
 
     def __init__(self, name: str = ""):
         self.name = name
@@ -57,20 +58,23 @@ class Counter:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def reset(self) -> None:
         with self._lock:
             self._value = 0
 
     def snapshot(self) -> dict:
-        return {"type": "counter", "value": self._value}
+        with self._lock:
+            return {"type": "counter", "value": self._value}
 
 
 class Gauge:
     """Last-value gauge."""
 
     __slots__ = ("name", "_lock", "_value")
+    _GUARDED_BY = {"_value": "_lock"}
 
     def __init__(self, name: str = ""):
         self.name = name
@@ -87,14 +91,16 @@ class Gauge:
 
     @property
     def value(self):
-        return self._value
+        with self._lock:
+            return self._value
 
     def reset(self) -> None:
         with self._lock:
             self._value = 0.0
 
     def snapshot(self) -> dict:
-        return {"type": "gauge", "value": self._value}
+        with self._lock:
+            return {"type": "gauge", "value": self._value}
 
 
 class Histogram:
@@ -107,6 +113,9 @@ class Histogram:
 
     __slots__ = ("name", "_lock", "buckets", "_counts", "count", "sum",
                  "min", "max")
+    # count/sum/min/max are tolerated-atomic reads (mean, tests); the
+    # bucket array is the torn-read hazard and stays lock-only.
+    _GUARDED_BY = {"_counts": "_lock"}
 
     def __init__(self, name: str = "", buckets=LATENCY_MS_BUCKETS):
         self.name = name
@@ -231,16 +240,38 @@ _NULL = _Null()
 class Registry:
     """Thread-safe name -> instrument map."""
 
-    def __init__(self, enabled: bool | None = None):
+    _GUARDED_BY = {"_instruments": "_lock"}
+
+    def __init__(self, enabled: bool | None = None,
+                 strict: bool | None = None):
         self._lock = threading.RLock()
         self._instruments: dict[str, object] = {}
         if enabled is None:
             enabled = os.environ.get("CAKE_OBS_METRICS", "1") != "0"
         self.enabled = enabled
+        # strict mode: refuse to create a series the catalog
+        # (cake_tpu/obs/catalog.py) does not declare — the runtime twin
+        # of the CK-METRIC lint check, for test rigs that want the
+        # can't-fork-a-series invariant enforced hot.
+        if strict is None:
+            strict = os.environ.get("CAKE_OBS_STRICT", "0") == "1"
+        self.strict = strict
+
+    def _check_declared(self, name: str) -> None:
+        from cake_tpu.obs import catalog  # lazy: catalog is pure data
+
+        if not catalog.is_declared(name):
+            raise ValueError(
+                f"metric series '{name}' is not declared in "
+                "cake_tpu/obs/catalog.py (strict registry); declare it "
+                "or fix the typo"
+            )
 
     def _get_or_create(self, name: str, cls, *args):
         if not self.enabled:
             return _NULL
+        if self.strict:
+            self._check_declared(name)
         with self._lock:
             inst = self._instruments.get(name)
             if inst is None:
@@ -271,6 +302,8 @@ class Registry:
         still holds the live instrument for its own reporting)."""
         if not self.enabled:
             return
+        if self.strict:
+            self._check_declared(name)
         with self._lock:
             if not replace and name in self._instruments:
                 raise ValueError(f"metric '{name}' already registered")

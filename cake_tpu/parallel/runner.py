@@ -242,7 +242,11 @@ class RemoteRunner(BlockRunner):
                                   timeout_ms=self._timeout_ms)
         try:
             conn.send(self._MsgType.HELLO)
-            t, payload = conn.recv()
+            # the WorkerInfo reply is a control frame: bound it by the
+            # connect budget, never the (possibly larger) op deadline
+            t, payload = conn.recv(
+                timeout=self._timeout_ms / 1000
+                if self._timeout_ms and self._timeout_ms > 0 else None)
         except Exception:
             # retried handshakes must not leak half-open sockets
             conn.close()

@@ -35,6 +35,9 @@ _BUILD_LOCK = threading.Lock()
 
 _lib = None
 _lib_tried = False
+# Lock discipline, machine-checked by `make lint` (cakelint CK-LOCK):
+# the lazy-loader globals may only be touched under the build lock.
+_GUARDED_BY = {"_lib": "_BUILD_LOCK", "_lib_tried": "_BUILD_LOCK"}
 
 
 def _build_native() -> bool:
@@ -372,9 +375,14 @@ def connect(host: str, port: int, timeout_ms: int = 10000,
             return Connection(fd=fd, timeout_s=default_s)
         _raise(fd)
     sock = socket.create_connection((host, port), timeout=timeout_ms / 1000)
-    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-    _set_keepalive(sock)
-    sock.settimeout(None)
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _set_keepalive(sock)
+        sock.settimeout(None)
+    except Exception:
+        # option setup failing must not leak the connected fd
+        sock.close()
+        raise
     return Connection(sock=sock, timeout_s=default_s)
 
 
@@ -392,9 +400,14 @@ class Listener:
             self.port = lib.cw_local_port(fd)
         else:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind((addr, port))
-            s.listen(16)
+            try:
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind((addr, port))
+                s.listen(16)
+            except Exception:
+                # a failed bind (port in use) must not leak the fd
+                s.close()
+                raise
             self._fd, self._sock, self._lib = None, s, None
             self.port = s.getsockname()[1]
 
@@ -405,8 +418,12 @@ class Listener:
                 _raise(fd)
             return Connection(fd=fd)
         conn, _ = self._sock.accept()
-        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _set_keepalive(conn)
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _set_keepalive(conn)
+        except Exception:
+            conn.close()
+            raise
         # accepted side keeps no default recv deadline: a server waits
         # indefinitely for the peer's next request; keepalive bounds the
         # dead-peer case

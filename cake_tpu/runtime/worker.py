@@ -284,14 +284,17 @@ class Worker:
             self._conns_live += 1
             self._conns_total += 1
         try:
-            t, _ = conn.recv()
+            # timeout=None is a decision, not a default (cakelint CK-WIRE):
+            # the accepted side legitimately waits forever for the master's
+            # next request; TCP keepalive bounds the dead-peer case.
+            t, _ = conn.recv(timeout=None)
             if t != MsgType.HELLO:
                 conn.send(MsgType.ERROR, protocol.encode_error("expected HELLO"))
                 return
             conn.send(MsgType.WORKER_INFO, self._info().to_bytes())
             while not self._stop.is_set():
                 try:
-                    t, payload = conn.recv()
+                    t, payload = conn.recv(timeout=None)
                 except wire.PeerClosed:
                     return
                 if t == MsgType.GOODBYE:

@@ -112,13 +112,19 @@ class ApiServer:
 
     def drain(self, timeout_s: float = 30.0) -> None:
         """Graceful shutdown: stop admitting, let in-flight streams finish
-        (bounded by ``timeout_s``), then stop the listener."""
-        self.scheduler.stop(drain=True, timeout_s=timeout_s)
-        self.close()
+        (bounded by ``timeout_s``), then stop the listener. The listener
+        teardown runs even if the drain raises — a failed drain must not
+        leak the bound port."""
+        try:
+            self.scheduler.stop(drain=True, timeout_s=timeout_s)
+        finally:
+            self.close()
 
     def close(self) -> None:
-        self.httpd.shutdown()
-        self.httpd.server_close()
+        try:
+            self.httpd.shutdown()
+        finally:
+            self.httpd.server_close()
 
 
 def start_api_server(scheduler, status_fn=None, bind: str = "127.0.0.1",
@@ -214,10 +220,18 @@ def _make_handler(server: ApiServer):
             except Draining:
                 self._error(503, "server is draining")
                 return
-            if sess.stream:
-                self._stream_response(sess)
-            else:
-                self._unary_response(sess)
+            # a handler dying mid-pump (any reason, not just the client
+            # socket) must hand the slot back: an uncancelled session
+            # would keep generating into a queue nobody drains until its
+            # token budget runs out
+            try:
+                if sess.stream:
+                    self._stream_response(sess)
+                else:
+                    self._unary_response(sess)
+            finally:
+                if sess.finish_reason is None:
+                    scheduler.cancel(sess)
 
         def _next_event(self, sess):
             """Block on the session queue, but never past a dead engine

@@ -60,6 +60,20 @@ class Scheduler:
     touching the engine runs on the engine thread only.
     """
 
+    # Thread contract, machine-checked by `make lint` (cakelint CK-LOCK):
+    # the admission queue, the live-session map, and the lifecycle flags
+    # are shared between handler threads and the engine thread, and may
+    # only be touched under the condition lock (methods named *_locked
+    # assert their caller already holds it). The throughput-EMA fields
+    # (_tok_s, _rate_*) are engine-thread-only writes with tolerated
+    # atomic reads, so they stay out of the map on purpose.
+    _GUARDED_BY = {
+        "_queue": "_cond",
+        "_by_sid": "_cond",
+        "_draining": "_cond",
+        "_stopping": "_cond",
+    }
+
     def __init__(self, engine, queue_depth: int = 64,
                  request_timeout_s: float | None = None):
         if queue_depth < 1:
@@ -189,12 +203,13 @@ class Scheduler:
         with self._cond:
             queued = len(self._queue)
             running = len(self._by_sid)
+            draining = self._draining
         return {
             "queued": queued,
             "running": running,
             "max_concurrent": self.max_concurrent,
             "queue_depth": self.queue_depth,
-            "draining": self._draining,
+            "draining": draining,
             "observed_tok_s": round(self._tok_s, 2),
             "engine": self.engine.stats(),
         }
@@ -278,10 +293,15 @@ class Scheduler:
     def _deliver(self, row) -> None:
         """Fan one emitted row out to its sessions' event queues."""
         n = 0
+        with self._cond:
+            # _by_sid is written only on this (engine) thread; the locked
+            # snapshot keeps the _GUARDED_BY annotation honest and stays
+            # correct if a second writer ever appears
+            by_sid = dict(self._by_sid)
         for slot, tok in enumerate(row):
             if tok is None:
                 continue
-            sess = self._by_sid.get(self.engine.streams[slot].stream_id)
+            sess = by_sid.get(self.engine.streams[slot].stream_id)
             if sess is None:
                 continue  # priming/dummy slot, or already aborted
             sess.on_token(tok.id, tok.text)
@@ -314,7 +334,9 @@ class Scheduler:
         is the slot/KV free; the detok tail is flushed into the terminal
         event so streamed text matches the full decode."""
         now = time.perf_counter()
-        for sid, sess in list(self._by_sid.items()):
+        with self._cond:
+            items = list(self._by_sid.items())
+        for sid, sess in items:
             reason = None
             if sess.finish_reason in ("stop", "length"):
                 reason = sess.finish_reason

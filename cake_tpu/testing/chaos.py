@@ -237,8 +237,14 @@ class ChaosProxy:
                             self.upstream, e)
                 client.close()
                 continue
-            for s in (client, server):
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            try:
+                for s in (client, server):
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                # a fault injector must not itself leak fds on error paths
+                client.close()
+                server.close()
+                continue
             pair = _Pair(client, server)
             threading.Thread(
                 target=self._pump, daemon=True,

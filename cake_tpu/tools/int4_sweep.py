@@ -45,6 +45,24 @@ SHAPES_8B = [
 
 
 def sweep(json_out: str | None = None, m: int = 1) -> list:
+    # probe the failure-prone setup BEFORE truncating the ledger: a bad
+    # pallas import or a wedged device grant must not zero out the
+    # previous run's rows (the modules stay cached for _sweep)
+    import jax
+
+    from cake_tpu.ops.pallas.quant import quant4_matmul_pallas  # noqa: F401
+    from cake_tpu.ops.quant import quant4_matmul_xla  # noqa: F401
+
+    jax.devices()
+    # `with` owns the ledger file: a sweep dying mid-shape (OOM, ctrl-C)
+    # must not lose buffered rows or leak the fd (cakelint CK-WIRE)
+    if json_out:
+        with open(json_out, "w") as out_f:
+            return _sweep(out_f, m)
+    return _sweep(None, m)
+
+
+def _sweep(out_f, m: int = 1) -> list:
     from cake_tpu.ops.pallas import interpret_default
     from cake_tpu.ops.pallas.quant import (
         quant4_matmul_pallas,
@@ -63,7 +81,6 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
     sys.stderr.write(f"device={dev.device_kind} compiled={compiled} m={m}\n")
     key = jax.random.PRNGKey(0)
     results = []
-    out_f = open(json_out, "w") if json_out else None
 
     def emit(rec):
         results.append(rec)
@@ -186,8 +203,6 @@ def sweep(json_out: str | None = None, m: int = 1) -> list:
                    if int8_ms else ")")
                 + "\n")
 
-    if out_f:
-        out_f.close()
     return results
 
 
