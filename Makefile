@@ -112,6 +112,20 @@ serve-smoke:
 	CAKE_BENCH_SERVE=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
 
+# structured-output smoke: the grammar-constrained decoding plane
+# (cake_tpu/constrain) — regex/JSON-schema -> token-DFA round trips,
+# disk-cache hits, the no-retrace masked decode path (compile-count
+# pinned), schema-constrained serve requests returning valid JSON,
+# stop-string SSE holdback, logprobs vs a numpy softmax reference, and
+# the bit-identical-unconstrained determinism guard — then the
+# CAKE_BENCH_CONSTRAIN constrained-vs-unconstrained HTTP tok/s row
+# (loadgen --workload json; every response must json.loads-parse).
+constrain-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_constrain.py -q \
+	  -m 'not slow'
+	CAKE_BENCH_CONSTRAIN=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -122,7 +136,7 @@ serve-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \

@@ -29,6 +29,12 @@ Knobs (env):
                      engine — aggregate tok/s through the socket plus
                      TTFT p50/p95, next to the in-process serving rows
                      (CAKE_BENCH_BATCH sets the client count).
+  CAKE_BENCH_CONSTRAIN=1 grammar-constrained HTTP serving
+                     (cake_tpu/constrain): loadgen --workload json
+                     requests (response_format json_schema, responses
+                     asserted json.loads-parseable) vs the same server
+                     unconstrained — constrained tok/s with
+                     vs_baseline = constrained/unconstrained.
 """
 
 from __future__ import annotations
@@ -691,6 +697,93 @@ def _run_serve_http(config, params, preset, quant, dev, batch,
     return 0
 
 
+class _AsciiTok:
+    """Printable-ASCII toy tokenizer for the constrained-serving row: id
+    -> one printable char (mod 95), so grammar compilation has real vocab
+    strings without shipping a tokenizer.json in the bench image."""
+
+    def decode(self, ids):
+        return "".join(chr(32 + (i % 95)) for i in ids)
+
+    def encode(self, text):
+        return [ord(c) - 32 for c in text]
+
+
+def _run_serve_constrain(config, params, preset, quant, dev, batch,
+                         steps) -> int:
+    """CAKE_BENCH_CONSTRAIN=1: grammar-constrained HTTP serving
+    (cake_tpu/constrain) vs the same server unconstrained. The
+    constrained leg runs loadgen's --workload json mode — every request
+    carries a response_format json_schema and every response must
+    json.loads-parse — and the figure of merit is constrained tok/s with
+    vs_baseline = constrained/unconstrained (the mask gather + host-side
+    DFA advance + forced single-step dispatch are the whole gap; the
+    design target is within 10% on the smoke config)."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    kv_quant = _kv_quant()
+    batch = max(2, batch)
+    max_tokens = max(32, min(steps * 2, config.max_seq_len - 16))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = BatchGenerator(config, params, settings=settings,
+                         kv_quant=kv_quant, tokenizer=_AsciiTok())
+    sched = Scheduler(gen, queue_depth=4 * batch)
+    sched.start(max_concurrent=batch, warm_prompt_len=8,
+                warm_constrain=True)
+    srv = start_api_server(sched)
+    url = f"http://127.0.0.1:{srv.port}"
+    try:
+        # warm BOTH legs: plain decode/admission compiles, then the
+        # masked-program compile (each leg must measure steady state)
+        loadgen.run_load(url, batch, concurrency=batch, max_tokens=4,
+                         prompt_lens=[8], vocab=config.vocab_size - 1,
+                         seed=1)
+        loadgen.run_load(url, batch, concurrency=batch, max_tokens=4,
+                         prompt_lens=[8], vocab=config.vocab_size - 1,
+                         seed=1, workload="json")
+        plain = loadgen.run_load(
+            url, 2 * batch, concurrency=batch, max_tokens=max_tokens,
+            prompt_lens=[8], vocab=config.vocab_size - 1, seed=2)
+        constrained = loadgen.run_load(
+            url, 2 * batch, concurrency=batch, max_tokens=max_tokens,
+            prompt_lens=[8], vocab=config.vocab_size - 1, seed=3,
+            workload="json")
+    finally:
+        srv.close()
+        sched.close()
+    if (constrained["errors"] or constrained["json_invalid"]
+            or plain["errors"]):
+        sys.stderr.write(f"constrain bench failed: plain={plain} "
+                         f"constrained={constrained}\n")
+        return 1
+    wtag = _wtag(quant, kv_quant)
+    ratio = (constrained["tok_s"] / plain["tok_s"]
+             if plain["tok_s"] else 0.0)
+    _emit({
+        "metric": (f"serve_constrained_tokens_per_sec_{_mtag(preset)}_"
+                   f"{wtag}_1chip_c{batch}"),
+        "value": constrained["tok_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(ratio, 4),
+    }, dev,
+        baseline=f"unconstrained_http_{plain['tok_s']:.1f}tok/s",
+        json_valid=constrained["completed"] - constrained["json_invalid"],
+        requests=constrained["requests"],
+        ttft_p50_ms=constrained["ttft_ms"]["p50"])
+    sys.stderr.write(
+        f"device={dev.device_kind} clients={batch} "
+        f"constrained_tok_s={constrained['tok_s']} "
+        f"unconstrained_tok_s={plain['tok_s']} ratio={ratio:.3f} "
+        f"json_valid={constrained['completed']}/"
+        f"{constrained['requests']}\n"
+    )
+    return 0
+
+
 def _run_churn(config, params, preset, quant, dev, batch, steps,
                multistep) -> int:
     """CAKE_BENCH_CHURN=1: serving under arrival churn. Streams that reach
@@ -1173,6 +1266,9 @@ def main() -> int:
     if os.environ.get("CAKE_BENCH_SERVE") == "1":
         return _run_serve_http(config, params, preset, quant, dev, batch,
                                steps)
+    if os.environ.get("CAKE_BENCH_CONSTRAIN") == "1":
+        return _run_serve_constrain(config, params, preset, quant, dev,
+                                    batch, steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

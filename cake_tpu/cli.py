@@ -286,6 +286,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "(seconds, default 300): expired requests are "
                         "refused while queued (504) or retired mid-stream "
                         "(finish_reason 'timeout'), freeing the slot")
+    p.add_argument("--serve-logprobs", type=int, default=0,
+                   dest="serve_logprobs", metavar="K",
+                   help="--mode serve: per-token top-K logprob capacity — "
+                        "the decode programs also return the top-K "
+                        "log-softmax, so requests may ask 'logprobs': N "
+                        "for any N <= K (default 0: refused with 400; "
+                        "needs the batched mesh engine)")
+    p.add_argument("--logit-bias", default=None, dest="logit_bias",
+                   metavar="ID:BIAS[,ID:BIAS...]",
+                   help="static token-id logit biases compiled into the "
+                        "sampler (all modes; serve requests passing "
+                        "logit_bias must match these values exactly)")
     p.add_argument("--log-level", default="info", dest="log_level",
                    choices=["debug", "info", "warning", "error"],
                    help="root log level for this process (master or worker "
@@ -348,6 +360,17 @@ def _load_tokenizer(model_dir: str):
 def _settings(args):
     from cake_tpu.ops.sampling import SamplerSettings
 
+    bias: tuple = ()
+    if getattr(args, "logit_bias", None):
+        try:
+            bias = tuple(sorted(
+                (int(tok), float(b))
+                for tok, _, b in (pair.partition(":")
+                                  for pair in args.logit_bias.split(","))
+            ))
+        except ValueError:
+            sys.exit("error: --logit-bias wants ID:BIAS[,ID:BIAS...] "
+                     f"(got {args.logit_bias!r})")
     return SamplerSettings(
         temperature=args.temperature,
         top_k=args.top_k,
@@ -355,6 +378,7 @@ def _settings(args):
         repeat_penalty=args.repeat_penalty,
         repeat_last_n=args.repeat_last_n,
         seed=args.seed,
+        logit_bias=bias,
     )
 
 
@@ -540,6 +564,8 @@ def _serve_flags(args) -> list[str]:
         out.append("--queue-depth")
     if args.request_timeout is not None:
         out.append("--request-timeout")
+    if args.serve_logprobs:
+        out.append("--serve-logprobs")
     return out
 
 
@@ -630,6 +656,11 @@ def run_http_serve(args) -> int:
                      "mesh engine; the host-topology serve path "
                      "single-steps the wire master (they would otherwise "
                      "be silently ignored)")
+        if args.serve_logprobs:
+            sys.exit("error: --serve-logprobs needs the batched mesh "
+                     "engine; the host-topology serve path has no "
+                     "logprob outputs (it would otherwise be silently "
+                     "ignored)")
         if max_concurrent > 1:
             log.warning("--max-concurrent %d: a host-addressed --topology "
                         "serves over the single-stream wire master; "
@@ -674,7 +705,7 @@ def run_http_serve(args) -> int:
                 block_size=(args.decode_block
                             if args.decode_block is not None else 8),
                 lookahead=args.lookahead, kv_quant=args.kv_quant,
-                spec_k=args.speculate)
+                spec_k=args.speculate, logprobs=args.serve_logprobs)
         except ValueError as e:
             sys.exit(f"error: {e}")
         # compile the admission path outside the serving window (requests
@@ -683,7 +714,11 @@ def run_http_serve(args) -> int:
 
     scheduler = Scheduler(engine, queue_depth=queue_depth,
                           request_timeout_s=request_timeout)
-    scheduler.start(max_concurrent=max_concurrent, warm_prompt_len=warm_len)
+    # warm the masked (constrained-decoding) program too when requests
+    # could carry response_format — i.e. whenever a tokenizer is loaded
+    # (grammars compile against the vocab's decoded strings)
+    scheduler.start(max_concurrent=max_concurrent, warm_prompt_len=warm_len,
+                    warm_constrain=tokenizer is not None)
 
     def serve_status():
         return {

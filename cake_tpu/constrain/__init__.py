@@ -1,0 +1,22 @@
+"""Structured generation: grammar-constrained decoding (ISSUE 8).
+
+``fsm`` compiles a constraint spec (regex, or JSON Schema lowered to
+regex) into a token-level DFA over the tokenizer vocab — cached in
+process and on disk; ``guide`` holds the per-stream host-side DFA
+cursor the engines advance between compiled decode steps. The mask
+application itself lives inside the compiled decode step
+(ops/sampling.py + parallel/pipeline.py), gathered from a
+device-resident packed bitmask table so constrained decode neither
+retraces nor round-trips logits to the host.
+"""
+
+from cake_tpu.constrain.fsm import (  # noqa: F401
+    RegexError,
+    TokenDFA,
+    build_token_dfa,
+    compile_constraint,
+    json_schema_to_regex,
+    spec_to_regex,
+    token_strings,
+)
+from cake_tpu.constrain.guide import Guide, guide_for  # noqa: F401

@@ -37,6 +37,15 @@ HISTOGRAM = "histogram"
 # name -> (kind, meaning). Grouped by owning subsystem; keep each group
 # sorted so diffs stay reviewable.
 SERIES: dict[str, tuple[str, str]] = {
+    # -- constrained decoding (cake_tpu/constrain) -----------------------
+    "constrain.dead_ends": (
+        COUNTER, "constrained streams retired at a grammar dead end"),
+    "constrain.fsm_cache_hits": (
+        COUNTER, "token-DFA compiles served from memo/disk cache"),
+    "constrain.fsm_cache_misses": (
+        COUNTER, "token-DFA compiles that ran the vocab walk"),
+    "constrain.fsm_compile_ms": (
+        HISTOGRAM, "grammar -> token-DFA compile wall time"),
     # -- generator (local single-stream decode) --------------------------
     "generator.decode_ms": (HISTOGRAM, "per-token decode latency"),
     "generator.prefill_ms": (HISTOGRAM, "prompt prefill latency"),
@@ -53,6 +62,7 @@ SERIES: dict[str, tuple[str, str]] = {
     "serve.decode_dispatch_ms": (HISTOGRAM, "batched decode dispatch"),
     "serve.queue_depth": (GAUGE, "requests waiting for admission"),
     "serve.rejected": (COUNTER, "submissions refused at the queue bound"),
+    "serve.stop_matches": (COUNTER, "streams ended by a stop-string match"),
     "serve.timeouts": (COUNTER, "requests expired (queued or mid-stream)"),
     "serve.tokens_emitted": (COUNTER, "tokens emitted by the batch engine"),
     "serve.tpot_ms": (HISTOGRAM, "inter-token gap per serving request"),

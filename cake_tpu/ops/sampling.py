@@ -38,10 +38,26 @@ class SamplerSettings:
     repeat_penalty: float = DEFAULT_REPEAT_PENALTY
     repeat_last_n: int = DEFAULT_REPEAT_LAST_N
     seed: int = DEFAULT_SEED
+    # Static per-server token biasing: ((token_id, bias), ...) added to
+    # the raw logits before everything else. A tuple (not a dict) so the
+    # settings object stays hashable/static; the serve API normalizes
+    # request dicts to this form. Empty = bit-identical no-op.
+    logit_bias: tuple[tuple[int, float], ...] = ()
 
     @property
     def greedy(self) -> bool:
         return self.temperature <= 0.0
+
+
+def validate_logit_bias(settings: SamplerSettings, vocab_size: int) -> None:
+    """Engine-construction check: biasing an out-of-range id would clamp
+    in the scatter and silently bias the wrong token."""
+    bad = [i for i, _ in settings.logit_bias
+           if not 0 <= int(i) < vocab_size]
+    if bad:
+        raise ValueError(
+            f"logit_bias token ids out of range [0, {vocab_size}): "
+            f"{bad[:5]}")
 
 
 def apply_repeat_penalty(
@@ -73,17 +89,42 @@ def _mask_top_p(logits: jax.Array, p: float) -> jax.Array:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def _bias_and_mask(
+    logits: jax.Array,  # [vocab] f32
+    settings: SamplerSettings,
+    mask: jax.Array | None,  # [vocab] bool — True = token allowed
+) -> jax.Array:
+    """Logit-bias scatter + constraint mask, applied to the RAW logits
+    before the penalty/temperature/nucleus transforms so the nucleus is
+    computed over the *allowed* distribution (masking after top-p could
+    strand the whole nucleus at -inf). Both are static no-ops when unset
+    — the unconstrained path stays bit-identical to the pre-mask sampler
+    (``jnp.where`` with an all-True mask returns logits unchanged, and
+    neither branch traces at all when absent)."""
+    if settings.logit_bias:
+        ids = jnp.asarray([int(i) for i, _ in settings.logit_bias],
+                          jnp.int32)
+        vals = jnp.asarray([float(b) for _, b in settings.logit_bias],
+                           jnp.float32)
+        logits = logits.at[ids].add(vals)
+    if mask is not None:
+        logits = jnp.where(mask, logits, NEG_INF)
+    return logits
+
+
 def processed_logits(
     logits: jax.Array,  # [vocab] f32
     history: jax.Array,  # [repeat_last_n] int32 ring buffer, -1 empty
     settings: SamplerSettings,
+    mask: jax.Array | None = None,  # [vocab] bool constraint mask
 ) -> jax.Array:
     """The exact pre-categorical transform of :func:`sample_token` —
-    repeat penalty -> temperature -> top-k -> top-p — factored out so
-    rejection-sampling speculation (runtime/speculative.py) evaluates the
-    SAME distribution the plain sampler draws from (one policy source).
-    Requires ``temperature > 0``."""
+    logit bias -> constraint mask -> repeat penalty -> temperature ->
+    top-k -> top-p — factored out so rejection-sampling speculation
+    (runtime/speculative.py) evaluates the SAME distribution the plain
+    sampler draws from (one policy source). Requires ``temperature > 0``."""
     assert not settings.greedy, "processed_logits is the sampled-path transform"
+    logits = _bias_and_mask(logits, settings, mask)
     if settings.repeat_penalty != 1.0:
         logits = apply_repeat_penalty(logits, history, settings.repeat_penalty)
     logits = logits / jnp.float32(settings.temperature)
@@ -99,16 +140,20 @@ def sample_token(
     key: jax.Array,
     history: jax.Array,  # [repeat_last_n] int32 ring buffer, -1 empty
     settings: SamplerSettings,
+    mask: jax.Array | None = None,  # [vocab] bool — True = allowed
 ) -> jax.Array:
     """Pure sampling step -> scalar int32 token. Jittable; ``settings`` is
-    static (mode selection mirrors llama.rs:45-58)."""
+    static (mode selection mirrors llama.rs:45-58). ``mask`` is the
+    constrained-decoding operand (constrain/): disallowed tokens sample
+    with probability ~0 on every path, greedy included."""
     if settings.greedy:
+        logits = _bias_and_mask(logits, settings, mask)
         if settings.repeat_penalty != 1.0:
             logits = apply_repeat_penalty(logits, history,
                                           settings.repeat_penalty)
         return jnp.argmax(logits).astype(jnp.int32)
     return jax.random.categorical(
-        key, processed_logits(logits, history, settings)
+        key, processed_logits(logits, history, settings, mask)
     ).astype(jnp.int32)
 
 
@@ -133,6 +178,7 @@ def sample_tokens_keyed(
     row_keys: jax.Array,  # [B, 2] uint32 — one PRNG key per stream
     history: jax.Array,  # [B, repeat_last_n] int32
     settings: SamplerSettings,
+    mask: jax.Array | None = None,  # [B, vocab] bool per-stream constraint
 ) -> jax.Array:
     """Batched sampling with *explicit per-row keys* -> [B] int32.
 
@@ -141,10 +187,37 @@ def sample_tokens_keyed(
     stream's stochastic output depends only on (its key, its logits, its
     history) — invariant to batch composition and mesh layout. This is the
     multi-stream serving contract: stream key = fold_in(base, stream_id),
-    stepped by fold_in(. , token_index) in the caller/program."""
-    return jax.vmap(lambda l, k, h: sample_token(l, k, h, settings))(
-        logits, row_keys, history
-    )
+    stepped by fold_in(. , token_index) in the caller/program. ``mask``
+    is the per-stream constrained-decoding row (unconstrained rows pass
+    all-True and sample bit-identically to the mask-less call)."""
+    if mask is None:
+        return jax.vmap(lambda l, k, h: sample_token(l, k, h, settings))(
+            logits, row_keys, history
+        )
+    return jax.vmap(
+        lambda l, k, h, m: sample_token(l, k, h, settings, mask=m)
+    )(logits, row_keys, history, mask)
+
+
+def unpack_mask_bits(bits: jax.Array, vocab: int) -> jax.Array:
+    """``[..., ceil(V/8)] uint8`` little-endian packed masks -> ``[..., V]``
+    bool. The in-program twin of ``np.unpackbits(..., bitorder='little')``
+    (jnp has no unpackbits) — used by the compiled decode step on the
+    rows it gathers from the device-resident constraint table."""
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    b = (bits[..., :, None] >> shifts) & jnp.uint8(1)
+    flat = b.reshape(bits.shape[:-1] + (bits.shape[-1] * 8,))
+    return flat[..., :vocab].astype(jnp.bool_)
+
+
+def topk_logprobs(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Top-``k`` of ``log_softmax(logits)`` -> (values, ids), computed on
+    the RAW model logits (pre bias/mask/penalty — the model's own
+    distribution, which is what an OpenAI-style ``logprobs`` field
+    reports). Works on any leading batch shape."""
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    vals, ids = jax.lax.top_k(lp, k)
+    return vals, ids.astype(jnp.int32)
 
 
 def push_history(history: jax.Array, slot: jax.Array, token: jax.Array):

@@ -226,7 +226,7 @@ def _dp_fold(key: jax.Array, dp: int) -> jax.Array:
 def build_sharded_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
     params_like: dict | None = None, steps: int = 1, per_row: bool = False,
-    kv_quant: str | None = None,
+    kv_quant: str | None = None, masked: bool = False, logprobs_k: int = 0,
 ):
     """Compile the fused multi-chip decode step.
 
@@ -258,10 +258,34 @@ def build_sharded_decode(
     owner-masked KV write and the per-row-masked distributed flash decode
     (ops/ring.py), which is what lets MULTI-stream serving ride a window
     sharded across chips.
+
+    ``masked=True`` (requires ``per_row`` and ``steps == 1``) is the
+    constrained-decoding variant (constrain/): the signature gains two
+    trailing operands — ``mask_table [M, ceil(V/8)] uint8`` (the
+    device-resident packed per-state allowed-token bitmasks; row 0 is
+    all-ones for unconstrained streams) and ``mask_row [B] int32`` (each
+    stream's current DFA-state row) — and the compiled body gathers each
+    stream's row, unpacks it, and applies it inside the sampler. The DFA
+    advance stays host-side between dispatches (CK-JIT: nothing
+    stateful traces); both shapes are static, so constrained decode
+    never retraces per token. Single-step only by design: a fused block
+    would need the host-side DFA advance mid-program.
+
+    ``logprobs_k > 0`` (requires ``per_row``) additionally returns the
+    top-k log-softmax of the RAW logits per emitted token — outputs gain
+    trailing ``(lp_vals, lp_ids)`` (``[B, k]``, or ``[steps, B, k]`` for
+    fused blocks). The sampled stream is unchanged: the top-k is a pure
+    extra read of logits the program already computed.
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
+    if masked and (not per_row or steps != 1):
+        raise ValueError("masked decode requires per_row=True, steps=1 "
+                         "(the DFA advance is host-side between steps)")
+    if logprobs_k and not per_row:
+        raise ValueError("logprobs_k requires the per_row serving mode")
 
-    def one_step(params, token, cache, pos, key, history, hist_slot):
+    def one_step(params, token, cache, pos, key, history, hist_slot,
+                 mask=None):
         # cache.max_seq inside shard_map is the per-shard slice; RoPE tables
         # must cover global positions.
         cos, sin = rope_tables(
@@ -276,13 +300,16 @@ def build_sharded_decode(
         )
         x_last = _select_stage0(x[:, -1, :])
         logits = _head_logits(params, x_last, config)
+        lp = sampling.topk_logprobs(logits, logprobs_k) if logprobs_k \
+            else None
         if per_row:
-            tok = sampling.sample_tokens_keyed(logits, key, history, settings)
+            tok = sampling.sample_tokens_keyed(logits, key, history,
+                                               settings, mask=mask)
         else:
             tok = sampling.sample_tokens(logits, _dp_fold(key, plan.dp),
                                          history, settings)
         history, hist_slot = sampling.push_history_batched(history, hist_slot, tok)
-        return tok, KVCache(k=ck, v=cv), history, hist_slot
+        return tok, KVCache(k=ck, v=cv), history, hist_slot, lp
 
     def fold_key(key, index):
         if per_row:  # key [B, 2], index [B] (per-stream schedules)
@@ -299,27 +326,54 @@ def build_sharded_decode(
         P(DP) if per_row else P(),  # hist_slot: per-stream ring positions
     ]
     if steps == 1 and not per_row:
-        step = one_step
+        def step(params, token, cache, pos, key, history, hist_slot):
+            tok, cache, history, hist_slot, _ = one_step(
+                params, token, cache, pos, key, history, hist_slot)
+            return tok, cache, history, hist_slot
     else:
-        def step(params, token, cache, pos, key, history, hist_slot, index0):
+        def step(params, token, cache, pos, key, history, hist_slot,
+                 index0, *mask_args):
+            if masked:
+                mask_table, mask_row = mask_args
+                # one gather + unpack per dispatch: each stream's current
+                # DFA-state bitmask row, from the table uploaded once
+                row_mask = sampling.unpack_mask_bits(
+                    mask_table[mask_row], config.vocab_size)
+            else:
+                row_mask = None
+
             def body(carry, i):
                 token, cache, history, hist_slot = carry
-                tok, cache, history, hist_slot = one_step(
+                tok, cache, history, hist_slot, lp = one_step(
                     params, token, cache, pos + i, fold_key(key, index0 + i),
-                    history, hist_slot,
+                    history, hist_slot, mask=row_mask,
                 )
-                return (tok, cache, history, hist_slot), tok
+                ys = (tok, lp[0], lp[1]) if logprobs_k else tok
+                return (tok, cache, history, hist_slot), ys
 
-            (_, cache, history, hist_slot), toks = jax.lax.scan(
+            (_, cache, history, hist_slot), ys = jax.lax.scan(
                 body, (token, cache, history, hist_slot),
                 jnp.arange(steps, dtype=jnp.int32),
             )
+            if logprobs_k:
+                toks, lpv, lpi = ys
+            else:
+                toks, lpv, lpi = ys, None, None
             if steps == 1:
-                return toks[0], cache, history, hist_slot
-            return toks, cache, history, hist_slot
+                out = (toks[0], cache, history, hist_slot)
+                return out + ((lpv[0], lpi[0]) if logprobs_k else ())
+            out = (toks, cache, history, hist_slot)
+            return out + ((lpv, lpi) if logprobs_k else ())
 
         in_specs.append(P(DP) if per_row else P())  # index0
+        if masked:
+            in_specs.append(P(None, None))  # mask_table: replicated
+            in_specs.append(P(DP))          # mask_row: per-stream
 
+    lp_specs = ()
+    if logprobs_k:
+        lp_specs = ((P(DP, None),) * 2 if steps == 1
+                    else (P(None, DP, None),) * 2)
     sharded = shard_map(
         step,
         mesh=plan.mesh,
@@ -329,7 +383,7 @@ def build_sharded_decode(
             cache_specs(kv_quant),
             P(DP, None),
             P(DP) if per_row else P(),
-        ),
+        ) + lp_specs,
         check_vma=False,
     )
     return jax.jit(sharded, donate_argnums=(2,))

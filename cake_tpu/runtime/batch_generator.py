@@ -102,6 +102,14 @@ class _Stream:
     done: bool = False
     active: bool = True  # False: batch-padding dummy, never emitted
     detok: TokenOutputStream | None = None
+    # why the stream ended: "eos" | "length" (window full) | "constraint"
+    # (grammar dead end) — the serve scheduler's finish_reason source
+    end_reason: str | None = None
+
+
+# initial device mask-table capacity (rows); grows by doubling as guides
+# attach, so the masked decode program compiles once per pow2 table shape
+_MASK_CAP0 = 64
 
 
 class BatchGenerator:
@@ -138,6 +146,7 @@ class BatchGenerator:
         spec_k: int = 0,
         spec_ngram: int = 3,
         spec_rounds: int = 8,
+        logprobs: int = 0,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -158,6 +167,16 @@ class BatchGenerator:
         self.config = config
         self.plan = plan
         self.settings = settings or SamplerSettings()
+        sampling.validate_logit_bias(self.settings, config.vocab_size)
+        # Per-token top-k logprob reporting (serve `logprobs: N`): the
+        # decode programs additionally return the top-k log-softmax of
+        # the raw logits. Pure extra outputs — the sampled streams are
+        # bit-identical with it on or off.
+        self.logprobs_k = max(0, int(logprobs))
+        if self.logprobs_k and spec_k:
+            raise ValueError("logprobs do not compose with batched "
+                             "speculation (spec_k): accepted runs have no "
+                             "per-step logits to report")
         self.max_seq = max_seq or config.max_seq_len
         if plan.sp > 1 and self.max_seq % plan.sp:
             raise ValueError(
@@ -234,14 +253,15 @@ class BatchGenerator:
             config, plan, params_like=self.params, kv_quant=kv_quant))
         self._decode_single = self._pinned(build_sharded_decode(
             config, self.settings, plan, params_like=self.params,
-            per_row=True, kv_quant=kv_quant,
+            per_row=True, kv_quant=kv_quant, logprobs_k=self.logprobs_k,
         ))
         self._decode_block = (
             self._pinned(build_sharded_decode(config, self.settings, plan,
                                               params_like=self.params,
                                               steps=self.block_size,
                                               per_row=True,
-                                              kv_quant=kv_quant))
+                                              kv_quant=kv_quant,
+                                              logprobs_k=self.logprobs_k))
             if self.block_size > 1 else None
         )
         # Interleaved-microbatch schedule (pipeline.build_interleaved_decode):
@@ -255,6 +275,11 @@ class BatchGenerator:
             plan.num_stages > 1 if interleave is None
             else interleave and plan.num_stages > 1
         )
+        if self.logprobs_k:
+            # the interleaved schedule has no logprob outputs (its head
+            # runs vocab-split per stage); serialized programs are
+            # bit-identical, so logprob serving just uses those
+            self._interleave = False
         self._decode_single_il = (
             self._pinned(build_interleaved_decode(
                 config, self.settings, plan, params_like=self.params,
@@ -270,6 +295,20 @@ class BatchGenerator:
         self._base_key = jax.random.PRNGKey(self.settings.seed)
         self.streams: list[_Stream] = []
         self._eos_ids = set(config.eos_ids())
+        # Constrained decoding (cake_tpu/constrain): per-slot Guide
+        # cursors advanced host-side between steps; their DFAs' packed
+        # mask rows live concatenated in ONE device-resident uint8 table
+        # (row 0 = all-ones for unconstrained streams) that the masked
+        # decode program gathers from by the per-slot mask_row vector.
+        # The table re-uploads only when a guide attaches; its row
+        # capacity grows by doubling so the masked program compiles once
+        # per pow2 shape (compile-count pinned by test).
+        self._guides: dict[int, object] = {}       # slot -> Guide
+        self._guide_rows: dict[int, int] = {}      # slot -> table base row
+        self._mask_table = None                    # jnp [cap, ceil(V/8)] u8
+        self.__masked = None                       # _pinned masked program
+        self._masked_jit = None                    # raw jit (compile count)
+        self._first_lp = None                      # first-token logprobs
         # Continuous-batching admission: arrivals queue here (enqueue) and
         # prefill ONE chunk per step() interleaved with decode dispatches,
         # as a single replicated row in a staging cache — no dp discarded
@@ -513,6 +552,146 @@ class BatchGenerator:
                 return fn(*args)
         return wrapped
 
+    # -- constrained decoding (cake_tpu/constrain) ---------------------------
+    @property
+    def eos_ids(self) -> frozenset:
+        """Public EOS-id surface of the engine facade — what the serve
+        scheduler maps finish reasons with (no private-attr reaches)."""
+        return frozenset(self._eos_ids)
+
+    @property
+    def _decode_single_masked(self):
+        """The constrained single-step decode program, compiled on first
+        use (unconstrained serving never pays for it). ``_masked_jit``
+        keeps the raw jitted callable so tests can pin its compile count
+        — exactly one compile per (batch, table-capacity) shape."""
+        if self.__masked is None:
+            self._masked_jit = build_sharded_decode(
+                self.config, self.settings, self.plan,
+                params_like=self.params, per_row=True,
+                kv_quant=self.kv_quant, masked=True,
+                logprobs_k=self.logprobs_k,
+            )
+            self.__masked = self._pinned(self._masked_jit)
+        return self.__masked
+
+    def _check_guide_ok(self, guide) -> None:
+        """Constraint-compatibility gate, raised where callers can turn
+        it into a client error (enqueue / set_prompts) — NOT on the
+        engine thread mid-step, where it would read as an engine fault
+        and drain the server."""
+        if guide is not None and self._spec_k:
+            raise ValueError(
+                "constrained decoding does not compose with batched "
+                "speculation (spec_k): the fused verify rounds cannot "
+                "advance the host-side DFA between tokens")
+
+    def _attach_guide(self, slot: int, guide, rebuild: bool = True) -> None:
+        """Bind a Guide to a batch slot and (by default) refresh the
+        device mask table. Engine-thread only (like every other
+        mutation); batch attachers pass rebuild=False and rebuild once."""
+        self._check_guide_ok(guide)
+        guide.reset()
+        self._guides[slot] = guide
+        if rebuild:
+            self._rebuild_mask_table()
+
+    def _drop_guide(self, slot: int) -> None:
+        self._guides.pop(slot, None)
+        self._guide_rows.pop(slot, None)
+        # stale table rows are simply never referenced again; the table
+        # re-packs at the next attach
+
+    def _rebuild_mask_table(self) -> None:
+        """Re-pack every attached guide's DFA mask rows into one device
+        table: [row 0 = all-ones] + each guide's block. One host->device
+        upload per ATTACH, never per token; capacity doubles so the
+        masked program's traced shape is stable across attachments."""
+        v8 = (self.config.vocab_size + 7) // 8
+        blocks = [np.full((1, v8), 0xFF, np.uint8)]
+        base = 1
+        self._guide_rows = {}
+        for slot in sorted(self._guides):
+            bits = self._guides[slot].dfa.mask_bits
+            self._guide_rows[slot] = base
+            blocks.append(bits)
+            base += bits.shape[0]
+        cap = _MASK_CAP0
+        while cap < base:
+            cap *= 2
+        table = np.zeros((cap, v8), np.uint8)
+        table[:base] = np.concatenate(blocks)
+        self._mask_table = jnp.asarray(table)
+
+    def _guides_live(self) -> bool:
+        return any(
+            self.streams[i].active and not self.streams[i].done
+            for i in self._guides
+        )
+
+    def _mask_rows_np(self) -> np.ndarray:
+        """Per-slot mask-row vector for the next dispatch: row 0
+        (all-ones) for unconstrained/done slots, the guide's current
+        DFA-state row otherwise."""
+        rows = np.zeros((len(self.streams),), np.int32)
+        for slot, g in self._guides.items():
+            s = self.streams[slot]
+            if s.active and not s.done:
+                rows[slot] = self._guide_rows[slot] + g.state
+        return rows
+
+    def _first_mask(self, b: int):
+        """[B, V] bool constraint mask for the post-prefill first-token
+        sampling (host-path), or None when no stream is constrained."""
+        if not self._guides:
+            return None
+        mask = np.ones((b, self.config.vocab_size), bool)
+        for slot, g in self._guides.items():
+            mask[slot] = g.mask_bool()
+        return jnp.asarray(mask)
+
+    def _advance_guide(self, slot: int, s: _Stream, tok_id: int) -> None:
+        """Host-side DFA advance for one emitted token; a dead end (no
+        emittable token at the new state, not even EOS) retires the
+        stream with end_reason 'constraint'."""
+        g = self._guides.get(slot)
+        if g is None:
+            return
+        if s.done:
+            self._drop_guide(slot)
+            return
+        if not g.advance(tok_id) or g.dead_end:
+            from cake_tpu.constrain.guide import DEAD_ENDS
+
+            s.done = True
+            s.end_reason = "constraint"
+            self._drop_guide(slot)
+            DEAD_ENDS.inc()
+
+    def warm_constrain(self) -> None:
+        """Compile the masked decode program against the live batch
+        shapes outside the serving window (same contract as
+        ``warm_blocks``/``warm_admission``: the first constrained request
+        must not pay XLA compilation mid-serving). Uses a sacrificial
+        cache copy; live state untouched."""
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        table = self._mask_table
+        if table is None:
+            v8 = (self.config.vocab_size + 7) // 8
+            t = np.zeros((_MASK_CAP0, v8), np.uint8)
+            t[0] = 0xFF
+            table = jnp.asarray(t)
+            self._mask_table = table
+        cache = jax.tree.map(lambda x: x.copy(), self.cache)
+        out = self._decode_single_masked(
+            self.params, self._last_tokens, cache, jnp.asarray(self._pos),
+            self._keys, self._history, self._hist_slot,
+            jnp.asarray(self._index), table,
+            jnp.zeros((len(self.streams),), jnp.int32),
+        )
+        jax.block_until_ready(out)
+
     # -- prompt intake -------------------------------------------------------
     def _encode(self, p) -> list[int]:
         """Tokenize/validate one prompt (the shared single-stream
@@ -524,10 +703,14 @@ class BatchGenerator:
         self,
         prompts: list[list[int] | str],
         stream_ids: list[int] | None = None,
+        guides: list | None = None,
     ) -> None:
         """Admit a batch of prompts. ``stream_ids`` pin each stream's
         sampling-key identity (default: its index) — the handle that makes a
-        stream reproducible in any batch composition."""
+        stream reproducible in any batch composition. ``guides`` (optional,
+        aligned with ``prompts``; None entries = unconstrained) attach a
+        constrain.Guide per stream — its grammar masks every sampling step
+        including this call's first token."""
         if not prompts:
             raise ValueError("empty batch")
         ids_list = [self._encode(p) for p in prompts]
@@ -535,6 +718,10 @@ class BatchGenerator:
             stream_ids = list(range(len(ids_list)))
         if len(stream_ids) != len(ids_list):
             raise ValueError("stream_ids/prompts length mismatch")
+        if guides is not None and len(guides) != len(ids_list):
+            raise ValueError("guides/prompts length mismatch")
+        self._guides = {}
+        self._guide_rows = {}
 
         # pad the batch to a dp multiple with inactive dummies (they compute,
         # they are never emitted)
@@ -566,6 +753,12 @@ class BatchGenerator:
                 _Stream(stream_id=-1, prompt=list(ids_list[0]), active=False)
             )
         b = len(self.streams)
+        if guides is not None:
+            for i, g in enumerate(guides):
+                if g is not None:
+                    self._attach_guide(i, g, rebuild=False)
+            if self._guides:
+                self._rebuild_mask_table()  # one repack+upload per batch
 
         # (the prefix store survives set_prompts: rows depend only on
         # params/config, both fixed for the instance's lifetime)
@@ -653,8 +846,13 @@ class BatchGenerator:
         # token-index schedule the in-program decode steps continue
         keys0 = jax.vmap(lambda k: jax.random.fold_in(k, 0))(self._keys)
         toks = sampling.sample_tokens_keyed(
-            logits, keys0, self._history, self.settings
+            logits, keys0, self._history, self.settings,
+            mask=self._first_mask(b),
         )
+        self._first_lp = None
+        if self.logprobs_k:
+            lpv, lpi = sampling.topk_logprobs(logits, self.logprobs_k)
+            self._first_lp = (self._host(lpv), self._host(lpi))
         self._history, self._hist_slot = sampling.push_history_batched(
             self._history, self._hist_slot, toks
         )
@@ -684,7 +882,7 @@ class BatchGenerator:
             None,
         )
 
-    def enqueue(self, prompt, stream_id: int) -> None:
+    def enqueue(self, prompt, stream_id: int, guide=None) -> None:
         """Queue a prompt for continuous admission. Each subsequent
         ``step()`` advances its prefill by ONE chunk dispatch (a single
         replicated row into a staging cache) alongside the running batch's
@@ -696,8 +894,14 @@ class BatchGenerator:
         indices). Composes with ``sp > 1`` (r5): the staged row's chunks
         run replicated over sp against the sequence-sharded staging cache
         (owner-masked range writes + the chunk attend,
-        pipeline.build_admit_prefill)."""
-        self._arrivals.append((self._encode(prompt), stream_id))
+        pipeline.build_admit_prefill). ``guide`` (a constrain.Guide)
+        attaches grammar-constrained decoding to the stream: its mask
+        applies from the admission's first sampled token on. Guide
+        compatibility is checked HERE (a serve scheduler turns the
+        ValueError into a 400) rather than at attach time on the engine
+        thread (where it would read as an engine fault)."""
+        self._check_guide_ok(guide)
+        self._arrivals.append((self._encode(prompt), stream_id, guide))
 
     def pending_admissions(self) -> int:
         """Arrivals not yet fully admitted (queued + in-flight)."""
@@ -809,7 +1013,7 @@ class BatchGenerator:
         if self._staging is None:
             if not self._arrivals or self._free_slot() is None:
                 return
-            ids, sid = self._arrivals.pop(0)
+            ids, sid, guide = self._arrivals.pop(0)
             # Prefix reuse: an arrival whose opening tokens match a stored
             # prefix row (its batch's system prompt, or ANY earlier
             # admission's block-aligned prefix) starts from a COPY of that
@@ -842,7 +1046,7 @@ class BatchGenerator:
             self._staging = {
                 "ids": ids, "sid": sid, "slot": self._free_slot(),
                 "tokens": tokens, "pos": 0, "chunk": chunk, "base": base,
-                "cache": cache,
+                "cache": cache, "guide": guide,
             }
         st = self._staging
         pos, chunk, base = st["pos"], st["chunk"], st["base"]
@@ -909,22 +1113,33 @@ class BatchGenerator:
         token, and queue its emission row."""
         st, self._staging = self._staging, None
         slot, ids, stream_id = st["slot"], st["ids"], st["sid"]
+        guide = st.get("guide")
         # Buffered block rows belong to the pre-admission state: record
         # them before the slot's column changes meaning, so streaming
         # step() consumers still receive every Token. An in-flight
         # lookahead block is the same chronology, one block later — fetch
         # and record it too (its rows are also pre-admission tokens).
         while self._block_buf:
-            self._pending_rows.append(self._emit(self._block_buf.popleft()))
+            self._pending_rows.append(
+                self._emit_buffered(self._block_buf.popleft()))
         if self._inflight is not None:
-            toks_if, _ = self._inflight
+            toks_if, lpv_if, lpi_if, _ = self._inflight
             self._inflight = None
             t0 = time.perf_counter()
             rows_if = self._host(toks_if)
+            lp_if = ((self._host(lpv_if), self._host(lpi_if))
+                     if lpv_if is not None else None)
             self._busy_s += time.perf_counter() - t0
             for i in range(rows_if.shape[0]):
-                self._pending_rows.append(self._emit(rows_if[i]))
+                self._pending_rows.append(self._emit(
+                    rows_if[i],
+                    lp=(lp_if[0][i], lp_if[1][i]) if lp_if else None,
+                ))
 
+        # the slot's previous stream is gone; its guide (if any) with it
+        self._drop_guide(slot)
+        if guide is not None:
+            self._attach_guide(slot, guide)
         key = jax.random.fold_in(self._base_key, stream_id)
         n_hist = self.settings.repeat_last_n
         hist_row = np.full((n_hist,), -1, np.int32)
@@ -933,9 +1148,16 @@ class BatchGenerator:
         tok = sampling.sample_token(
             logits[0], jax.random.fold_in(key, 0), jnp.asarray(hist_row),
             self.settings,
+            mask=jnp.asarray(guide.mask_bool()) if guide is not None
+            else None,
         )
         tok_id = int(tok)
         hist_row[len(tail) % n_hist] = tok_id
+        lp_row = None
+        if self.logprobs_k:
+            lpv0, lpi0 = sampling.topk_logprobs(logits[0], self.logprobs_k)
+            lp_row = [(int(i), float(v))
+                      for v, i in zip(np.asarray(lpv0), np.asarray(lpi0))]
 
         (self.cache, self._keys, self._history, self._hist_slot,
          self._last_tokens) = self._splice_fn()(
@@ -962,12 +1184,18 @@ class BatchGenerator:
             self._spec_ctx_pos = None
         s.generated.append(tok_id)
         window_full = len(ids) + 1 >= self.max_seq
-        s.done = (tok_id in self._eos_ids) or window_full
-        text = s.detok.next_token(tok_id) if s.detok else None
+        is_eos = tok_id in self._eos_ids
+        s.done = is_eos or window_full
+        if s.done:
+            s.end_reason = "eos" if is_eos else "length"
+        self._advance_guide(slot, s, tok_id)
+        text = (s.detok.next_token(tok_id)
+                if s.detok is not None and not is_eos else None)
         self._n_emitted += 1
         self._emitted_ctr.inc()
         row: list[Token | None] = [None] * len(self.streams)
-        row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done)
+        row[slot] = Token(id=tok_id, text=text, is_end_of_stream=s.done,
+                          logprobs=lp_row)
         self._pending_rows.append(row)
 
         # Feed the store: this arrival's prefix, truncated to a
@@ -998,9 +1226,10 @@ class BatchGenerator:
         (buffered fused-block rows, an in-flight lookahead block, banked
         speculation runs) are discarded at emission like any other
         past-EOS overrun."""
-        for s in self.streams:
+        for i, s in enumerate(self.streams):
             if s.active and not s.done and s.stream_id == stream_id:
                 s.done = True
+                self._drop_guide(i)
                 return True
         if self._staging is not None and self._staging["sid"] == stream_id:
             self._staging = None  # staged KV row is dropped with it
@@ -1019,7 +1248,7 @@ class BatchGenerator:
         if not self.streams:
             raise RuntimeError("set_prompts first")
         ids = self._encode(prompt)
-        self._arrivals.append((ids, stream_id))
+        self._arrivals.append((ids, stream_id, None))
         # Drain until OUR arrival (tracked by list identity — FIFO order
         # admits anything queued ahead of it first) is fully admitted. If
         # the queue head cannot start because every stream is live, raise
@@ -1038,11 +1267,15 @@ class BatchGenerator:
         return slot, row[slot]
 
     # -- stepping ------------------------------------------------------------
-    def _emit(self, row: np.ndarray,
-              skip: list[bool] | None = None) -> list[Token | None]:
+    def _emit(self, row: np.ndarray, skip: list[bool] | None = None,
+              lp=None) -> list[Token | None]:
         """Turn one [B] token row into per-stream Tokens (None when done or
         dummy), updating per-stream bookkeeping. ``skip[i]`` excludes a
-        stream from this row without marking it done."""
+        stream from this row without marking it done. ``lp`` is the
+        optional per-row top-k logprob pair ``(vals [B, K], ids [B, K])``.
+        Constrained streams advance their host-side DFA cursor here —
+        the one host-side step per token the no-retrace design needs."""
+        lpv, lpi = lp if lp is not None else (None, None)
         out: list[Token | None] = []
         for i, s in enumerate(self.streams):
             if not s.active or s.done or (skip is not None and skip[i]):
@@ -1051,13 +1284,30 @@ class BatchGenerator:
             tok_id = int(row[i])
             s.generated.append(tok_id)
             window_full = len(s.prompt) + len(s.generated) >= self.max_seq
-            s.done = (tok_id in self._eos_ids) or window_full
-            text = s.detok.next_token(tok_id) if s.detok else None
-            out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done))
+            is_eos = tok_id in self._eos_ids
+            s.done = is_eos or window_full
+            if s.done:
+                s.end_reason = "eos" if is_eos else "length"
+            self._advance_guide(i, s, tok_id)
+            # the EOS id is an end marker, not text: detokenizing it would
+            # append its (toy tokenizers: arbitrary) surface form
+            text = (s.detok.next_token(tok_id)
+                    if s.detok is not None and not is_eos else None)
+            lp_i = None
+            if lpv is not None:
+                lp_i = [(int(lpi[i, j]), float(lpv[i, j]))
+                        for j in range(lpi.shape[1])]
+            out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done,
+                             logprobs=lp_i))
         emitted = sum(1 for t in out if t is not None)
         self._n_emitted += emitted
         self._emitted_ctr.inc(emitted)
         return out
+
+    def _emit_buffered(self, entry) -> list[Token | None]:
+        """Emit one buffered fused-block row: ``(row [B], lp-or-None)``."""
+        row, lp = entry
+        return self._emit(row, lp=lp)
 
     def step(self) -> list[Token | None]:
         """Advance every live stream one token; returns one entry per active
@@ -1074,6 +1324,7 @@ class BatchGenerator:
             return self._emit(
                 self._host(self._last_tokens),
                 skip=[bool(s.generated) for s in self.streams],
+                lp=self._first_lp,
             )
         self._admission_tick()
         if self._pending_rows:
@@ -1381,7 +1632,8 @@ class BatchGenerator:
                 prog = self._pinned(build_sharded_decode(
                     self.config, self.settings, self.plan,
                     params_like=self.params, steps=steps, per_row=True,
-                    kv_quant=self.kv_quant))
+                    kv_quant=self.kv_quant,
+                    logprobs_k=self.logprobs_k))
             self.__block_progs[key] = prog
         return prog
 
@@ -1436,35 +1688,44 @@ class BatchGenerator:
         (same `_emit` path as stepping); the Token rows land in the
         pending queue for any consumer still calling step()."""
         while self._block_buf:
-            self._pending_rows.append(self._emit(self._block_buf.popleft()))
+            self._pending_rows.append(
+                self._emit_buffered(self._block_buf.popleft()))
         if self._inflight is not None:
-            toks, _ = self._inflight
+            toks, lpv, lpi, _ = self._inflight
             self._inflight = None
             t0 = time.perf_counter()
             rows = self._host(toks)
+            lp = ((self._host(lpv), self._host(lpi))
+                  if lpv is not None else None)
             self._busy_s += time.perf_counter() - t0
             for i in range(rows.shape[0]):
-                self._pending_rows.append(self._emit(rows[i]))
+                self._pending_rows.append(self._emit(
+                    rows[i], lp=(lp[0][i], lp[1][i]) if lp else None))
 
     def _dispatch_block(self, size: int):
         """Dispatch one fused decode block (async): the device-side state
         (cache / history / feedback token futures) and the host-side
-        pos/index advance immediately; the ``[size, B]`` token rows return
-        UN-fetched so the caller chooses when to pay the host round-trip
-        (the lookahead path dispatches the next block first)."""
+        pos/index advance immediately; the ``[size, B]`` token rows (and
+        top-k logprob rows when enabled) return UN-fetched so the caller
+        chooses when to pay the host round-trip (the lookahead path
+        dispatches the next block first)."""
         with span("decode.dispatch", steps=size, batch=len(self.streams)):
-            toks, self.cache, self._history, self._hist_slot = (
-                self._block_prog(size)(
-                    self.params, self._last_tokens, self.cache,
-                    jnp.asarray(self._pos), self._keys, self._history,
-                    self._hist_slot, jnp.asarray(self._index),
-                )
+            out = self._block_prog(size)(
+                self.params, self._last_tokens, self.cache,
+                jnp.asarray(self._pos), self._keys, self._history,
+                self._hist_slot, jnp.asarray(self._index),
             )
+            if self.logprobs_k:
+                (toks, self.cache, self._history, self._hist_slot,
+                 lpv, lpi) = out
+            else:
+                toks, self.cache, self._history, self._hist_slot = out
+                lpv = lpi = None
         self._n_decode_dispatches += 1
         self._pos = self._pos + size
         self._index = self._index + size
         self._last_tokens = toks[-1].astype(jnp.int32)
-        return toks
+        return toks, lpv, lpi
 
     def _step_decode(self):
         # Buffered fused-block rows are EARLIER tokens than anything a new
@@ -1472,7 +1733,7 @@ class BatchGenerator:
         # proposals mid-drain would emit later tokens ahead of buffered
         # earlier ones and scramble per-stream order (r4 review repro).
         if self._block_buf:
-            return self._emit(self._block_buf.popleft())
+            return self._emit_buffered(self._block_buf.popleft())
         if self._spec_k:
             row = self._spec_emit_or_round()
             if row is not None:
@@ -1495,21 +1756,28 @@ class BatchGenerator:
         # _emit marks it done at the window-filling token so the overrun
         # outputs are discarded — one long stream near its edge must not
         # force every stream to single-step dispatches.
-        toks = None
+        #
+        # Constrained streams (attached Guides) pin the WHOLE batch to
+        # single-step masked dispatches: the DFA advance is host-side
+        # between steps, so a fused block (or a lookahead dispatch) would
+        # sample tokens 2..K against a stale mask row. The moment the last
+        # constrained stream retires, block/lookahead dispatch resumes.
+        constrained = self._guides_live()
+        toks = lpv = lpi = None
         if self._inflight is not None:
-            toks, _ = self._inflight  # consume the pipelined block
+            toks, lpv, lpi, _ = self._inflight  # consume pipelined block
             self._inflight = None
-        else:
+        elif not constrained:
             can_block = (self._decode_block is not None
                          or self.block_size_max > self.block_size)
             size = self._pick_block_size(live) if can_block else 1
             if size > 1:
-                toks = self._dispatch_block(size)
+                toks, lpv, lpi = self._dispatch_block(size)
         if toks is not None:
             t0 = time.perf_counter()
             size = len(toks)
             if (self._lookahead and not self._arrivals
-                    and self._staging is None):
+                    and self._staging is None and not constrained):
                 # pipeline the NEXT block before this one's host fetch:
                 # EOS/retirement inside the fetched block only discards
                 # per-row outputs (the standard overrun invariant)
@@ -1518,8 +1786,10 @@ class BatchGenerator:
                      if s.active and not s.done]
                 )
                 if nsize > 1:
-                    self._inflight = (self._dispatch_block(nsize), nsize)
+                    self._inflight = self._dispatch_block(nsize) + (nsize,)
             rows = self._host(toks)  # [steps, B]
+            lp_h = ((self._host(lpv), self._host(lpi))
+                    if lpv is not None else None)
             dt = time.perf_counter() - t0
             self._busy_s += dt
             # per-token ms so the series is comparable across block sizes
@@ -1530,22 +1800,41 @@ class BatchGenerator:
                     kind="decode", total_ms=round(dt * 1e3, 3), steps=size,
                     batch=len(self.streams),
                 )
-            self._block_buf = deque(rows[i] for i in range(rows.shape[0]))
-            return self._emit(self._block_buf.popleft())
+            self._block_buf = deque(
+                (rows[i],
+                 (lp_h[0][i], lp_h[1][i]) if lp_h is not None else None)
+                for i in range(rows.shape[0])
+            )
+            return self._emit_buffered(self._block_buf.popleft())
 
         if int(max(live)) >= self.max_seq:  # unreachable: _emit marks
             raise RuntimeError("KV cache exhausted")  # window-full streams done
         t0 = time.perf_counter()
         with span("decode.dispatch", steps=1, batch=len(self.streams)):
-            tok, self.cache, self._history, self._hist_slot = (
-                self._pick_decode(block=False)(
-                    self.params, self._last_tokens, self.cache,
-                    jnp.asarray(self._pos), self._keys, self._history,
-                    self._hist_slot, jnp.asarray(self._index),
-                )
+            args = (
+                self.params, self._last_tokens, self.cache,
+                jnp.asarray(self._pos), self._keys, self._history,
+                self._hist_slot, jnp.asarray(self._index),
             )
+            if constrained:
+                # gather-and-mask runs inside this compiled program; the
+                # per-slot row vector is the only per-step upload
+                out = self._decode_single_masked(
+                    *args, self._mask_table,
+                    jnp.asarray(self._mask_rows_np()),
+                )
+            else:
+                out = self._pick_decode(block=False)(*args)
+            if self.logprobs_k:
+                (tok, self.cache, self._history, self._hist_slot,
+                 lpv_d, lpi_d) = out
+            else:
+                tok, self.cache, self._history, self._hist_slot = out
+                lpv_d = lpi_d = None
             # sync: dispatch is async, busy_s needs compute
             row = self._host(tok)
+            lp_h = ((self._host(lpv_d), self._host(lpi_d))
+                    if lpv_d is not None else None)
         self._n_decode_dispatches += 1
         dt = time.perf_counter() - t0
         self._busy_s += dt
@@ -1559,7 +1848,7 @@ class BatchGenerator:
         self._pos = self._pos + 1
         self._index = self._index + 1
         self._last_tokens = tok.astype(jnp.int32)
-        return self._emit(row)
+        return self._emit(row, lp=lp_h)
 
     def stats(self) -> dict:
         """Serving counters (the reference's worker ops/s + master tok/s
@@ -1578,6 +1867,10 @@ class BatchGenerator:
                 1 for s in self.streams if s.active and s.done
             ),
             "pending_admissions": self.pending_admissions(),
+            "constrained_live": sum(
+                1 for i in self._guides
+                if self.streams[i].active and not self.streams[i].done
+            ),
             "tokens_emitted": self._n_emitted,
             "decode_dispatches": self._n_decode_dispatches,
             "admit_dispatches": self._n_admit_dispatches,

@@ -48,11 +48,15 @@ from cake_tpu.utils.token_stream import TokenOutputStream
 
 @dataclasses.dataclass
 class Token:
-    """Mirror of the reference ``Token`` (model/mod.rs:46-52)."""
+    """Mirror of the reference ``Token`` (model/mod.rs:46-52), plus the
+    serving plane's optional per-token top-k logprob report: a list of
+    ``(token_id, logprob)`` pairs over the raw model distribution, None
+    when the engine was not built with ``logprobs``."""
 
     id: int
     text: str | None
     is_end_of_stream: bool
+    logprobs: list[tuple[int, float]] | None = None
 
 
 def encode_prompt(prompt, tokenizer, config, max_seq: int) -> list[int]:
@@ -122,14 +126,26 @@ def decode_step_fn(
     hist_slot,
     config: LlamaConfig,
     settings: SamplerSettings,
+    mask_table=None,  # [M, ceil(V/8)] uint8 packed constraint masks
+    mask_row=None,  # scalar int32 — current DFA-state row
 ):
-    """One fused decode step: forward one token + sample the next."""
+    """One fused decode step: forward one token + sample the next. The
+    optional trailing mask operands are the constrained-decoding path
+    (constrain/): a gather from the device-resident packed bitmask table
+    + one jnp.where inside the same compiled program. Calls without them
+    trace the exact pre-constraint program — unconstrained streams stay
+    bit-identical."""
     cos, sin = rope_tables(config.head_dim, cache.max_seq, config.rope_theta,
                            scaling=config.rope_scaling)
     x = llama.embed_tokens(params, token[:, None], config)
     x, cache = llama.forward_layers(params["layers"], x, cache, cos, sin, pos, config)
     logits = _lm_head(params, x[:, -1, :], config)
-    next_tok = sampling.sample_token(logits[0], key, history, settings)
+    mask = None
+    if mask_table is not None:
+        mask = sampling.unpack_mask_bits(mask_table[mask_row],
+                                         config.vocab_size)
+    next_tok = sampling.sample_token(logits[0], key, history, settings,
+                                     mask=mask)
     history, hist_slot = sampling.push_history(history, hist_slot, next_tok)
     return next_tok, cache, history, hist_slot
 
@@ -201,6 +217,13 @@ class GeneratorBase:
         self._pos = 0
         self._last_token: int | None = None
         self._eos_ids = set(config.eos_ids())
+        sampling.validate_logit_bias(self.settings, config.vocab_size)
+        # Constrained decoding (cake_tpu/constrain): a Guide set via
+        # set_guide() masks every sampling step. Subclasses that can
+        # apply the mask flip supports_guide; the base refuses, so a
+        # serve adapter can never silently ignore a constraint.
+        self.guide = None
+        self.guide_dead = False  # DFA dead end hit (end_reason constraint)
         # fused block-decode buffer (subclasses with block_size > 1);
         # deque: the per-token pop is O(1), not the O(n) list.pop(0)
         self.block_size = 1
@@ -234,10 +257,38 @@ class GeneratorBase:
             )
             self._hist_slot = jnp.int32(len(tail))
         self._block_buf = deque()
+        self.guide = None  # constraints are per-request: re-set_guide
+        self.guide_dead = False
         self._on_new_prompt()
 
     def _on_new_prompt(self) -> None:
         """Hook for subclasses (e.g. reset remote runner caches)."""
+
+    # -- constrained decoding -----------------------------------------------
+    supports_guide = False
+
+    @property
+    def eos_ids(self) -> frozenset:
+        """Public EOS-id surface (the serve facade contract)."""
+        return frozenset(self._eos_ids)
+
+    def set_guide(self, guide) -> None:
+        """Attach (or clear, with None) a constrain.Guide for the CURRENT
+        prompt — call after set_prompt, before next_token(0). Every
+        sampled token is then masked to the grammar's allowed set and
+        advances the host-side DFA cursor."""
+        if guide is not None and not self.supports_guide:
+            raise ValueError(
+                f"{type(self).__name__} does not support constrained "
+                "decoding (no masked sampling path)")
+        if guide is not None:
+            guide.reset()
+        self.guide = guide
+        self.guide_dead = False
+        self._on_guide()
+
+    def _on_guide(self) -> None:
+        """Hook: upload/refresh device-side mask state for self.guide."""
 
     # -- shared bookkeeping --------------------------------------------------
     def _require_prompt(self) -> None:
@@ -255,8 +306,20 @@ class GeneratorBase:
         self._last_token = tok_id
         self._generated.append(tok_id)
         is_eos = tok_id in self._eos_ids
-        text = self.stream.next_token(tok_id) if self.stream else None
-        return Token(id=tok_id, text=text, is_end_of_stream=is_eos)
+        if self.guide is not None and not is_eos:
+            # host-side DFA advance between compiled steps; a dead end
+            # (no emittable token at the new state) ends the stream
+            if not self.guide.advance(tok_id) or self.guide.dead_end:
+                from cake_tpu.constrain.guide import DEAD_ENDS
+
+                self.guide_dead = True
+                DEAD_ENDS.inc()
+        # the EOS id is an end marker, not text (toy tokenizers map it to
+        # an arbitrary printable char)
+        text = (self.stream.next_token(tok_id)
+                if self.stream is not None and not is_eos else None)
+        return Token(id=tok_id, text=text,
+                     is_end_of_stream=is_eos or self.guide_dead)
 
     def _decode_next(self, index: int, run_block, run_single) -> Token:
         """Shared block-decode control flow: pop the buffer, else collect
@@ -275,7 +338,10 @@ class GeneratorBase:
             self._block_buf.extend(toks)
             return self._finish_token(self._block_buf.popleft())
         self._check_capacity()
-        if self.block_size > 1 and self._pos + self.block_size <= self.max_seq:
+        if (self.block_size > 1 and self.guide is None
+                and self._pos + self.block_size <= self.max_seq):
+            # a live guide forces single-step dispatch: the in-block
+            # feedback tokens would sample against a stale mask row
             self._block_buf.extend(run_block(index))
             return self._finish_token(self._block_buf.popleft())
         return self._finish_token(run_single(index))
@@ -308,7 +374,17 @@ class GeneratorBase:
 class LlamaGenerator(GeneratorBase):
     """Single-stream generator over an all-local model. (The distributed,
     topology-sharded equivalent — runtime.master.DistributedGenerator —
-    shares this base and swaps the execution path for a runner walk.)"""
+    shares this base and swaps the execution path for a runner walk.)
+
+    Supports constrained decoding (``set_guide``): the guide's packed DFA
+    mask table uploads once per prompt (rows padded to a pow2 capacity so
+    the masked trace is stable across grammars), the decode step gathers
+    the current state's row on device, and the DFA cursor advances
+    host-side in ``_finish_token``. While a guide is live, fused
+    block/lookahead dispatch is bypassed — tokens 2..K of a block would
+    sample against a stale mask row."""
+
+    supports_guide = True
 
     def __init__(
         self,
@@ -344,6 +420,7 @@ class LlamaGenerator(GeneratorBase):
         self.block_size = max(1, block_size)
         self._lookahead = bool(lookahead) and self.block_size > 1
         self._inflight = None  # un-fetched [steps] device tokens
+        self._guide_table = None  # device mask table (set_guide uploads)
         # per-token dispatch latency (block dispatches record ms/token so
         # the series is comparable across block sizes) and prompt-pass ms
         self._decode_hist = obs_metrics.Histogram("generator.decode_ms")
@@ -374,6 +451,20 @@ class LlamaGenerator(GeneratorBase):
         # stale KV writes sit beyond the new prompt's causal frontier (the
         # same invariant set_prompt documents for the cache itself)
         self._inflight = None
+
+    def _on_guide(self) -> None:
+        """Upload the guide's packed mask table (pow2-padded rows: one
+        masked-program trace per capacity, not per grammar)."""
+        if self.guide is None:
+            self._guide_table = None
+            return
+        bits = self.guide.dfa.mask_bits
+        cap = 64
+        while cap < bits.shape[0]:
+            cap *= 2
+        table = jnp.zeros((cap, bits.shape[1]), jnp.uint8)
+        self._guide_table = table.at[: bits.shape[0]].set(
+            jnp.asarray(bits))
 
     def _dispatch_block(self, token_dev, index0: int):
         """Async-dispatch one fused ``block_size``-step block and advance
@@ -430,6 +521,15 @@ class LlamaGenerator(GeneratorBase):
 
     def _run_single(self, index: int) -> int:
         t0 = time.perf_counter()
+        # constrained streams ride the same jitted step with the two mask
+        # operands added (a separate trace; the unconstrained trace is
+        # untouched). mask_row is the only per-token upload — the table
+        # went up once at set_guide.
+        kwargs = (
+            dict(mask_table=self._guide_table,
+                 mask_row=jnp.int32(self.guide.state))
+            if self.guide is not None else {}
+        )
         with span("decode.step", index=index):
             tok, self.cache, self._history, self._hist_slot = (
                 self._decode_single(
@@ -440,6 +540,7 @@ class LlamaGenerator(GeneratorBase):
                     jax.random.fold_in(self._key, index),
                     self._history,
                     self._hist_slot,
+                    **kwargs,
                 )
             )
             self._pos += 1
@@ -471,7 +572,9 @@ class LlamaGenerator(GeneratorBase):
                 )
                 step_key = jax.random.fold_in(self._key, 0)
                 tok = sampling.sample_token(
-                    logits[0], step_key, self._history, self.settings
+                    logits[0], step_key, self._history, self.settings,
+                    mask=(jnp.asarray(self.guide.mask_bool())
+                          if self.guide is not None else None),
                 )
                 self._history, self._hist_slot = sampling.push_history(
                     self._history, self._hist_slot, tok

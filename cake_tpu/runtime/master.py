@@ -328,13 +328,26 @@ class DistributedGenerator(GeneratorBase):
             )
         return self._finish_token(tok_id)
 
+    # Constrained decoding rides for free on the wire path: sampling (and
+    # therefore masking) is master-side — workers only ever see
+    # activations, so a grammar constrains a distributed topology without
+    # any protocol change. The [V]-bit mask row uploads per token here
+    # (the single-stream wire walk is host-loop-bound anyway; the batch
+    # engine is where the device-resident-table design pays).
+    supports_guide = True
+
     def _sample(self, logits: jax.Array, index: int) -> int:
         """Sample + history push, timed for the flight record (the int()
         fetch synchronizes, so sample_ms covers the real device work)."""
         t0 = time.perf_counter()
         with span("sample", index=index):
             step_key = jax.random.fold_in(self._key, index)
-            tok = self._sample_fn(logits, step_key, self._history)
+            if self.guide is not None:
+                tok = self._sample_fn(
+                    logits, step_key, self._history,
+                    mask=jnp.asarray(self.guide.mask_bool()))
+            else:
+                tok = self._sample_fn(logits, step_key, self._history)
             self._history, self._hist_slot = sampling.push_history(
                 self._history, self._hist_slot, tok
             )

@@ -6,13 +6,24 @@ scheduler:
 - ``POST /v1/completions`` — JSON body: ``prompt`` (text, needs the
   engine's tokenizer) or ``prompt_ids`` (the CLI ``--prompt-ids`` escape
   hatch), ``max_tokens``, ``stream``. Sampler knobs (``temperature`` /
-  ``top_k`` / ``top_p`` / ``seed``) are accepted only when they match the
-  settings the server was started with — the engine compiles ONE sampler
-  into its programs, and silently ignoring a mismatch would be worse than
-  refusing it. ``stream: true`` answers Server-Sent Events, one event per
-  token (text incrementally detokenized by the engine's
-  ``TokenOutputStream``), final event carrying the usage stats;
-  ``stream: false`` answers one JSON object.
+  ``top_k`` / ``top_p`` / ``seed``, and ``logit_bias``) are accepted only
+  when they match the settings the server was started with — the engine
+  compiles ONE sampler into its programs, and silently ignoring a
+  mismatch would be worse than refusing it. ``stream: true`` answers
+  Server-Sent Events, one event per token (text incrementally
+  detokenized by the engine's ``TokenOutputStream``), final event
+  carrying the usage stats; ``stream: false`` answers one JSON object.
+
+  Structured generation (cake_tpu/constrain, ISSUE 8):
+  ``response_format: {"type": "json_schema", "schema": {...}}`` or
+  ``{"type": "regex", "pattern": "..."}`` constrains decoding to the
+  grammar (device-side masking, no retrace — finish_reason
+  ``"constraint"`` marks a grammar dead end); ``stop: [str]`` ends the
+  stream at the first stop-string match with SSE holdback (a potential
+  match is withheld until resolved, so stop text never reaches the
+  client; finish_reason ``"stop"``, distinct from ``"eos"``);
+  ``logprobs: N`` adds top-N logprobs to every token event and the
+  final usage block (server capacity set by ``--serve-logprobs``).
 - ``GET /v1/models`` / ``GET /healthz`` — discovery and liveness.
 - ``GET /`` + ``GET /metrics`` — the exact statusd surface
   (``obs.statusd.status_response``), so one port serves traffic AND
@@ -42,6 +53,68 @@ log = logging.getLogger("cake_tpu.serve.api")
 _SAMPLER_KNOBS = ("temperature", "top_k", "top_p", "seed")
 
 
+def _parse_stop(body: dict, engine) -> list[str]:
+    stop = body.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (not isinstance(stop, list) or not stop or len(stop) > 8
+            or not all(isinstance(s, str) and s for s in stop)):
+        raise ValueError(
+            "'stop' must be a non-empty string or a list of 1..8 "
+            "non-empty strings")
+    if engine.tokenizer is None:
+        raise ValueError(
+            "'stop' needs a server-side tokenizer (stop strings match "
+            "the emitted text stream)")
+    return stop
+
+
+def _parse_logit_bias(body: dict, engine) -> None:
+    """Validate ``logit_bias`` and require it to match the server's
+    compiled sampler (the engine traces ONE bias scatter): out-of-range
+    ids and malformed entries are 400s in their own right."""
+    if "logit_bias" not in body:
+        return
+    lb = body["logit_bias"]
+    if not isinstance(lb, dict):
+        raise ValueError("'logit_bias' must be an object of "
+                         "{token_id: bias}")
+    norm = []
+    vocab = engine.config.vocab_size
+    for k, v in lb.items():
+        try:
+            tok = int(k)
+        except (TypeError, ValueError):
+            raise ValueError(f"logit_bias key {k!r} is not a token id")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            raise ValueError(f"logit_bias value for {tok} must be a number")
+        if not 0 <= tok < vocab:
+            raise ValueError(
+                f"logit_bias token id {tok} out of range [0, {vocab})")
+        norm.append((tok, float(v)))
+    if tuple(sorted(norm)) != tuple(sorted(
+            (int(i), float(b)) for i, b in engine.settings.logit_bias)):
+        raise ValueError(
+            "per-request 'logit_bias' is not supported: the engine "
+            "compiles one sampler (server runs logit_bias="
+            f"{dict(engine.settings.logit_bias)!r}); omit it or match "
+            "the server's value")
+
+
+def _parse_guide(body: dict, engine):
+    rf = body.get("response_format")
+    if rf is None:
+        return None
+    from cake_tpu.constrain import RegexError, guide_for
+
+    try:
+        return guide_for(rf, engine.tokenizer, engine.config)
+    except RegexError as e:
+        raise ValueError(f"bad response_format: {e}")
+
+
 def _parse_request(body: dict, scheduler) -> Session:
     """Validate one completions body into a Session (raises ValueError
     with a client-facing message)."""
@@ -66,7 +139,8 @@ def _parse_request(body: dict, scheduler) -> Session:
     stream = body.get("stream", False)
     if not isinstance(stream, bool):
         raise ValueError("'stream' must be a boolean")
-    settings = scheduler.engine.settings
+    engine = scheduler.engine
+    settings = engine.settings
     for knob in _SAMPLER_KNOBS:
         if knob in body and body[knob] != getattr(settings, knob):
             raise ValueError(
@@ -75,13 +149,28 @@ def _parse_request(body: dict, scheduler) -> Session:
                 f"{getattr(settings, knob)!r}); omit it or match the "
                 "server's value"
             )
+    _parse_logit_bias(body, engine)
+    logprobs = body.get("logprobs", 0)
+    if not isinstance(logprobs, int) or logprobs < 0:
+        raise ValueError("'logprobs' must be a non-negative int")
+    cap = getattr(engine, "logprobs_k", 0)
+    if logprobs > cap:
+        raise ValueError(
+            f"'logprobs': {logprobs} exceeds this server's capacity "
+            f"({cap}; start the server with --serve-logprobs N to raise "
+            "it)" if cap else
+            "'logprobs' is not enabled on this server (start it with "
+            "--serve-logprobs N)")
+    stop = _parse_stop(body, engine)
+    guide = _parse_guide(body, engine)
     timeout = body.get("timeout_s", scheduler.request_timeout_s)
     if timeout is not None and (
         not isinstance(timeout, (int, float)) or timeout <= 0
     ):
         raise ValueError("'timeout_s' must be a positive number")
     return Session(ids, max_tokens=max_tokens, stream=stream,
-                   timeout_s=timeout)
+                   timeout_s=timeout, stop=stop, logprobs=logprobs,
+                   guide=guide)
 
 
 class ApiServer:
@@ -256,10 +345,15 @@ def _make_handler(server: ApiServer):
                 while True:
                     ev = self._next_event(sess)
                     if ev[0] == "token":
-                        _, tok_id, text = ev
-                        self.wfile.write(sse_event(
-                            {"index": index, "token": tok_id,
-                             "text": text}))
+                        _, tok_id, text, top = ev
+                        frame = {"index": index, "token": tok_id,
+                                 "text": text}
+                        if top is not None:
+                            frame["logprobs"] = [
+                                {"id": i, "logprob": round(v, 6)}
+                                for i, v in top
+                            ]
+                        self.wfile.write(sse_event(frame))
                         index += 1
                     elif ev[0] == "done":
                         _, reason, usage, tail = ev

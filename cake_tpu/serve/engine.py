@@ -32,10 +32,16 @@ class _Slot:
     done: bool = False
     active: bool = True
     detok: TokenOutputStream | None = None
+    end_reason: str | None = None  # "eos" | "length" | "constraint"
 
 
 class SingleStreamEngine:
     """One-slot ``BatchGenerator`` facade over a ``GeneratorBase``."""
+
+    # the one-slot path has no top-k logprob outputs (the wrapped
+    # generators keep sampling fused on device); requests asking for
+    # logprobs are refused at the API layer
+    logprobs_k = 0
 
     def __init__(self, gen):
         self.gen = gen
@@ -48,19 +54,30 @@ class SingleStreamEngine:
         # arrival, exactly like a primed batch engine's done slots
         self.streams: list[_Slot] = [_Slot(stream_id=-1, prompt=[],
                                            done=True)]
-        self._arrivals: list[tuple[list[int], int]] = []
+        self._arrivals: list[tuple[list[int], int, object]] = []
         self._index = 0
         self._n_emitted = 0
         self._t_start = time.perf_counter()
 
     # -- BatchGenerator API subset -------------------------------------------
+    @property
+    def eos_ids(self) -> frozenset:
+        """Public EOS-id surface of the engine facade (scheduler
+        finish-reason mapping — no private-attr reaches)."""
+        return frozenset(self._eos_ids)
+
     def _encode(self, p) -> list[int]:
         """The shared prompt-intake rules (``generator.encode_prompt``),
         without mutating generator state."""
         return encode_prompt(p, self.tokenizer, self.config, self.max_seq)
 
-    def enqueue(self, prompt, stream_id: int) -> None:
-        self._arrivals.append((self._encode(prompt), stream_id))
+    def enqueue(self, prompt, stream_id: int, guide=None) -> None:
+        if guide is not None and not getattr(self.gen, "supports_guide",
+                                             False):
+            raise ValueError(
+                "this serve deployment's generator does not support "
+                "constrained decoding (response_format)")
+        self._arrivals.append((self._encode(prompt), stream_id, guide))
 
     def pending_admissions(self) -> int:
         return len(self._arrivals)
@@ -84,8 +101,9 @@ class SingleStreamEngine:
         generator's KV state — retirement IS the KV free here too)."""
         s = self.streams[0]
         if s.done and self._arrivals:
-            ids, sid = self._arrivals.pop(0)
+            ids, sid, guide = self._arrivals.pop(0)
             self.gen.set_prompt(ids)
+            self.gen.set_guide(guide)
             s = _Slot(stream_id=sid, prompt=ids, detok=self.gen.stream)
             self.streams[0] = s
             self._index = 0
@@ -96,6 +114,13 @@ class SingleStreamEngine:
         s.generated.append(tok.id)
         window_full = len(s.prompt) + len(s.generated) >= self.max_seq
         s.done = tok.is_end_of_stream or window_full
+        if s.done:
+            if getattr(self.gen, "guide_dead", False):
+                s.end_reason = "constraint"
+            elif tok.id in self._eos_ids:
+                s.end_reason = "eos"
+            else:
+                s.end_reason = "length"
         self._n_emitted += 1
         return [Token(id=tok.id, text=tok.text,
                       is_end_of_stream=s.done)]

@@ -96,14 +96,18 @@ class Scheduler:
 
     # -- lifecycle ------------------------------------------------------------
     def start(self, max_concurrent: int = 4,
-              warm_prompt_len: int | None = None) -> None:
+              warm_prompt_len: int | None = None,
+              warm_constrain: bool = False) -> None:
         """Prime the engine with ``max_concurrent`` retired slots and start
         the engine thread. A batch engine needs a live batch before
         ``enqueue`` can splice arrivals into it, so priming runs one
         minimal ``set_prompts`` and retires every slot immediately — every
         real request then rides the continuous-admission path. With
         ``warm_prompt_len``, the admission-prefill program is compiled here
-        too, outside the serving window (``warm_admission``)."""
+        too, outside the serving window (``warm_admission``);
+        ``warm_constrain`` additionally compiles the masked decode
+        program, so the FIRST constrained request (``response_format``)
+        does not stall every live stream on an XLA compile mid-serving."""
         if self._thread is not None:
             raise RuntimeError("scheduler already started")
         if max_concurrent < 1:
@@ -121,6 +125,8 @@ class Scheduler:
         self._next_sid = self.max_concurrent  # clear of the priming ids
         if warm_prompt_len and hasattr(self.engine, "warm_admission"):
             self.engine.warm_admission(warm_prompt_len)
+        if warm_constrain and hasattr(self.engine, "warm_constrain"):
+            self.engine.warm_constrain()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="cake-serve-engine")
         self._thread.start()
@@ -282,7 +288,13 @@ class Scheduler:
                 sid = self._next_sid
                 self._next_sid += 1
             try:
-                self.engine.enqueue(sess.prompt_ids, sid)
+                # guide= only when constrained: unconstrained admission
+                # keeps the bare protocol every engine stub speaks
+                if sess.guide is not None:
+                    self.engine.enqueue(sess.prompt_ids, sid,
+                                        guide=sess.guide)
+                else:
+                    self.engine.enqueue(sess.prompt_ids, sid)
             except ValueError as e:  # encode raced the window, etc.
                 sess.fail(400, str(e))
                 continue
@@ -301,15 +313,21 @@ class Scheduler:
         for slot, tok in enumerate(row):
             if tok is None:
                 continue
-            sess = by_sid.get(self.engine.streams[slot].stream_id)
+            stream = self.engine.streams[slot]
+            sess = by_sid.get(stream.stream_id)
             if sess is None:
                 continue  # priming/dummy slot, or already aborted
-            sess.on_token(tok.id, tok.text)
+            sess.on_token(tok.id, tok.text,
+                          logprobs=getattr(tok, "logprobs", None))
             n += 1
             if tok.is_end_of_stream:
+                # the engine records WHY it ended the stream ("eos" |
+                # "length" | "constraint"); the eos_ids fallback covers
+                # engines that only flag the end
                 sess.finish_reason = (
-                    "stop" if tok.id in getattr(self.engine, "_eos_ids", ())
-                    else "length"  # window exhausted
+                    getattr(stream, "end_reason", None)
+                    or ("eos" if tok.id in self.engine.eos_ids
+                        else "length")
                 )
         if n:
             self._rate_tokens += n
@@ -338,8 +356,10 @@ class Scheduler:
             items = list(self._by_sid.items())
         for sid, sess in items:
             reason = None
-            if sess.finish_reason in ("stop", "length"):
+            if sess.finish_reason in ("eos", "stop", "length", "constraint"):
                 reason = sess.finish_reason
+            elif sess.stop_hit:
+                reason = "stop"  # server-side stop string matched
             elif len(sess.generated) >= sess.max_tokens:
                 reason = "length"
             elif sess.cancelled.is_set():

@@ -1,10 +1,26 @@
-"""Per-request serving state: encode, stream, measure.
+"""Per-request serving state: encode, stream, measure, constrain.
 
 A :class:`Session` is one HTTP request's life in the serving plane — its
 prompt (text through the engine's tokenizer, or a ``prompt_ids`` escape
 hatch mirroring the CLI's ``--prompt-ids``), its token budget and arrival
 deadline, the queue the scheduler fans its tokens into, and its own
 latency record (TTFT = submit to first token, TPOT = inter-token gap).
+
+Structured-generation state lives here too (ISSUE 8):
+
+- ``guide`` — the constrain.Guide the scheduler hands to the engine at
+  admission (grammar-constrained decoding);
+- ``stop`` — server-side stop strings, matched on the *emitted text
+  stream* with holdback: token events whose text could still be the
+  prefix of a stop string are withheld from the event queue until the
+  match resolves, so a stop string (or any prefix of one that ends up
+  matching) never reaches an SSE client, even split across chunk
+  boundaries. A match truncates exactly at the match start (text-level;
+  a token straddling the boundary contributes its pre-match text via the
+  terminal event's tail) and finishes the request with reason "stop"
+  (``serve.stop_matches``);
+- ``logprobs`` — top-N per-token logprobs accumulated for the SSE events
+  and the final usage block.
 
 Latencies feed the registry histograms below, so serving traffic shows up
 everywhere the obs layer already looks: ``/metrics`` Prometheus text,
@@ -33,6 +49,12 @@ REJECTED = obs_metrics.counter("serve.rejected")
 CANCELLED = obs_metrics.counter("serve.cancelled")
 TIMEOUTS = obs_metrics.counter("serve.timeouts")
 COMPLETED = obs_metrics.counter("serve.completed")
+STOP_MATCHES = obs_metrics.counter("serve.stop_matches")
+
+# finish reasons that mean "the request got its output" (vs rejected /
+# cancelled / timed out): EOS, stop string, token/window budget, grammar
+# dead end
+_COMPLETED_REASONS = ("eos", "stop", "length", "constraint")
 
 
 def sse_event(data) -> bytes:
@@ -49,12 +71,23 @@ class Session:
 
     def __init__(self, prompt_ids: list[int], max_tokens: int,
                  stream: bool = True, timeout_s: float | None = None,
-                 request_id: str | None = None):
+                 request_id: str | None = None,
+                 stop: list[str] | None = None, logprobs: int = 0,
+                 guide=None):
         self.id = request_id or uuid.uuid4().hex[:12]
         self.prompt_ids = list(prompt_ids)
         self.max_tokens = int(max_tokens)
         self.stream = bool(stream)
         self.timeout_s = timeout_s
+        # structured generation
+        self.stop = list(stop or [])
+        self.logprobs = max(0, int(logprobs))
+        self.guide = guide
+        self.stop_hit = False
+        self.stop_tail: str | None = None  # pre-match remainder text
+        self._held: list[tuple[int, str, list | None]] = []
+        self._held_text = ""
+        self.logprob_rows: list[list] | None = [] if self.logprobs else None
         # scheduler-owned identity/state
         self.stream_id: int | None = None  # engine stream id once admitted
         self.finish_reason: str | None = None
@@ -62,8 +95,8 @@ class Session:
         # handler -> scheduler: the client went away (write failed); the
         # engine thread retires the stream at its next loop pass
         self.cancelled = threading.Event()
-        # scheduler -> handler: ("token", id, text) | ("done", reason,
-        # usage, tail_text) | ("error", http_status, message)
+        # scheduler -> handler: ("token", id, text, logprobs) |
+        # ("done", reason, usage, tail_text) | ("error", status, message)
         self.events: queue.Queue = queue.Queue()
         now = time.perf_counter()
         self.t_submit = now
@@ -73,9 +106,14 @@ class Session:
         self._tpot_sum_ms = 0.0
 
     # -- engine-thread side ---------------------------------------------------
-    def on_token(self, tok_id: int, text: str | None) -> None:
+    def on_token(self, tok_id: int, text: str | None,
+                 logprobs: list | None = None) -> None:
         """Record one emitted token (engine thread): latency samples land
-        in the registry, the event lands in the handler's queue."""
+        in the registry, the event lands in the handler's queue — unless
+        stop strings are configured, in which case events ride the
+        holdback buffer until they provably cannot be part of a match."""
+        if self.stop_hit:
+            return  # tokens past a stop match are discarded
         now = time.perf_counter()
         if self._t_last is None:
             self.ttft_ms = (now - self.t_submit) * 1e3
@@ -86,14 +124,109 @@ class Session:
             TPOT_MS.observe(gap_ms)
         self._t_last = now
         self.generated.append(tok_id)
-        self.events.put(("token", tok_id, text))
+        top = logprobs[: self.logprobs] if (self.logprobs and logprobs) \
+            else None
+        if self.logprob_rows is not None:
+            self.logprob_rows.append(top or [])
+        if not self.stop:
+            self.events.put(("token", tok_id, text, top))
+            return
+        self._held.append((tok_id, text or "", top))
+        self._held_text += text or ""
+        match = self._earliest_stop(self._held_text)
+        if match is not None:
+            self._commit_stop(match)
+            return
+        # flush everything that can no longer participate in a match
+        self._flush_held(len(self._held_text) - self._hold_len())
+
+    def _earliest_stop(self, text: str) -> int | None:
+        best = None
+        for s in self.stop:
+            i = text.find(s)
+            if i >= 0 and (best is None or i < best):
+                best = i
+        return best
+
+    def _hold_len(self) -> int:
+        """Longest suffix of the held text that is a prefix of some stop
+        string — the exact amount that must stay withheld."""
+        t = self._held_text
+        best = 0
+        for s in self.stop:
+            for k in range(min(len(s) - 1, len(t)), best, -1):
+                if t.endswith(s[:k]):
+                    best = k
+                    break
+        return best
+
+    def _flush_held(self, upto_chars: int, final: bool = False) -> int:
+        """Release held events whose text lies entirely before char
+        position ``upto_chars``; returns the number of chars released.
+        Zero-width events (detok withheld the text) sitting exactly at
+        the boundary stay held unless ``final`` — their text will arrive
+        attributed to a LATER token, which may yet complete a stop match,
+        and a released token id leaks that text."""
+        flushed = 0
+        pos = 0
+        for tid, txt, top in self._held:
+            end = pos + len(txt)
+            if end > upto_chars or (not final and not txt
+                                    and pos >= upto_chars):
+                break
+            self.events.put(("token", tid, txt or None, top))
+            flushed += 1
+            pos = end
+        self._held = self._held[flushed:]
+        self._held_text = self._held_text[pos:]
+        return pos
+
+    def _commit_stop(self, match_at: int) -> None:
+        """A stop string matched at held-text offset ``match_at``: flush
+        the fully-before tokens, keep the straddling token's pre-match
+        text as the terminal tail, drop everything else (ids included —
+        they are the stop string)."""
+        self.stop_hit = True
+        STOP_MATCHES.inc()
+        released = self._flush_held(match_at)
+        self.stop_tail = self._held_text[:match_at - released] or None
+        dropped = len(self._held)
+        if dropped:
+            del self.generated[-dropped:]
+            if self.logprob_rows is not None:
+                del self.logprob_rows[-dropped:]
+        self._held = []
+        self._held_text = ""
 
     def finish(self, reason: str, tail_text: str | None = None) -> None:
         """Close the session (engine thread): one terminal event carrying
         the usage stats, plus the flight record that makes the request
-        visible to --flight-log/--trace consumers."""
+        visible to --flight-log/--trace consumers. With stop strings
+        configured, the detok tail is scanned too — a stop string whose
+        final characters only surface at the flush must still match, and
+        must still not leak."""
+        if self.stop_hit:
+            reason, tail_text = "stop", self.stop_tail
+        elif self.stop:
+            held_len = len(self._held_text)
+            combined = self._held_text + (tail_text or "")
+            match = self._earliest_stop(combined)
+            if match is None:
+                self._flush_held(held_len, final=True)
+            elif match >= held_len:
+                # the match lies in the detok tail: every held token is
+                # legit output, the tail truncates at the match start
+                self.stop_hit = True
+                STOP_MATCHES.inc()
+                self._flush_held(held_len, final=True)
+                reason = "stop"
+                tail_text = (tail_text or "")[: match - held_len] or None
+            else:
+                self._commit_stop(match)
+                reason = "stop"
+                tail_text = self.stop_tail
         self.finish_reason = reason
-        if reason in ("stop", "length"):
+        if reason in _COMPLETED_REASONS:
             # cancelled/timed-out requests land in their own counters;
             # completed means the request actually got its tokens
             COMPLETED.inc()
@@ -130,4 +263,9 @@ class Session:
             u["ttft_ms"] = round(self.ttft_ms, 3)
         if self.tpot_ms is not None:
             u["tpot_ms"] = round(self.tpot_ms, 3)
+        if self.logprob_rows is not None:
+            u["logprobs"] = [
+                [{"id": i, "logprob": round(v, 6)} for i, v in row]
+                for row in self.logprob_rows
+            ]
         return u

@@ -9,15 +9,24 @@ closed loop self-throttles when the server slows down). Prompts draw from
 a ``--prompt-len`` mix of random in-vocab token ids (``prompt_ids`` path:
 no tokenizer needed on either side), or from ``--prompt`` literals.
 
+``--workload json`` (ISSUE 8) sends schema-constrained requests
+(``response_format: json_schema`` against :data:`JSON_WORKLOAD_SCHEMA`)
+and asserts every response's assembled text ``json.loads``-parses —
+the end-to-end proof that grammar-constrained decoding produced valid
+JSON through the whole HTTP plane. Needs a server-side tokenizer.
+Invalid responses land in ``json_invalid`` (nonzero exit).
+
 Prints TTFT / TPOT / end-to-end percentiles and aggregate token
-throughput; used by ``make serve-smoke`` and the ``CAKE_BENCH_SERVE=1``
-bench row.
+throughput; used by ``make serve-smoke`` / ``make constrain-smoke`` and
+the ``CAKE_BENCH_SERVE=1`` / ``CAKE_BENCH_CONSTRAIN=1`` bench rows.
 
 Usage:
   python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
       -n 32 -c 4 --max-tokens 64 --prompt-len 8,32,128
   python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
       -n 64 --rate 8 --max-tokens 32        # open loop, 8 req/s Poisson
+  python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
+      -n 16 --workload json --max-tokens 48  # constrained JSON workload
 """
 
 from __future__ import annotations
@@ -30,6 +39,19 @@ import threading
 import time
 import urllib.error
 import urllib.request
+
+
+# the --workload json constraint: small, fully bounded (the lowered
+# automaton is acyclic, so every constrained stream terminates within
+# its token budget), exercises object/integer/boolean paths
+JSON_WORKLOAD_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "a": {"type": "integer"},
+        "ok": {"type": "boolean"},
+    },
+    "required": ["a", "ok"],
+}
 
 
 def _percentile(xs: list[float], q: float) -> float:
@@ -50,13 +72,16 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
         headers={"Content-Type": "application/json"},
     )
     t0 = time.perf_counter()
-    out: dict = {"tokens": 0, "ttft_s": None, "gaps_s": [], "ids": []}
+    out: dict = {"tokens": 0, "ttft_s": None, "gaps_s": [], "ids": [],
+                 "text": ""}
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             if not body.get("stream"):
                 payload = json.loads(resp.read())
                 out["tokens"] = payload["usage"]["completion_tokens"]
                 out["ids"] = payload.get("token_ids", [])
+                out["text"] = payload.get("text", "")
+                out["finish_reason"] = payload.get("finish_reason")
                 out["ttft_s"] = (payload["usage"].get("ttft_ms", 0)
                                  or 0) / 1e3
                 out["wall_s"] = time.perf_counter() - t0
@@ -79,9 +104,15 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
                     t_last = now
                     out["tokens"] += 1
                     out["ids"].append(ev["token"])
+                    if ev.get("text"):
+                        out["text"] += ev["text"]
                 elif "error" in ev:
                     out["error"] = ev["error"]
                     break
+                elif ev.get("done"):
+                    if ev.get("text"):
+                        out["text"] += ev["text"]  # detok tail
+                    out["finish_reason"] = ev.get("finish_reason")
             out["wall_s"] = time.perf_counter() - t0
             return out
     except urllib.error.HTTPError as e:
@@ -112,15 +143,23 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
              prompt_lens: list[int] | None = None, vocab: int = 256,
              rate: float | None = None, seed: int = 0,
              prompts: list[str] | None = None, stream: bool = True,
-             timeout: float = 300.0) -> dict:
+             timeout: float = 300.0, workload: str = "text") -> dict:
     """Run the load; returns aggregate stats (also the in-process entry
-    the bench row and tests use)."""
+    the bench row and tests use). ``workload="json"`` attaches the
+    schema constraint to every request and json-validates every
+    response's text."""
+    if workload not in ("text", "json"):
+        raise ValueError(f"workload must be 'text' or 'json', "
+                         f"got {workload!r}")
     frags = _make_prompts(n, prompt_lens or [8], vocab, seed, prompts or [])
     results: list[dict] = [None] * n  # type: ignore[list-item]
     t_start = time.perf_counter()
 
     def fire(i: int) -> None:
         body = dict(frags[i], max_tokens=max_tokens, stream=stream)
+        if workload == "json":
+            body["response_format"] = {"type": "json_schema",
+                                       "schema": JSON_WORKLOAD_SCHEMA}
         results[i] = _one_request(url, body, timeout)
 
     if rate:
@@ -163,6 +202,14 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     rejected = [r for r in results if r and r.get("status") == 429]
     errors = [r for r in results if r and (
         "error" in r or ("status" in r and r["status"] != 429))]
+    json_invalid = 0
+    if workload == "json":
+        for r in done:
+            try:
+                json.loads(r.get("text") or "")
+            except ValueError:
+                json_invalid += 1
+                r["json_invalid"] = True
     ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
     gaps = [g for r in done for g in r.get("gaps_s", ())]
     total_tokens = sum(r["tokens"] for r in done)
@@ -171,6 +218,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         "completed": len(done),
         "rejected_429": len(rejected),
         "errors": len(errors),
+        "json_invalid": json_invalid,
         "wall_s": round(wall, 3),
         "tokens": total_tokens,
         "tok_s": round(total_tokens / wall, 2) if wall > 0 else 0.0,
@@ -209,6 +257,10 @@ def main(argv=None) -> int:
                         "server-side tokenizer; overrides --prompt-len)")
     p.add_argument("--no-stream", action="store_true",
                    help="unary JSON responses instead of SSE")
+    p.add_argument("--workload", choices=["text", "json"], default="text",
+                   help="json: schema-constrained requests "
+                        "(response_format json_schema), responses "
+                        "asserted json.loads-parseable")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=300.0)
     args = p.parse_args(argv)
@@ -218,11 +270,12 @@ def main(argv=None) -> int:
         max_tokens=args.max_tokens, prompt_lens=lens, vocab=args.vocab,
         rate=args.rate, seed=args.seed, prompts=args.prompt,
         stream=not args.no_stream, timeout=args.timeout,
+        workload=args.workload,
     )
     stats = dict(stats)
     stats.pop("results")
     print(json.dumps(stats, indent=1))
-    return 0 if stats["errors"] == 0 else 1
+    return 0 if stats["errors"] == 0 and stats["json_invalid"] == 0 else 1
 
 
 if __name__ == "__main__":
