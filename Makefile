@@ -126,6 +126,19 @@ constrain-smoke:
 	CAKE_BENCH_CONSTRAIN=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
 
+# gateway smoke: the multi-replica routing plane (cake_tpu/gateway) —
+# 3-backend loopback fleet with SSE pass-through bit-identical to a
+# direct connection, transparent retry + circuit breaker around a killed
+# backend, prefix-affinity routing concentrating same-prefix requests on
+# one replica (its engine prefix-store hits move, round_robin's do not),
+# draining backends routed around with zero 5xx, loadgen --retry-429 and
+# --spawn-backends — then the CAKE_BENCH_GATEWAY gateway-vs-direct HTTP
+# tok/s + TTFT overhead row (design target: within 10%).
+gateway-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_gateway.py -q -m 'not slow'
+	CAKE_BENCH_GATEWAY=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -136,7 +149,7 @@ constrain-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -155,4 +168,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke perf-smoke deploy clean

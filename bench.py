@@ -35,6 +35,11 @@ Knobs (env):
                      asserted json.loads-parseable) vs the same server
                      unconstrained — constrained tok/s with
                      vs_baseline = constrained/unconstrained.
+  CAKE_BENCH_GATEWAY=1 routing-gateway overhead (cake_tpu/gateway): the
+                     same loadgen workload against one serve replica
+                     directly vs through a gateway fronting it —
+                     gateway tok/s with vs_baseline = gateway/direct
+                     plus the TTFT p50 the extra hop adds.
 """
 
 from __future__ import annotations
@@ -697,6 +702,110 @@ def _run_serve_http(config, params, preset, quant, dev, batch,
     return 0
 
 
+def _run_gateway_http(config, params, preset, quant, dev, batch,
+                      steps) -> int:
+    """CAKE_BENCH_GATEWAY=1: the routing gateway's own overhead — the
+    same loadgen workload against one serve replica directly, then
+    through a gateway (cake_tpu/gateway) fronting it. The figure of
+    merit is gateway tok/s with vs_baseline = gateway/direct (the proxy
+    hop, routing decision, and health bookkeeping are the whole gap; the
+    design target is within 10% on the smoke config), plus the TTFT p50
+    delta the extra hop adds."""
+    from cake_tpu.gateway.api import start_gateway
+    from cake_tpu.gateway.health import Backend, HealthMonitor
+    from cake_tpu.gateway.policy import make_policy
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    kv_quant = _kv_quant()
+    batch = max(2, batch)
+    max_tokens = max(4, min(steps, config.max_seq_len - 16))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = BatchGenerator(config, params, settings=settings,
+                         kv_quant=kv_quant)
+    sched = Scheduler(gen, queue_depth=4 * batch)
+    sched.start(max_concurrent=batch, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    direct_url = f"http://127.0.0.1:{srv.port}"
+    monitor = HealthMonitor(
+        [Backend("b0", f"127.0.0.1:{srv.port}")], probe_interval=0.5)
+    monitor.start()
+    gw = start_gateway(monitor, make_policy("p2c"))
+    gw_url = f"http://127.0.0.1:{gw.port}"
+    directs, via_gws = [], []
+    try:
+        # warm BOTH paths (compiles + the gateway's connect machinery),
+        # then interleave the measured legs A/B/A/B — sequential legs
+        # against the shared engine bias whichever runs later (EMA and
+        # warmup drift exceed the ms-scale overhead being measured)
+        loadgen.run_load(direct_url, batch, concurrency=batch,
+                         max_tokens=4, prompt_lens=[8],
+                         vocab=config.vocab_size - 1, seed=1)
+        loadgen.run_load(gw_url, batch, concurrency=batch,
+                         max_tokens=4, prompt_lens=[8],
+                         vocab=config.vocab_size - 1, seed=1)
+        for rep in range(2):
+            directs.append(loadgen.run_load(
+                direct_url, 2 * batch, concurrency=batch,
+                max_tokens=max_tokens, prompt_lens=[8],
+                vocab=config.vocab_size - 1, seed=2 + rep))
+            via_gws.append(loadgen.run_load(
+                gw_url, 2 * batch, concurrency=batch,
+                max_tokens=max_tokens, prompt_lens=[8],
+                vocab=config.vocab_size - 1, seed=2 + rep))
+    finally:
+        gw.close()
+        monitor.stop()
+        srv.close()
+        sched.close()
+
+    def _agg(legs):
+        tokens = sum(s["tokens"] for s in legs)
+        wall = sum(s["wall_s"] for s in legs)
+        return {
+            "tok_s": round(tokens / wall, 2) if wall else 0.0,
+            "ttft_p50_ms": round(
+                sum(s["ttft_ms"]["p50"] for s in legs) / len(legs), 1),
+            "completed": sum(s["completed"] for s in legs),
+            "errors": sum(s["errors"] for s in legs),
+            "requests": sum(s["requests"] for s in legs),
+        }
+
+    direct, via_gw = _agg(directs), _agg(via_gws)
+    if (direct["errors"] or via_gw["errors"]
+            or direct["completed"] != 4 * batch
+            or via_gw["completed"] != 4 * batch):
+        sys.stderr.write(f"gateway bench failed: direct={direct} "
+                         f"gateway={via_gw}\n")
+        return 1
+    ratio = via_gw["tok_s"] / direct["tok_s"] if direct["tok_s"] else 0.0
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": (f"gateway_http_tokens_per_sec_{_mtag(preset)}_{wtag}_"
+                   f"1chip_c{batch}"),
+        "value": via_gw["tok_s"],
+        "unit": "tokens/s",
+        "vs_baseline": round(ratio, 4),
+    }, dev,
+        baseline=f"direct_http_{direct['tok_s']:.1f}tok/s",
+        ttft_p50_ms=via_gw["ttft_p50_ms"],
+        ttft_p50_direct_ms=direct["ttft_p50_ms"],
+        ttft_added_p50_ms=round(via_gw["ttft_p50_ms"]
+                                - direct["ttft_p50_ms"], 1),
+        requests=via_gw["requests"], max_tokens=max_tokens,
+        interleaved_reps=2)
+    sys.stderr.write(
+        f"device={dev.device_kind} clients={batch} "
+        f"gateway_tok_s={via_gw['tok_s']} direct_tok_s={direct['tok_s']} "
+        f"ratio={ratio:.3f} ttft_p50 {direct['ttft_p50_ms']} -> "
+        f"{via_gw['ttft_p50_ms']} ms\n"
+    )
+    return 0
+
+
 class _AsciiTok:
     """Printable-ASCII toy tokenizer for the constrained-serving row: id
     -> one printable char (mod 95), so grammar compilation has real vocab
@@ -1269,6 +1378,9 @@ def main() -> int:
     if os.environ.get("CAKE_BENCH_CONSTRAIN") == "1":
         return _run_serve_constrain(config, params, preset, quant, dev,
                                     batch, steps)
+    if os.environ.get("CAKE_BENCH_GATEWAY") == "1":
+        return _run_gateway_http(config, params, preset, quant, dev,
+                                 batch, steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

@@ -42,8 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="cake-tpu",
         description="TPU-native distributed single-stream LLM inference",
     )
-    p.add_argument("--model", required=True,
-                   help="checkpoint directory (config.json + safetensors)")
+    p.add_argument("--model", default=None,
+                   help="checkpoint directory (config.json + safetensors); "
+                        "required in every mode except gateway (a gateway "
+                        "holds no model — its backends do)")
     p.add_argument("--fetch", default=None, metavar="SRC",
                    help="populate --model first from hf://org/name[@rev] or "
                         "a local dir (idempotent; unlike the reference's "
@@ -51,7 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--refetch", action="store_true",
                    help="with --fetch: re-copy/re-download even if --model "
                         "already holds a complete checkpoint")
-    p.add_argument("--mode", choices=["master", "worker", "serve"],
+    p.add_argument("--mode", choices=["master", "worker", "serve",
+                                      "gateway"],
                    default="master",
                    help="master: one-shot generation (default); worker: "
                         "serve topology-assigned layers over the wire; "
@@ -60,7 +63,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "/v1/models, /healthz, plus the / + /metrics "
                         "status surface) over the continuous-batching "
                         "engine, with admission queueing, backpressure, "
-                        "cancellation, and graceful SIGTERM drain")
+                        "cancellation, and graceful SIGTERM drain; "
+                        "gateway: route the same API across a fleet of "
+                        "serve replicas (--backends) with health-checked "
+                        "load-aware routing, transparent failover, and "
+                        "SSE pass-through")
     p.add_argument("--name", default=None, help="worker name in the topology")
     p.add_argument("--address", default="127.0.0.1:10128",
                    help="worker bind address")
@@ -293,6 +300,38 @@ def build_parser() -> argparse.ArgumentParser:
                         "log-softmax, so requests may ask 'logprobs': N "
                         "for any N <= K (default 0: refused with 400; "
                         "needs the batched mesh engine)")
+    # -- routing gateway (--mode gateway: cake_tpu/gateway) ------------------
+    p.add_argument("--backends", default=None, metavar="HOST:PORT,...",
+                   help="--mode gateway: comma-separated serve-replica "
+                        "addresses the gateway routes across (each runs "
+                        "--mode serve; the gateway health-checks their "
+                        "/healthz and proxies /v1/completions, /v1/models "
+                        "to the fleet)")
+    p.add_argument("--route-policy", choices=["p2c", "round_robin",
+                                              "prefix"],
+                   default="p2c", dest="route_policy",
+                   help="--mode gateway: routing policy — p2c "
+                        "(power-of-two-choices on the live /healthz load "
+                        "signal; default), round_robin, or prefix "
+                        "(prefix-affinity: same-prefix prompts land on "
+                        "the replica whose engine prefix store already "
+                        "holds their KV, p2c fallback when it is "
+                        "saturated)")
+    p.add_argument("--probe-interval", type=float, default=2.0,
+                   dest="probe_interval", metavar="S",
+                   help="--mode gateway: seconds between /healthz probe "
+                        "passes (default 2.0); DOWN backends re-probe on "
+                        "a jittered backoff instead (the circuit "
+                        "breaker)")
+    p.add_argument("--gateway-prefix-block", type=int, default=64,
+                   dest="gateway_prefix_block", metavar="N",
+                   help="--mode gateway: prefix-affinity alignment — the "
+                        "routing key is the FIRST N tokens of the prompt "
+                        "(characters for a text prompt), so prompts "
+                        "sharing a system prefix route together whatever "
+                        "their tail length; prompts shorter than N get "
+                        "no preference (default 64, matching the "
+                        "engine's prefix_block)")
     p.add_argument("--logit-bias", default=None, dest="logit_bias",
                    metavar="ID:BIAS[,ID:BIAS...]",
                    help="static token-id logit biases compiled into the "
@@ -773,6 +812,142 @@ def run_http_serve(args) -> int:
     return 0
 
 
+def _gateway_flags(args) -> list[str]:
+    """Names of the --mode gateway flags the user actually set — they
+    mean nothing on the single-process modes."""
+    out = []
+    if args.backends is not None:
+        out.append("--backends")
+    if args.route_policy != "p2c":
+        out.append("--route-policy")
+    if args.probe_interval != 2.0:
+        out.append("--probe-interval")
+    if args.gateway_prefix_block != 64:
+        out.append("--gateway-prefix-block")
+    return out
+
+
+def run_gateway(args) -> int:
+    """--mode gateway: the multi-replica routing front door
+    (cake_tpu/gateway) — health-checked, load-aware routing of the
+    serving API across a fleet of --mode serve replicas. The gateway
+    holds no model and touches no accelerator: it is pure fleet plumbing
+    (probes, policy, proxy), so one host can front many."""
+    import signal
+    import threading
+
+    from cake_tpu import __version__, obs
+    from cake_tpu.gateway.api import parse_backends, start_gateway
+    from cake_tpu.gateway.health import HealthMonitor
+    from cake_tpu.gateway.policy import make_policy
+    from cake_tpu.obs import metrics as obs_metrics
+
+    if not args.backends:
+        sys.exit("error: --mode gateway requires --backends "
+                 "HOST:PORT[,HOST:PORT...] (the serve replicas to route "
+                 "across)")
+    if args.model:
+        sys.exit("error: --model belongs to the serving/generation modes; "
+                 "a gateway holds no model — point --backends at --mode "
+                 "serve replicas instead")
+    if args.topology:
+        sys.exit("error: --topology describes a model deployment; the "
+                 "gateway's fleet is --backends (each backend may itself "
+                 "run a --topology)")
+    if args.prompts_file or args.prompt_ids:
+        sys.exit("error: --mode gateway takes requests over HTTP "
+                 "(POST /v1/completions); --prompts-file/--prompt-ids "
+                 "belong to the one-shot paths")
+    if args.cluster_report or args.top:
+        sys.exit("error: --cluster-report/--top aggregate a master's "
+                 "workers; the gateway exposes its fleet view on / and "
+                 "/metrics instead")
+    flags = _failure_domain_flags(args)
+    if flags:
+        sys.exit(f"error: {'/'.join(flags)} drive a master's worker "
+                 "links; the gateway's failure handling is built in "
+                 "(probes, breaker, transparent retry)")
+    engine_flags = [f for f, on in (
+        ("--max-concurrent", args.max_concurrent is not None),
+        ("--queue-depth", args.queue_depth is not None),
+        ("--serve-logprobs", bool(args.serve_logprobs)),
+    ) if on]
+    if engine_flags:
+        sys.exit(f"error: {'/'.join(engine_flags)} configure a serve "
+                 "replica's engine; pass them to the --mode serve "
+                 "processes behind --backends")
+    if args.probe_interval <= 0:
+        sys.exit("error: --probe-interval must exceed 0")
+    if args.gateway_prefix_block < 1:
+        sys.exit("error: --gateway-prefix-block must be >= 1")
+    if args.request_timeout is not None and args.request_timeout <= 0:
+        sys.exit("error: --request-timeout must exceed 0")
+
+    serve_port = args.serve_port if args.serve_port is not None else 8080
+    serve_bind = args.serve_bind or "127.0.0.1"
+    request_timeout = (args.request_timeout
+                       if args.request_timeout is not None else 300.0)
+    try:
+        backends = parse_backends(args.backends)
+    except ValueError as e:
+        sys.exit(f"error: {e}")
+    monitor = HealthMonitor(backends, probe_interval=args.probe_interval)
+    policy = make_policy(args.route_policy,
+                         prefix_block=args.gateway_prefix_block)
+    monitor.start()
+
+    def gateway_status():
+        return {
+            "role": "gateway",
+            "version": __version__,
+            "policy": args.route_policy,
+            "backends": monitor.describe(),
+            "metrics": obs_metrics.registry().snapshot(),
+        }
+
+    server = start_gateway(monitor, policy, bind=serve_bind,
+                           port=serve_port,
+                           prefix_block=args.gateway_prefix_block,
+                           read_timeout=request_timeout,
+                           status_fn=gateway_status)
+    status_httpd = None
+    if args.status_port is not None:
+        from cake_tpu.obs import statusd
+
+        status_httpd, bound = statusd.start_status_server(
+            gateway_status, bind=args.status_bind, port=args.status_port)
+        log.info("status page on http://%s:%d/", args.status_bind, bound)
+    up = len(monitor.routable())
+    log.info("gateway on http://%s:%d/ — %d backend(s), %d up, "
+             "policy %s, probe every %gs",
+             serve_bind, server.port, len(backends), up,
+             args.route_policy, args.probe_interval)
+    if not up:
+        log.warning("no backend answered the initial probe; serving 503 "
+                    "until one comes up")
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        log.info("signal %d: draining (no new admissions; in-flight "
+                 "proxied streams finish)", signum)
+        stop.set()
+
+    for signum in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(signum, _on_signal)
+    try:
+        stop.wait()
+    finally:
+        server.drain(timeout_s=request_timeout)
+        if status_httpd is not None:
+            status_httpd.shutdown()
+            status_httpd.server_close()
+        monitor.stop()
+        obs.flush_artifacts()
+        log.info("drained; bye")
+    return 0
+
+
 def _build_distributed_gen(args, config, topology, tokenizer, settings):
     """Cross-host master over a host-addressed topology (shared by the
     one-shot master and --mode serve's single-stream engine path): head
@@ -1140,6 +1315,12 @@ def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from cake_tpu import obs
 
+    if args.mode != "gateway" and not args.model:
+        sys.exit("error: --model is required (only --mode gateway runs "
+                 "without a checkpoint)")
+    if args.mode == "gateway" and args.fetch:
+        sys.exit("error: --fetch populates --model, and a gateway holds "
+                 "no model; fetch on the --mode serve replicas instead")
     obs.setup_logging("debug" if args.verbose else args.log_level)
     if args.trace:
         # --profile already captures an XLA trace; passing spans through as
@@ -1188,15 +1369,22 @@ def main(argv=None) -> int:
             fetch_checkpoint(args.fetch, args.model, force=args.refetch)
         except Exception as e:
             sys.exit(f"error: fetch from {args.fetch} failed: {e}")
-    if args.mode != "serve" and _serve_flags(args):
+    if args.mode not in ("serve", "gateway") and _serve_flags(args):
         sys.exit(f"error: {'/'.join(_serve_flags(args))} configure the "
-                 "HTTP serving plane; they require --mode serve (they "
+                 "HTTP serving plane; they require --mode serve or "
+                 "--mode gateway (they would otherwise be silently "
+                 "ignored)")
+    if args.mode != "gateway" and _gateway_flags(args):
+        sys.exit(f"error: {'/'.join(_gateway_flags(args))} configure the "
+                 "routing gateway; they require --mode gateway (they "
                  "would otherwise be silently ignored)")
     try:
         if args.mode == "worker":
             return run_worker(args)
         if args.mode == "serve":
             return run_http_serve(args)
+        if args.mode == "gateway":
+            return run_gateway(args)
         if args.prompts_file:
             return run_serve(args)
         return run_master(args)

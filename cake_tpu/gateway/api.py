@@ -1,0 +1,466 @@
+"""The gateway front door: one port, many serve replicas behind it.
+
+A threaded HTTP server (the ``serve/api.py`` shape, stdlib-only) that
+proxies the serving API across a fleet of ``--mode serve`` replicas:
+
+- ``POST /v1/completions`` — routed by the configured policy
+  (``gateway/policy.py``) to one UP backend. Unary responses relay whole;
+  ``stream: true`` responses pass through as raw SSE bytes chunk by chunk
+  (bit-identical to a direct connection — the gateway never reframes). A
+  connect failure or 5xx **before the first SSE byte** is retried
+  transparently on another backend (the client never learns); once a byte
+  has been forwarded the stream is committed and a mid-flight death
+  truncates it honestly. A 429 marks the backend saturated and tries the
+  next one — the client sees 429 (with the backend's ``Retry-After``)
+  only when EVERY routable backend refused.
+- ``GET /v1/models`` — relayed from any UP backend (replicas serve the
+  same model by contract).
+- ``GET /healthz`` — the gateway's own probe surface: 200 while at least
+  one backend is routable and the gateway is not draining, 503 otherwise,
+  body carrying the per-backend state map (so a gateway can itself sit
+  behind another gateway or an external balancer).
+- ``GET /`` + ``GET /metrics`` — the shared ``obs/statusd`` status
+  surface: fleet state JSON and the process registry (all ``gateway.*``
+  series) in Prometheus text.
+
+Graceful drain mirrors serve: ``drain()`` (the SIGTERM path) stops
+admitting (503), waits for in-flight proxied requests — streams included
+— to finish, then closes the listener.
+"""
+
+from __future__ import annotations
+
+import http.client
+import http.server
+import json
+import logging
+import threading
+import time
+
+from cake_tpu.gateway import policy as policy_mod
+from cake_tpu.gateway.health import Backend, HealthMonitor
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import statusd as _statusd
+
+log = logging.getLogger("cake_tpu.gateway.api")
+
+REQUESTS = obs_metrics.counter("gateway.requests")
+RETRIES = obs_metrics.counter("gateway.retries")
+REJECTED = obs_metrics.counter("gateway.rejected")
+SATURATED = obs_metrics.counter("gateway.saturated")
+ADDED_MS = obs_metrics.histogram("gateway.added_ms")
+
+_HOP_HEADERS = ("Content-Type", "Cache-Control", "Retry-After")
+
+
+class _Attempt:
+    """One backend attempt: connection + response, closed as a unit."""
+
+    def __init__(self, backend: Backend, connect_timeout: float,
+                 read_timeout: float):
+        self.backend = backend
+        self.conn = http.client.HTTPConnection(
+            backend.host, backend.port, timeout=connect_timeout)
+        self.read_timeout = read_timeout
+        self.resp: http.client.HTTPResponse | None = None
+        self.t_sent: float | None = None
+
+    def send(self, method: str, path: str, body: bytes | None = None):
+        """Connect (short timeout), widen to the stream timeout, send,
+        and read the response head. Raises ``OSError`` on any transport
+        failure — the retry loop's cue. ``t_sent`` is stamped the moment
+        the request is fully handed to the backend, BEFORE the response
+        wait: everything up to it is gateway-added latency, everything
+        after it is the backend working."""
+        self.conn.connect()
+        self.conn.sock.settimeout(self.read_timeout)
+        headers = {}
+        if body is not None:
+            headers = {"Content-Type": "application/json",
+                       "Content-Length": str(len(body))}
+        self.conn.request(method, path, body=body, headers=headers)
+        self.t_sent = time.perf_counter()
+        self.resp = self.conn.getresponse()
+        return self.resp
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+class GatewayServer:
+    """The routing front door; ``start_gateway`` is the entry point."""
+
+    # in-flight accounting shared between handler threads and drain()
+    _GUARDED_BY = {"_inflight": "_cond", "_draining": "_cond"}
+
+    def __init__(self, monitor: HealthMonitor, policy,
+                 bind: str = "127.0.0.1", port: int = 0,
+                 prefix_block: int = 64, connect_timeout: float = 2.0,
+                 read_timeout: float = 300.0, status_fn=None):
+        self.monitor = monitor
+        self.policy = policy
+        # one source of truth for the affinity alignment: a Prefix policy
+        # carries its own block, and the key MUST be computed at that
+        # block for the policy's hashing to group what it means to group;
+        # the server-level knob only covers policies without one
+        self.prefix_block = max(1, getattr(policy, "block", None)
+                                or prefix_block)
+        self.connect_timeout = connect_timeout
+        self.read_timeout = read_timeout
+        self._cond = threading.Condition()
+        self._inflight = 0
+        self._draining = False
+        if status_fn is None:
+            def status_fn():
+                return {"role": "gateway",
+                        "policy": getattr(policy, "name", "?"),
+                        "backends": monitor.describe(),
+                        "metrics": obs_metrics.registry().snapshot()}
+        self.status_fn = status_fn
+        handler = _make_handler(self)
+        self.httpd = http.server.ThreadingHTTPServer((bind, port), handler)
+        self.port = self.httpd.server_address[1]
+        self.bind = bind
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True,
+                                        name="cake-gateway-http")
+
+    def start(self) -> "GatewayServer":
+        self._thread.start()
+        return self
+
+    # -- drain bookkeeping ----------------------------------------------------
+    def _enter(self) -> bool:
+        with self._cond:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def _exit(self) -> None:
+        with self._cond:
+            self._inflight -= 1
+            self._cond.notify_all()
+
+    def is_draining(self) -> bool:
+        with self._cond:
+            return self._draining
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """SIGTERM path: stop admitting (503), let in-flight proxied
+        requests — streams included — run out (bounded), then close the
+        listener. Teardown runs even if the wait is interrupted."""
+        try:
+            with self._cond:
+                self._draining = True
+                deadline = time.monotonic() + timeout_s
+                while self._inflight > 0:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        log.warning("drain timed out with %d request(s) "
+                                    "in flight", self._inflight)
+                        break
+                    self._cond.wait(left)
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        try:
+            self.httpd.shutdown()
+        finally:
+            self.httpd.server_close()
+
+
+def start_gateway(monitor: HealthMonitor, policy, bind: str = "127.0.0.1",
+                  port: int = 0, **kw) -> GatewayServer:
+    """Build + start a :class:`GatewayServer` (``port=0`` ephemeral)."""
+    return GatewayServer(monitor, policy, bind=bind, port=port,
+                         **kw).start()
+
+
+def _make_handler(server: GatewayServer):
+    monitor = server.monitor
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            log.debug("gateway: " + fmt, *args)
+
+        # -- reply helpers ------------------------------------------------
+        def _send_raw(self, status: int, body: bytes,
+                      headers: dict | None = None) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, status: int, obj: dict,
+                  headers: dict | None = None) -> None:
+            self._send_raw(status, json.dumps(obj, indent=1).encode(),
+                           headers)
+
+        def _error(self, status: int, message: str,
+                   headers: dict | None = None) -> None:
+            self._json(status, {"error": message}, headers)
+
+        def _relay(self, resp, data: bytes) -> None:
+            """One whole (non-streaming) backend response to the client,
+            status and relevant headers preserved."""
+            self.send_response(resp.status)
+            for h in _HOP_HEADERS:
+                v = resp.getheader(h)
+                if v is not None:
+                    self.send_header(h, v)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        # -- GET: health, discovery, status surface -----------------------
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            path = self.path.rstrip("/") or "/"
+            if path == "/healthz":
+                ups = monitor.routable()
+                draining = server.is_draining()
+                ok = bool(ups) and not draining
+                self._json(200 if ok else 503, {
+                    "ok": ok,
+                    "draining": draining,
+                    "backends_up": len(ups),
+                    "backends": {b.name: b.state
+                                 for b in monitor.backends},
+                })
+            elif path == "/v1/models":
+                self._proxy_get("/v1/models")
+            elif path in ("/", "/metrics"):
+                body, ctype = _statusd.status_response(server.status_fn,
+                                                       path)
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._error(404, f"no route for GET {self.path}")
+
+        def _proxy_get(self, path: str) -> None:
+            """Relay a small GET from any UP backend (retrying across the
+            fleet; replicas answer identically by contract)."""
+            tried: list = []
+            while True:
+                cands = [b for b in monitor.routable() if b not in tried]
+                if not cands:
+                    self._error(502, "no backend available")
+                    return
+                b = server.policy.choose(cands, now=time.monotonic())
+                tried.append(b)
+                att = _Attempt(b, server.connect_timeout,
+                               server.connect_timeout)
+                try:
+                    resp = att.send("GET", path)
+                    data = resp.read()
+                except OSError:
+                    monitor.report_failure(b)
+                    continue
+                finally:
+                    att.close()
+                self._relay(resp, data)
+                return
+
+        # -- POST: routed completions -------------------------------------
+        def do_POST(self):  # noqa: N802 (stdlib casing)
+            if self.path.rstrip("/") != "/v1/completions":
+                self._error(404, f"no route for POST {self.path}")
+                return
+            if not server._enter():
+                # refused at the door: rejected only — gateway.requests
+                # counts ACCEPTED requests (the catalog's contract)
+                REJECTED.inc()
+                self._error(503, "gateway is draining")
+                return
+            REQUESTS.inc()
+            try:
+                self._proxy_completions()
+            finally:
+                server._exit()
+
+        def _proxy_completions(self) -> None:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(length)
+            except (ValueError, OSError) as e:
+                self._error(400, f"bad request body: {e}")
+                return
+            # the body is parsed ONLY to derive the affinity key, and
+            # only the prefix policy reads one — p2c/round_robin must
+            # not pay a json.loads of a potentially huge prompt per
+            # request on the front door's hot path
+            key = None
+            if getattr(server.policy, "wants_key", False):
+                try:
+                    body = json.loads(raw or b"{}")
+                except ValueError:
+                    body = None  # forward anyway; the backend 400s
+                if isinstance(body, dict):
+                    key = policy_mod.prefix_key(body,
+                                                server.prefix_block)
+            t0 = time.perf_counter()
+            tried: list = []
+            last_429: tuple | None = None
+            while True:
+                now = time.monotonic()
+                cands = [b for b in monitor.routable() if b not in tried]
+                if not cands:
+                    if last_429 is not None:
+                        # every routable backend is saturated: only now
+                        # does the client see the backpressure
+                        SATURATED.inc()
+                        resp_data, retry_after = last_429
+                        self._send_raw(429, resp_data,
+                                       {"Retry-After": retry_after}
+                                       if retry_after else None)
+                    else:
+                        REJECTED.inc()
+                        self._error(503, "no backend available")
+                    return
+                b = server.policy.choose(cands, key=key, now=now,
+                                         first_attempt=not tried)
+                tried.append(b)
+                if len(tried) > 1:
+                    RETRIES.inc()
+                    tried[-2].retries.inc()
+                b.requests.inc()
+                outcome = self._try_backend(b, raw, t0)
+                if outcome == "done":
+                    return
+                if isinstance(outcome, tuple):  # a 429: remember, go on
+                    last_429 = outcome
+
+        def _try_backend(self, b: Backend, raw: bytes, t0: float):
+            """One routed attempt. Returns ``"done"`` when a response
+            (success or deterministic client error) reached the client,
+            a ``(body, retry_after)`` tuple on 429, or ``None`` when the
+            attempt failed and the retry loop should pick another
+            backend."""
+            att = _Attempt(b, server.connect_timeout, server.read_timeout)
+            try:
+                try:
+                    resp = att.send("POST", "/v1/completions", raw)
+                    t_sent = att.t_sent
+                except OSError as e:
+                    log.debug("backend %s connect/send failed: %s",
+                              b.name, e)
+                    b.errors.inc()
+                    monitor.report_failure(b)
+                    return None
+                if resp.status == 429:
+                    monitor.report_saturated(
+                        b, _as_seconds(resp.getheader("Retry-After")))
+                    try:
+                        data = resp.read()
+                    except OSError:
+                        data = b"{}"
+                    return (data, resp.getheader("Retry-After"))
+                if resp.status == 503:
+                    # the replica is draining (or refusing): route around
+                    # it and tell the monitor why
+                    monitor.report_draining(b)
+                    return None
+                if resp.status >= 500:
+                    b.errors.inc()
+                    monitor.report_failure(b)
+                    return None
+                ctype = resp.getheader("Content-Type", "")
+                if ctype.startswith("text/event-stream"):
+                    return self._relay_stream(b, resp, t0, t_sent)
+                # unary (200 or a deterministic 4xx): relay whole
+                try:
+                    data = resp.read()
+                except OSError:
+                    b.errors.inc()
+                    monitor.report_failure(b)
+                    return None
+                if resp.status < 400:
+                    ADDED_MS.observe((t_sent - t0) * 1e3)
+                    monitor.report_success(b)
+                try:
+                    self._relay(resp, data)
+                except OSError:
+                    pass  # client went away; nothing to unwind
+                return "done"
+            finally:
+                att.close()
+
+        def _relay_stream(self, b: Backend, resp, t0: float,
+                          t_sent: float):
+            """SSE pass-through. The client's response head is withheld
+            until the backend's first body byte arrives, so a backend
+            dying post-headers is still transparently retried; after the
+            first forwarded byte the stream is committed."""
+            try:
+                first = resp.read1(65536)
+            except OSError:
+                b.errors.inc()
+                monitor.report_failure(b)
+                return None
+            if not first:  # EOF before any event: died mid-prefill
+                b.errors.inc()
+                monitor.report_failure(b)
+                return None
+            ADDED_MS.observe((t_sent - t0) * 1e3)
+            monitor.report_success(b)
+            try:
+                self.send_response(200)
+                for h in ("Content-Type", "Cache-Control"):
+                    v = resp.getheader(h)
+                    if v is not None:
+                        self.send_header(h, v)
+                self.end_headers()
+                self.wfile.write(first)
+                self.wfile.flush()
+                while True:
+                    try:
+                        chunk = resp.read1(65536)
+                    except OSError as e:
+                        # BACKEND died mid-stream: the stream is already
+                        # committed, so truncate honestly — but this one
+                        # is the replica's fault, count it against it
+                        log.debug("backend %s died mid-stream: %s",
+                                  b.name, e)
+                        b.errors.inc()
+                        break
+                    if not chunk:
+                        break  # normal close-delimited end of stream
+                    self.wfile.write(chunk)
+                    self.wfile.flush()
+            except OSError as e:
+                # CLIENT went away: closing our backend socket (the
+                # attempt's finally) makes the replica's next write fail,
+                # which cancels its session and frees the slot — normal
+                # churn, not a backend error
+                log.debug("client left stream via %s: %s", b.name, e)
+            return "done"
+
+    return Handler
+
+
+def _as_seconds(retry_after: str | None) -> float:
+    try:
+        return float(retry_after) if retry_after else 1.0
+    except ValueError:
+        return 1.0
+
+
+def parse_backends(spec: str) -> list[Backend]:
+    """``host:port,host:port,...`` -> named Backend list (``b0``, ``b1``,
+    ... in spec order — the names key the per-backend metric series)."""
+    addrs = [a.strip() for a in spec.split(",") if a.strip()]
+    if not addrs:
+        raise ValueError("--backends wants host:port[,host:port...]")
+    if len(set(addrs)) != len(addrs):
+        raise ValueError(f"duplicate backend address in {spec!r}")
+    return [Backend(f"b{i}", a) for i, a in enumerate(addrs)]

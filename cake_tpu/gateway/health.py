@@ -1,0 +1,344 @@
+"""Per-backend health: UP / DRAINING / DOWN state machines + prober.
+
+Every serve replica behind the gateway gets one :class:`Backend`, whose
+state is fed from two directions:
+
+- **active probes** — a background :class:`HealthMonitor` thread GETs each
+  backend's ``/healthz`` every ``probe_interval`` seconds. The serve plane
+  answers that probe with its cheap load fields (``queued`` / ``running`` /
+  ``tok_s_ema`` / ``max_concurrent``), so one GET is both the liveness
+  check and the p2c load signal — no ``/metrics`` scrape on the hot path;
+- **passive signals** — every proxied request's outcome
+  (``report_success`` / ``report_failure`` / ``report_saturated``), so a
+  backend that dies between probes is marked down by the traffic itself,
+  not a poll later.
+
+Transitions carry hysteresis in both directions: ``down_after``
+consecutive failures (probe or passive) before UP -> DOWN, ``up_after``
+consecutive probe successes before DOWN -> UP — one dropped packet must
+not flap a replica out of rotation, and one lucky probe must not flap a
+crashing one back in. DRAINING is different: it is the backend's own
+explicit statement (a 503 ``/healthz`` with ``draining: true``), so it is
+believed immediately both ways.
+
+A DOWN backend routes through a circuit breaker: re-probes back off with
+full jitter on the shape of :class:`cake_tpu.runtime.retry.RetryPolicy`
+(the same policy plane the distributed master's reconnects use) instead
+of hammering a dead port every interval, and while the breaker holds the
+backend is not probed at all. Routing (``gateway/policy.py``) only ever
+sees ``routable()`` — the UP subset.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.runtime.retry import RetryPolicy
+
+log = logging.getLogger("cake_tpu.gateway.health")
+
+UP = "up"
+DRAINING = "draining"
+DOWN = "down"
+
+# gauge encoding for the per-backend state series (gateway.<name>.state)
+_STATE_VALUE = {UP: 2, DRAINING: 1, DOWN: 0}
+
+BACKENDS_UP = obs_metrics.gauge("gateway.backends_up")
+BREAKER_OPEN = obs_metrics.gauge("gateway.breaker_open")
+
+
+class Backend:
+    """One serve replica: address, health state, and live load signal."""
+
+    # Shared between HTTP handler threads (routing + passive signals) and
+    # the monitor's probe thread; every touch goes through the lock
+    # (machine-checked by cakelint CK-LOCK).
+    _GUARDED_BY = {
+        "_state": "_lock",
+        "_fails": "_lock",
+        "_oks": "_lock",
+        "_load": "_lock",
+        "_saturated_until": "_lock",
+        "_breaker_attempt": "_lock",
+        "_next_probe_t": "_lock",
+    }
+
+    def __init__(self, name: str, addr: str):
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"backend address {addr!r} is not host:port")
+        self.name = name
+        self.addr = addr
+        self.host = host
+        self.port = int(port)
+        self._lock = threading.Lock()
+        # optimistic start: a freshly configured backend is routable until
+        # the first probe (run synchronously at monitor start) says no
+        self._state = UP
+        self._fails = 0
+        self._oks = 0
+        self._load = {"queued": 0, "running": 0, "max_concurrent": 1,
+                      "tok_s_ema": 0.0}
+        self._saturated_until = 0.0
+        self._breaker_attempt = 0
+        self._next_probe_t = 0.0
+        # per-backend traffic/health series (dynamic gateway.* family)
+        self.requests = obs_metrics.counter(f"gateway.{name}.requests")
+        self.retries = obs_metrics.counter(f"gateway.{name}.retries")
+        self.errors = obs_metrics.counter(f"gateway.{name}.errors")
+        self._state_gauge = obs_metrics.gauge(f"gateway.{name}.state")
+        self._state_gauge.set(_STATE_VALUE[UP])
+
+    # -- read side (routing) --------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def routable(self) -> bool:
+        with self._lock:
+            return self._state == UP
+
+    def load_score(self) -> float:
+        """Outstanding work per slot — the p2c comparison key."""
+        with self._lock:
+            ld = self._load
+            return (ld["queued"] + ld["running"]) / max(
+                1, ld["max_concurrent"])
+
+    def saturated(self, now: float | None = None) -> bool:
+        """No free slot at the last probe, or a recent 429 said so."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            ld = self._load
+            if now < self._saturated_until:
+                return True
+            return ld["queued"] + ld["running"] >= ld["max_concurrent"]
+
+    def breaker_open(self, now: float | None = None) -> bool:
+        """DOWN with the next re-probe still backed off into the future."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._state == DOWN and now < self._next_probe_t
+
+    def probe_due(self, now: float) -> bool:
+        with self._lock:
+            return self._state != DOWN or now >= self._next_probe_t
+
+    def describe(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "addr": self.addr,
+                "state": self._state,
+                "load": dict(self._load),
+                "consecutive_failures": self._fails,
+                "requests": self.requests.value,
+                "errors": self.errors.value,
+            }
+
+    # -- write side (monitor + passive request outcomes) ----------------------
+    def probe_ok(self, load: dict, up_after: int) -> None:
+        """A 200 ``/healthz``: refresh the load signal; DOWN needs
+        ``up_after`` consecutive clean probes to re-enter rotation,
+        DRAINING re-enters immediately (the backend explicitly said it is
+        serving again)."""
+        with self._lock:
+            for k in self._load:
+                if k in load:
+                    self._load[k] = load[k]
+            self._fails = 0
+            self._oks += 1
+            if self._state == DRAINING or (
+                self._state == DOWN and self._oks >= up_after
+            ):
+                self._set_state_locked(UP)
+            if self._state == UP:
+                self._breaker_attempt = 0
+                self._next_probe_t = 0.0
+
+    def probe_draining(self) -> None:
+        """The backend's own drain statement (503 + ``draining: true``):
+        believed immediately, no hysteresis, no breaker — it is alive and
+        will say when it is back."""
+        with self._lock:
+            self._fails = 0
+            self._oks = 0
+            if self._state != DRAINING:
+                self._set_state_locked(DRAINING)
+
+    def report_failure(self, policy: RetryPolicy,
+                       rng: random.Random, down_after: int,
+                       now: float | None = None) -> None:
+        """A probe or proxied request failed (connect refused, timeout,
+        5xx): count toward DOWN; once DOWN, back the next re-probe off
+        with full jitter (the circuit breaker)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._fails += 1
+            self._oks = 0
+            if self._state != DOWN and self._fails >= down_after:
+                self._set_state_locked(DOWN)
+            if self._state == DOWN:
+                # equal-jitter floor on the full-jitter sample: a breaker
+                # whose jitter lands near zero would re-probe instantly,
+                # which is no breaker at all
+                self._next_probe_t = now + max(
+                    policy.backoff_s(min(self._breaker_attempt, 8), rng),
+                    policy.base_s / 2)
+                self._breaker_attempt += 1
+
+    def report_success(self) -> None:
+        """A proxied request completed: clears the failure streak (state
+        transitions stay probe-driven — traffic only ever lands on UP
+        backends, so there is nothing to promote)."""
+        with self._lock:
+            self._fails = 0
+
+    def report_saturated(self, retry_after_s: float,
+                         now: float | None = None) -> None:
+        """The backend answered 429: treat it as saturated for the
+        Retry-After window without waiting for the next probe."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._saturated_until = max(
+                self._saturated_until, now + max(0.0, retry_after_s))
+
+    def _set_state_locked(self, state: str) -> None:
+        log.info("backend %s (%s): %s -> %s", self.name, self.addr,
+                 self._state, state)
+        self._state = state
+        self._state_gauge.set(_STATE_VALUE[state])
+
+
+class HealthMonitor:
+    """Background ``/healthz`` prober over a fixed backend set."""
+
+    def __init__(self, backends: list[Backend], probe_interval: float = 2.0,
+                 down_after: int = 2, up_after: int = 2,
+                 probe_timeout: float | None = None,
+                 retry_policy: RetryPolicy | None = None,
+                 rng: random.Random | None = None):
+        if not backends:
+            raise ValueError("a gateway needs at least one backend")
+        if probe_interval <= 0:
+            raise ValueError("probe_interval must exceed 0")
+        self.backends = list(backends)
+        self.probe_interval = probe_interval
+        self.down_after = max(1, down_after)
+        self.up_after = max(1, up_after)
+        self.probe_timeout = (probe_timeout if probe_timeout is not None
+                              else max(0.5, min(2.0, probe_interval)))
+        # breaker shape: first re-probe within ~a probe interval, capped
+        # well under a minute — a restarted replica should not sit out
+        # long, it just must not be hammered while dead
+        self.retry_policy = retry_policy or RetryPolicy(
+            deadline_s=None, max_attempts=1 << 30,
+            base_s=probe_interval, cap_s=max(4 * probe_interval, 15.0))
+        self._rng = rng or random.Random()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- routing views --------------------------------------------------------
+    def routable(self) -> list[Backend]:
+        return [b for b in self.backends if b.routable()]
+
+    def describe(self) -> list[dict]:
+        return [b.describe() for b in self.backends]
+
+    # -- passive signals (called by the proxy path) ---------------------------
+    def report_failure(self, backend: Backend) -> None:
+        backend.report_failure(self.retry_policy, self._rng,
+                               self.down_after)
+        self._publish_gauges()
+
+    def report_success(self, backend: Backend) -> None:
+        backend.report_success()
+
+    def report_saturated(self, backend: Backend,
+                         retry_after_s: float) -> None:
+        backend.report_saturated(retry_after_s)
+
+    def report_draining(self, backend: Backend) -> None:
+        backend.probe_draining()
+        self._publish_gauges()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self, initial_probe: bool = True) -> "HealthMonitor":
+        """Launch the probe thread; with ``initial_probe`` one synchronous
+        pass runs first, so a gateway never starts routing on pure
+        optimism toward a port nobody listens on."""
+        if self._thread is not None:
+            raise RuntimeError("monitor already started")
+        if initial_probe:
+            # the bootstrap pass is DECISIVE (down_after=1): hysteresis
+            # exists to absorb blips on a backend with history, but at
+            # start there is no history — a port refusing the very first
+            # probe is dead NOW, and marking it UP anyway would falsify
+            # the whole point of probing before routing
+            self.probe_pass(bootstrap=True)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="cake-gateway-health")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.probe_interval):
+            try:
+                self.probe_pass()
+            except Exception:  # a probe pass must never kill the thread
+                log.exception("health probe pass failed")
+
+    def probe_pass(self, bootstrap: bool = False) -> None:
+        """Probe every backend whose breaker allows it, then refresh the
+        fleet-level gauges. ``bootstrap`` collapses the DOWN hysteresis
+        to one failure (the decisive first pass)."""
+        now = time.monotonic()
+        down_after = 1 if bootstrap else self.down_after
+        for b in self.backends:
+            if b.probe_due(now):
+                self._probe_one(b, down_after)
+        self._publish_gauges()
+
+    def _probe_one(self, b: Backend, down_after: int) -> None:
+        url = f"http://{b.addr}/healthz"
+        try:
+            with urllib.request.urlopen(url,
+                                        timeout=self.probe_timeout) as r:
+                body = json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                body = json.loads(e.read())
+            except ValueError:
+                body = {}
+            finally:
+                e.close()
+            if e.code == 503 and body.get("draining"):
+                b.probe_draining()
+            else:
+                b.report_failure(self.retry_policy, self._rng, down_after)
+            return
+        except (OSError, ValueError):
+            b.report_failure(self.retry_policy, self._rng, down_after)
+            return
+        b.probe_ok(body, self.up_after)
+
+    def _publish_gauges(self) -> None:
+        now = time.monotonic()
+        BACKENDS_UP.set(sum(1 for b in self.backends if b.routable()))
+        BREAKER_OPEN.set(sum(1 for b in self.backends
+                             if b.breaker_open(now)))

@@ -46,6 +46,26 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "token-DFA compiles that ran the vocab walk"),
     "constrain.fsm_compile_ms": (
         HISTOGRAM, "grammar -> token-DFA compile wall time"),
+    # -- gateway (multi-replica routing front door) ----------------------
+    "gateway.added_ms": (
+        HISTOGRAM, "gateway-added latency ahead of the backend "
+                   "(route + connect + request send, failed attempts "
+                   "included)"),
+    "gateway.backends_up": (GAUGE, "backends currently routable (UP)"),
+    "gateway.breaker_open": (
+        GAUGE, "DOWN backends whose circuit breaker is holding probes"),
+    "gateway.rejected": (
+        COUNTER, "requests refused at the gateway (draining / no backend "
+                 "up)"),
+    "gateway.requests": (COUNTER, "completions requests accepted"),
+    "gateway.retries": (
+        COUNTER, "transparent re-routes after a backend failure or 429"),
+    "gateway.route_prefix_fallback": (
+        COUNTER, "prefix-affinity routes that fell back to p2c"),
+    "gateway.route_prefix_hits": (
+        COUNTER, "requests landed on their prefix-preferred replica"),
+    "gateway.saturated": (
+        COUNTER, "429s propagated because every UP backend was saturated"),
     # -- generator (local single-stream decode) --------------------------
     "generator.decode_ms": (HISTOGRAM, "per-token decode latency"),
     "generator.prefill_ms": (HISTOGRAM, "prompt prefill latency"),
@@ -97,6 +117,13 @@ SERIES: dict[str, tuple[str, str]] = {
 # these patterns verbatim; fnmatch covers literal names that happen to
 # land inside a family.
 DYNAMIC: dict[str, tuple[str, str]] = {
+    "gateway.*.errors": (
+        COUNTER, "per-backend proxy failures (connect / 5xx / stream)"),
+    "gateway.*.requests": (COUNTER, "per-backend routed requests"),
+    "gateway.*.retries": (
+        COUNTER, "per-backend requests re-routed away after a failure"),
+    "gateway.*.state": (
+        GAUGE, "per-backend health state (2 UP / 1 DRAINING / 0 DOWN)"),
     "master.segment*.decode_ms": (
         HISTOGRAM, "per-segment steady-state forward time"),
     "master.segment*.warmup_ms": (

@@ -253,12 +253,16 @@ def _make_handler(server: ApiServer):
             if path == "/healthz":
                 st = scheduler.stats()
                 # a draining server must fail the probe at the STATUS
-                # level: balancers route on the code, not the body
+                # level: balancers route on the code, not the body. The
+                # body carries the cheap load fields the gateway's p2c
+                # signal reads — one GET, not a /metrics scrape.
                 self._json(200 if not st["draining"] else 503, {
                     "ok": not st["draining"],
                     "draining": st["draining"],
                     "queued": st["queued"],
                     "running": st["running"],
+                    "max_concurrent": st["max_concurrent"],
+                    "tok_s_ema": st["observed_tok_s"],
                 })
             elif path == "/v1/models":
                 eng = scheduler.engine

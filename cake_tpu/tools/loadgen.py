@@ -16,9 +16,17 @@ the end-to-end proof that grammar-constrained decoding produced valid
 JSON through the whole HTTP plane. Needs a server-side tokenizer.
 Invalid responses land in ``json_invalid`` (nonzero exit).
 
+``--retry-429`` makes a 429 honor its ``Retry-After`` and resubmit
+(bounded) instead of counting a hard rejection — the realistic open-loop
+client against a saturated server or gateway. ``--spawn-backends N``
+(ISSUE 10) spawns N tiny in-process serve replicas plus a routing
+gateway (``cake_tpu/gateway``) and drives the gateway, so one command
+smokes the whole loopback fleet.
+
 Prints TTFT / TPOT / end-to-end percentiles and aggregate token
-throughput; used by ``make serve-smoke`` / ``make constrain-smoke`` and
-the ``CAKE_BENCH_SERVE=1`` / ``CAKE_BENCH_CONSTRAIN=1`` bench rows.
+throughput; used by ``make serve-smoke`` / ``make constrain-smoke`` /
+``make gateway-smoke`` and the ``CAKE_BENCH_SERVE=1`` /
+``CAKE_BENCH_CONSTRAIN=1`` / ``CAKE_BENCH_GATEWAY=1`` bench rows.
 
 Usage:
   python -m cake_tpu.tools.loadgen http://127.0.0.1:8080 \\
@@ -143,11 +151,15 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
              prompt_lens: list[int] | None = None, vocab: int = 256,
              rate: float | None = None, seed: int = 0,
              prompts: list[str] | None = None, stream: bool = True,
-             timeout: float = 300.0, workload: str = "text") -> dict:
+             timeout: float = 300.0, workload: str = "text",
+             retry_429: bool = False) -> dict:
     """Run the load; returns aggregate stats (also the in-process entry
     the bench row and tests use). ``workload="json"`` attaches the
     schema constraint to every request and json-validates every
-    response's text."""
+    response's text. ``retry_429`` makes a 429 response honor its
+    ``Retry-After`` and resubmit (bounded) instead of counting a hard
+    rejection — the honest open-loop behavior against a saturated
+    server or gateway (a real client backs off; it does not give up)."""
     if workload not in ("text", "json"):
         raise ValueError(f"workload must be 'text' or 'json', "
                          f"got {workload!r}")
@@ -160,7 +172,19 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         if workload == "json":
             body["response_format"] = {"type": "json_schema",
                                        "schema": JSON_WORKLOAD_SCHEMA}
-        results[i] = _one_request(url, body, timeout)
+        r = _one_request(url, body, timeout)
+        tries = 0
+        while retry_429 and r.get("status") == 429 and tries < 8:
+            try:
+                delay = float(r.get("retry_after") or 1.0)
+            except ValueError:
+                delay = 1.0
+            time.sleep(min(max(delay, 0.0), 30.0))
+            tries += 1
+            r = _one_request(url, body, timeout)
+        if tries:
+            r["retries_429"] = tries
+        results[i] = r
 
     if rate:
         # open loop: Poisson arrivals, one thread per in-flight request
@@ -217,6 +241,8 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         "requests": n,
         "completed": len(done),
         "rejected_429": len(rejected),
+        "retried_429": sum(r.get("retries_429", 0)
+                           for r in results if r),
         "errors": len(errors),
         "json_invalid": json_invalid,
         "wall_s": round(wall, 3),
@@ -234,12 +260,61 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     }
 
 
+def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
+                policy: str = "p2c"):
+    """Smoke support for the gateway plane: build ``n`` tiny
+    random-weight serve replicas IN PROCESS plus a routing gateway in
+    front, so one command (``--spawn-backends N``) drives a whole
+    loopback fleet with zero setup. Returns ``(gateway, cleanup)`` —
+    call ``cleanup()`` when done. Deliberately heavyweight imports live
+    here, not at module top: plain loadgen against a remote URL stays
+    stdlib-only."""
+    import jax
+
+    from cake_tpu.gateway.api import start_gateway
+    from cake_tpu.gateway.health import Backend, HealthMonitor
+    from cake_tpu.gateway.policy import make_policy
+    from cake_tpu.models import llama
+    from cake_tpu.models.config import tiny
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+
+    cfg = tiny(max_seq_len=128, eos_token_id=-1)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    stacks = []
+    for _ in range(n):
+        gen = BatchGenerator(
+            cfg, params,
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.0))
+        sched = Scheduler(gen, queue_depth=queue_depth)
+        sched.start(max_concurrent=max_concurrent, warm_prompt_len=8)
+        stacks.append((start_api_server(sched), sched))
+    backends = [Backend(f"b{i}", f"127.0.0.1:{srv.port}")
+                for i, (srv, _) in enumerate(stacks)]
+    monitor = HealthMonitor(backends, probe_interval=0.5).start()
+    gateway = start_gateway(monitor, make_policy(policy))
+
+    def cleanup() -> None:
+        gateway.close()
+        monitor.stop()
+        for srv, sched in stacks:
+            srv.close()
+            sched.close()
+
+    return gateway, cleanup
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="cake-loadgen",
         description="closed/open-loop HTTP load generator for --mode serve",
     )
-    p.add_argument("url", help="server base URL, e.g. http://127.0.0.1:8080")
+    p.add_argument("url", nargs="?", default=None,
+                   help="server base URL, e.g. http://127.0.0.1:8080 "
+                        "(omitted with --spawn-backends: the spawned "
+                        "gateway is driven instead)")
     p.add_argument("-n", "--requests", type=int, default=16)
     p.add_argument("-c", "--concurrency", type=int, default=4,
                    help="closed-loop client count (ignored with --rate)")
@@ -261,17 +336,39 @@ def main(argv=None) -> int:
                    help="json: schema-constrained requests "
                         "(response_format json_schema), responses "
                         "asserted json.loads-parseable")
+    p.add_argument("--retry-429", action="store_true", dest="retry_429",
+                   help="honor Retry-After on a 429 and resubmit "
+                        "(bounded) instead of counting a hard rejection "
+                        "— the honest open-loop client behavior")
+    p.add_argument("--spawn-backends", type=int, default=None,
+                   dest="spawn_backends", metavar="N",
+                   help="smoke mode: spawn N tiny in-process serve "
+                        "replicas plus a routing gateway and drive the "
+                        "gateway (no url needed) — one command exercises "
+                        "the whole loopback fleet")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=300.0)
     args = p.parse_args(argv)
+    if args.spawn_backends is not None and args.spawn_backends < 1:
+        p.error("--spawn-backends must be >= 1")
+    if args.url is None and args.spawn_backends is None:
+        p.error("a server url is required (or --spawn-backends N)")
     lens = [int(x) for x in args.prompt_len.split(",") if x.strip()]
-    stats = run_load(
-        args.url, args.requests, concurrency=args.concurrency,
-        max_tokens=args.max_tokens, prompt_lens=lens, vocab=args.vocab,
-        rate=args.rate, seed=args.seed, prompts=args.prompt,
-        stream=not args.no_stream, timeout=args.timeout,
-        workload=args.workload,
-    )
+    url, cleanup = args.url, None
+    if args.spawn_backends:
+        gateway, cleanup = spawn_fleet(args.spawn_backends)
+        url = args.url or f"http://127.0.0.1:{gateway.port}"
+    try:
+        stats = run_load(
+            url, args.requests, concurrency=args.concurrency,
+            max_tokens=args.max_tokens, prompt_lens=lens, vocab=args.vocab,
+            rate=args.rate, seed=args.seed, prompts=args.prompt,
+            stream=not args.no_stream, timeout=args.timeout,
+            workload=args.workload, retry_429=args.retry_429,
+        )
+    finally:
+        if cleanup is not None:
+            cleanup()
     stats = dict(stats)
     stats.pop("results")
     print(json.dumps(stats, indent=1))

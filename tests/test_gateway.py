@@ -1,0 +1,921 @@
+"""The multi-replica routing gateway (cake_tpu/gateway).
+
+`make gateway-smoke` acceptance: a 3-backend loopback fleet where SSE
+streams through the gateway are bit-identical to a direct connection; a
+backend killed mid-fleet has its traffic transparently retried onto the
+survivors while the circuit breaker opens; prefix-affinity routing lands
+same-prefix requests on one replica and measurably raises that replica's
+engine prefix-store hits where round_robin's interleaving thrashes them;
+a draining backend is routed around with zero client-visible 5xx; plus
+policy/health unit coverage and the loadgen --retry-429 /
+--spawn-backends smoke.
+"""
+
+import http.server
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from cake_tpu.gateway import policy as policy_mod
+from cake_tpu.gateway.api import GatewayServer, parse_backends, start_gateway
+from cake_tpu.gateway.health import (DOWN, DRAINING, UP, Backend,
+                                     HealthMonitor)
+from cake_tpu.gateway.policy import P2C, Prefix, RoundRobin, make_policy
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.batch_generator import BatchGenerator
+from cake_tpu.runtime.retry import RetryPolicy
+from cake_tpu.serve.api import start_api_server
+from cake_tpu.serve.scheduler import Scheduler
+
+# eos disabled: deterministic stream lengths (the test_serve convention)
+CFG = tiny(max_seq_len=64, eos_token_id=-1)
+GREEDY = dict(temperature=0.0, repeat_penalty=1.1)
+
+# unique per-test-session backend names so per-backend metric series
+# never collide between monitors built by different tests
+_NAME_SEQ = iter(range(10_000))
+
+
+def _backend(addr: str) -> Backend:
+    return Backend(f"t{next(_NAME_SEQ)}", addr)
+
+
+def _monitor(addrs, **kw) -> HealthMonitor:
+    kw.setdefault("probe_interval", 0.2)
+    kw.setdefault("up_after", 1)
+    return HealthMonitor([_backend(a) for a in addrs], **kw)
+
+
+class _FakeTok:
+    """id -> letter (alnum decodes, so the detok emits text per token)."""
+
+    def decode(self, ids):
+        return "".join(chr(ord("a") + (i % 26)) for i in ids)
+
+    def encode(self, text):
+        return [ord(c) - ord("a") for c in text]
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _get(url: str, timeout: float = 30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post(url: str, body: dict, timeout: float = 120.0):
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _post_sse(url: str, body: dict, timeout: float = 120.0):
+    """Stream one request; returns (parsed events, raw data-line bytes)."""
+    body = dict(body, stream=True)
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    events, raw_lines = [], []
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        assert r.headers["Content-Type"] == "text/event-stream"
+        for raw in r:
+            raw = raw.strip()
+            if not raw.startswith(b"data: "):
+                continue
+            raw_lines.append(raw)
+            data = raw[len(b"data: "):]
+            events.append(data.decode() if data == b"[DONE]"
+                          else json.loads(data))
+    return events, raw_lines
+
+
+def _ids_of(events):
+    return [e["token"] for e in events
+            if isinstance(e, dict) and "token" in e]
+
+
+def _done_of(events):
+    done = [e for e in events if isinstance(e, dict) and e.get("done")]
+    assert len(done) == 1, f"expected one terminal event, got {events}"
+    return done[0]
+
+
+# -- scripted stand-in replicas (failure paths without engine weight) -------
+
+
+class _StubBackend:
+    """Scripted serve-replica stand-in: real /healthz + /v1/completions
+    shapes, behavior set by ``mode`` — ok | error500 | reject429 |
+    draining | flaky429 (429 once, then ok)."""
+
+    def __init__(self, mode: str = "ok", tokens: int = 4,
+                 token_delay_s: float = 0.0, unary_delay_s: float = 0.0,
+                 queued: int = 0, running: int = 0,
+                 max_concurrent: int = 4, retry_after: str = "3"):
+        self.mode = mode
+        self.tokens = tokens
+        self.token_delay_s = token_delay_s
+        self.unary_delay_s = unary_delay_s
+        self.load = {"queued": queued, "running": running,
+                     "max_concurrent": max_concurrent, "tok_s_ema": 50.0}
+        self.retry_after = retry_after
+        self.completions = 0
+        self.rejects = 0
+        self._lock = threading.Lock()
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, status, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                path = self.path.rstrip("/") or "/"
+                if path == "/healthz":
+                    if stub.mode == "draining":
+                        self._json(503, {"ok": False, "draining": True})
+                    else:
+                        self._json(200, dict(stub.load, ok=True,
+                                             draining=False))
+                elif path == "/v1/models":
+                    self._json(200, {"object": "list",
+                                     "data": [{"id": "stub"}]})
+                else:
+                    self._json(404, {"error": "no route"})
+
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                mode = stub.mode
+                if mode == "flaky429":
+                    with stub._lock:
+                        first = stub.rejects == 0
+                        if first:
+                            stub.rejects += 1
+                    mode = "reject429" if first else "ok"
+                if mode == "error500":
+                    self._json(500, {"error": "stub exploded"})
+                    return
+                if mode == "reject429":
+                    with stub._lock:
+                        stub.rejects += 1
+                    self._json(429, {"error": "stub saturated"},
+                               headers={"Retry-After": stub.retry_after})
+                    return
+                if mode == "draining":
+                    self._json(503, {"error": "server is draining"})
+                    return
+                with stub._lock:
+                    stub.completions += 1
+                n = min(int(body.get("max_tokens", 16)), stub.tokens)
+                ids = list(range(7, 7 + n))
+                if not body.get("stream"):
+                    if stub.unary_delay_s:
+                        time.sleep(stub.unary_delay_s)  # "generation"
+                    self._json(200, {
+                        "id": "stub", "finish_reason": "length",
+                        "usage": {"prompt_tokens": 1,
+                                  "completion_tokens": n,
+                                  "total_tokens": 1 + n},
+                        "token_ids": ids})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                for i, t in enumerate(ids):
+                    if stub.token_delay_s:
+                        time.sleep(stub.token_delay_s)
+                    self.wfile.write(
+                        f"data: {json.dumps({'index': i, 'token': t, 'text': None})}\n\n".encode())
+                    self.wfile.flush()
+                done = {"id": "stub", "done": True,
+                        "finish_reason": "length",
+                        "usage": {"completion_tokens": n}}
+                self.wfile.write(f"data: {json.dumps(done)}\n\n".encode())
+                self.wfile.write(b"data: [DONE]\n\n")
+                self.wfile.flush()
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                     Handler)
+        self.port = self.httpd.server_address[1]
+        self.addr = f"127.0.0.1:{self.port}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stub_gateway():
+    """Factory: gateway + monitor over a list of stub/real addresses;
+    everything torn down at test end."""
+    created = []
+
+    def build(addrs, policy="round_robin", initial_probe=True,
+              **monitor_kw):
+        mon = _monitor(addrs, **monitor_kw)
+        mon.start(initial_probe=initial_probe)
+        gw = start_gateway(mon, make_policy(policy, prefix_block=8),
+                           connect_timeout=1.0, read_timeout=60.0)
+        created.append((gw, mon))
+        return gw, mon
+
+    yield build
+    for gw, mon in created:
+        gw.close()
+        mon.stop()
+
+
+def _url(gw) -> str:
+    return f"http://127.0.0.1:{gw.port}"
+
+
+def _dead_addr() -> str:
+    """An address nothing listens on (bind, grab the port, close)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    return f"127.0.0.1:{port}"
+
+
+# -- policy units -----------------------------------------------------------
+
+
+def test_round_robin_cycles():
+    bs = [_backend(f"127.0.0.1:{9000 + i}") for i in range(3)]
+    rr = RoundRobin()
+    picks = [rr.choose(bs).name for _ in range(6)]
+    assert picks == [b.name for b in bs] * 2
+
+
+def test_p2c_prefers_lower_load():
+    idle, busy = _backend("127.0.0.1:9000"), _backend("127.0.0.1:9001")
+    busy.probe_ok({"queued": 5, "running": 4, "max_concurrent": 4}, 1)
+    idle.probe_ok({"queued": 0, "running": 1, "max_concurrent": 4}, 1)
+    p2c = P2C()
+    picks = {p2c.choose([idle, busy]).name for _ in range(16)}
+    assert picks == {idle.name}  # two choices always include both here
+
+
+def test_prefix_is_sticky_and_falls_back_when_saturated():
+    bs = [_backend(f"127.0.0.1:{9100 + i}") for i in range(3)]
+    pol = Prefix(block=8)
+    key = policy_mod.prefix_key({"prompt": "system prompt here!"}, 8)
+    assert key is not None
+    first = pol.choose(bs, key=key)
+    for _ in range(8):  # deterministic: same key -> same replica
+        assert pol.choose(bs, key=key) is first
+    # a different candidate ORDER must not move the key (rendezvous)
+    assert pol.choose(list(reversed(bs)), key=key) is first
+    # saturated preferred -> p2c over the rest
+    first.probe_ok({"queued": 3, "running": 4, "max_concurrent": 4}, 1)
+    fallback = pol.choose(bs, key=key)
+    assert fallback is not first
+    # no key (short prompt) -> p2c, not a crash
+    assert pol.choose(bs, key=None) in bs
+
+
+def test_prefix_key_alignment():
+    assert policy_mod.prefix_key({"prompt_ids": list(range(16))}, 8) \
+        == policy_mod.prefix_key({"prompt_ids": list(range(8)) + [99] * 8},
+                                 8)
+    assert policy_mod.prefix_key({"prompt_ids": [1, 2, 3]}, 8) is None
+    assert policy_mod.prefix_key({"prompt": "ab"}, 8) is None
+    assert policy_mod.prefix_key({}, 8) is None
+    k1 = policy_mod.prefix_key({"prompt": "abcdefgh-SUFFIX1"}, 8)
+    k2 = policy_mod.prefix_key({"prompt": "abcdefgh-SUFFIX2"}, 8)
+    assert k1 == k2 is not None
+
+
+def test_prefix_counters_score_first_choice_only():
+    """Review regression: a retry lands on the rendezvous runner-up
+    because the true preferred replica was already excluded — that must
+    not read as an affinity hit (or fallback) in the routing-decision
+    counters."""
+    bs = [_backend(f"127.0.0.1:{9150 + i}") for i in range(3)]
+    pol = Prefix(block=8)
+    key = policy_mod.prefix_key({"prompt": "x" * 8}, 8)
+    hits0 = policy_mod.PREFIX_HITS.value
+    fb0 = policy_mod.PREFIX_FALLBACK.value
+    pol.choose(bs[:2], key=key, first_attempt=False)  # the retry path
+    assert policy_mod.PREFIX_HITS.value == hits0
+    assert policy_mod.PREFIX_FALLBACK.value == fb0
+    pol.choose(bs, key=key)  # the first attempt still scores
+    assert (policy_mod.PREFIX_HITS.value
+            + policy_mod.PREFIX_FALLBACK.value) == hits0 + fb0 + 1
+
+
+def test_parse_backends_validation():
+    bs = parse_backends("127.0.0.1:8081, 127.0.0.1:8082")
+    assert [b.port for b in bs] == [8081, 8082]
+    assert [b.name for b in bs] == ["b0", "b1"]
+    with pytest.raises(ValueError):
+        parse_backends("127.0.0.1:8081,127.0.0.1:8081")  # duplicate
+    with pytest.raises(ValueError):
+        parse_backends("no-port")
+    with pytest.raises(ValueError):
+        parse_backends("")
+
+
+# -- health state machine units ---------------------------------------------
+
+
+def test_backend_down_after_failures_and_breaker_backoff():
+    import random as random_mod
+
+    b = _backend("127.0.0.1:9200")
+    pol = RetryPolicy(deadline_s=None, max_attempts=1 << 30, base_s=0.5,
+                      cap_s=2.0)
+    rng = random_mod.Random(7)
+    b.report_failure(pol, rng, down_after=2, now=100.0)
+    assert b.state == UP  # hysteresis: one failure is not an outage
+    b.report_failure(pol, rng, down_after=2, now=100.1)
+    assert b.state == DOWN
+    assert not b.routable()
+    # breaker: the next probe is backed off into the future
+    assert not b.probe_due(100.1)
+    assert b.breaker_open(100.1)
+    assert b.probe_due(200.0)
+    # hysteresis up: up_after=2 needs two clean probes
+    b.probe_ok({"queued": 0}, up_after=2)
+    assert b.state == DOWN
+    b.probe_ok({"queued": 0}, up_after=2)
+    assert b.state == UP
+    assert not b.breaker_open(200.0)
+
+
+def test_backend_draining_is_immediate_both_ways():
+    b = _backend("127.0.0.1:9201")
+    b.probe_draining()
+    assert b.state == DRAINING and not b.routable()
+    b.probe_ok({}, up_after=3)  # the backend said it is back: no waiting
+    assert b.state == UP
+
+
+def test_backend_saturation_signal():
+    b = _backend("127.0.0.1:9202")
+    assert not b.saturated(now=10.0)
+    b.probe_ok({"queued": 2, "running": 4, "max_concurrent": 4}, 1)
+    assert b.saturated(now=10.0)
+    b.probe_ok({"queued": 0, "running": 1, "max_concurrent": 4}, 1)
+    assert not b.saturated(now=10.0)
+    b.report_saturated(5.0, now=10.0)  # a 429 said so, believe it a while
+    assert b.saturated(now=12.0)
+    assert not b.saturated(now=16.0)
+
+
+def test_initial_probe_is_decisive():
+    """Review regression: the bootstrap probe pass collapses the DOWN
+    hysteresis — a backend refusing its very FIRST probe has no history
+    to flap against, so the gateway must not start routing toward it on
+    pure optimism (down_after only buffers established backends)."""
+    mon = _monitor([_dead_addr()], down_after=2, probe_interval=30.0)
+    mon.start()
+    try:
+        assert mon.backends[0].state == DOWN
+        assert mon.routable() == []
+    finally:
+        mon.stop()
+
+
+def test_server_prefix_block_follows_policy():
+    """Review regression: the affinity alignment has ONE source of truth
+    — a Prefix policy's block wins over the server-level default, so the
+    key is always computed at the block the policy hashes with."""
+    mon = _monitor([_dead_addr()])  # never started: no probes needed
+    gw = GatewayServer(mon, make_policy("prefix", prefix_block=8))
+    try:
+        assert gw.prefix_block == 8
+        gw2 = GatewayServer(mon, make_policy("p2c"), prefix_block=16)
+        try:
+            assert gw2.prefix_block == 16
+        finally:
+            gw2.httpd.server_close()
+    finally:
+        gw.httpd.server_close()
+
+
+def test_monitor_probes_mark_states(stub_gateway):
+    ok, draining = _StubBackend("ok"), _StubBackend("draining")
+    dead = _dead_addr()
+    try:
+        _, mon = stub_gateway([ok.addr, draining.addr, dead],
+                              down_after=1)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            states = [b.state for b in mon.backends]
+            if states == [UP, DRAINING, DOWN]:
+                break
+            time.sleep(0.05)
+        assert [b.state for b in mon.backends] == [UP, DRAINING, DOWN]
+        # the load signal rode the same healthz GET
+        assert mon.backends[0].describe()["load"]["max_concurrent"] == 4
+    finally:
+        ok.close()
+        draining.close()
+
+
+# -- proxy semantics over stubs ---------------------------------------------
+
+
+def test_connect_failure_retries_to_survivor_and_opens_breaker(
+        stub_gateway):
+    from cake_tpu.gateway import api as gw_api
+
+    ok = _StubBackend("ok")
+    try:
+        # initial_probe=False: the backend "dies" after a clean start, so
+        # the first failure the gateway sees is the routed request itself
+        # — the passive-signal path under test
+        gw, mon = stub_gateway([_dead_addr(), ok.addr],
+                               policy="round_robin", down_after=2,
+                               probe_interval=30.0, initial_probe=False)
+        retries0 = gw_api.RETRIES.value
+        for i in range(4):  # round robin keeps picking the dead one first
+            out = _post(_url(gw), {"prompt_ids": [1, 2], "max_tokens": 3})
+            assert out["usage"]["completion_tokens"] == 3
+        assert gw_api.RETRIES.value > retries0
+        dead_b = mon.backends[0]
+        assert dead_b.state == DOWN  # passive failures tripped the breaker
+        assert dead_b.breaker_open()
+        assert ok.completions == 4
+    finally:
+        ok.close()
+
+
+def test_5xx_before_first_byte_retries_transparently(stub_gateway):
+    bad, good = _StubBackend("error500"), _StubBackend("ok")
+    try:
+        gw, mon = stub_gateway([bad.addr, good.addr],
+                               policy="round_robin", down_after=3,
+                               probe_interval=30.0)
+        events, _ = _post_sse(_url(gw),
+                              {"prompt_ids": [1], "max_tokens": 4})
+        assert _ids_of(events) == [7, 8, 9, 10]
+        assert _done_of(events)["finish_reason"] == "length"
+        assert mon.backends[0].describe()["errors"] >= 1
+    finally:
+        bad.close()
+        good.close()
+
+
+def test_429_propagates_only_when_every_backend_saturated(stub_gateway):
+    from cake_tpu.gateway import api as gw_api
+
+    sat1 = _StubBackend("reject429", retry_after="7")
+    sat2 = _StubBackend("reject429", retry_after="7")
+    ok = _StubBackend("ok")
+    try:
+        # one healthy replica: the client must never see the 429
+        gw, _ = stub_gateway([sat1.addr, sat2.addr, ok.addr],
+                             policy="round_robin", probe_interval=30.0)
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+        assert out["usage"]["completion_tokens"] == 2
+
+        # every replica saturated: NOW the 429 (and its Retry-After)
+        # reaches the client
+        sat0 = gw_api.SATURATED.value
+        gw2, _ = stub_gateway([sat1.addr, sat2.addr],
+                              policy="round_robin", probe_interval=30.0)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(_url(gw2), {"prompt_ids": [1], "max_tokens": 2})
+        assert exc.value.code == 429
+        assert exc.value.headers["Retry-After"] == "7"
+        assert gw_api.SATURATED.value > sat0
+    finally:
+        sat1.close()
+        sat2.close()
+        ok.close()
+
+
+def test_draining_backend_routed_around_with_zero_5xx(stub_gateway):
+    draining = _StubBackend("draining")
+    ok1, ok2 = _StubBackend("ok"), _StubBackend("ok")
+    try:
+        gw, mon = stub_gateway([draining.addr, ok1.addr, ok2.addr],
+                               policy="round_robin")
+        deadline = time.time() + 10
+        while (time.time() < deadline
+               and mon.backends[0].state != DRAINING):
+            time.sleep(0.05)
+        assert mon.backends[0].state == DRAINING
+        for i in range(6):  # zero 5xx: every request lands on a survivor
+            out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+            assert out["usage"]["completion_tokens"] == 2
+        assert draining.completions == 0
+        assert ok1.completions + ok2.completions == 6
+    finally:
+        draining.close()
+        ok1.close()
+        ok2.close()
+
+
+def test_draining_backend_503_is_retried_even_before_probe(stub_gateway):
+    """A replica that starts draining BETWEEN probes: its 503 is a
+    passive signal — the request retries elsewhere and the state flips
+    without waiting for the next poll."""
+    draining, ok = _StubBackend("draining"), _StubBackend("ok")
+    try:
+        gw, mon = stub_gateway([draining.addr, ok.addr],
+                               policy="round_robin", probe_interval=30.0)
+        # force the draining replica to be picked first at least once
+        for i in range(3):
+            out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+            assert out["usage"]["completion_tokens"] == 2
+        assert mon.backends[0].state == DRAINING
+        assert draining.completions == 0
+    finally:
+        draining.close()
+        ok.close()
+
+
+def test_gateway_healthz_models_status_metrics(stub_gateway):
+    ok = _StubBackend("ok")
+    try:
+        gw, _ = stub_gateway([ok.addr])
+        health = _get(_url(gw) + "/healthz")
+        assert health["ok"] is True and health["backends_up"] == 1
+        assert list(health["backends"].values()) == [UP]
+        models = _get(_url(gw) + "/v1/models")
+        assert models["data"][0]["id"] == "stub"
+        status = _get(_url(gw) + "/")
+        assert status["role"] == "gateway"
+        assert status["backends"][0]["state"] == UP
+        text = urllib.request.urlopen(
+            _url(gw) + "/metrics", timeout=10).read().decode()
+        for series in ("cake_gateway_requests", "cake_gateway_retries",
+                       "cake_gateway_backends_up", "cake_gateway_added_ms"):
+            assert series in text, f"{series} missing from /metrics"
+    finally:
+        ok.close()
+
+
+def test_added_ms_excludes_backend_generation_time(stub_gateway):
+    """Review regression: gateway.added_ms is the gateway's OWN overhead
+    (route + connect + request send). A unary backend that takes 1s to
+    generate must not push a ~1000 ms sample into the histogram — the
+    response wait is the backend working, not the gateway adding."""
+    from cake_tpu.gateway import api as gw_api
+
+    slow = _StubBackend("ok", unary_delay_s=1.0)
+    try:
+        gw, _ = stub_gateway([slow.addr], probe_interval=30.0)
+        before = gw_api.ADDED_MS.snapshot()
+        t0 = time.perf_counter()
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        assert out["usage"]["completion_tokens"] == 2
+        after = gw_api.ADDED_MS.snapshot()
+        assert after["count"] == before["count"] + 1
+        sample_ms = after["sum"] - before["sum"]
+        assert wall_ms >= 1000  # the backend really did take ~1s
+        assert sample_ms < 500, (
+            f"added_ms recorded {sample_ms:.0f} ms — it is counting the "
+            "backend's generation time")
+    finally:
+        slow.close()
+
+
+def test_gateway_healthz_503_when_no_backend_up(stub_gateway):
+    gw, _ = stub_gateway([_dead_addr()], down_after=1)
+    deadline = time.time() + 10
+    code = None
+    while time.time() < deadline and code != 503:
+        try:
+            _get(_url(gw) + "/healthz")
+        except urllib.error.HTTPError as e:
+            code = e.code
+        time.sleep(0.05)
+    assert code == 503
+    # and a routed request is refused, not hung
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+    assert exc.value.code == 503
+
+
+def test_gateway_drain_finishes_inflight_and_refuses_new():
+    slow = _StubBackend("ok", tokens=20, token_delay_s=0.05)
+    mon = _monitor([slow.addr])
+    mon.start()
+    gw = start_gateway(mon, make_policy("round_robin"))
+    try:
+        result: dict = {}
+
+        def client():
+            ev, _ = _post_sse(f"http://127.0.0.1:{gw.port}",
+                              {"prompt_ids": [1], "max_tokens": 20})
+            result["events"] = ev
+
+        t = threading.Thread(target=client)
+        t.start()
+        deadline = time.time() + 10  # wait for the stream to be in flight
+        while time.time() < deadline and slow.completions == 0:
+            time.sleep(0.02)
+        assert slow.completions == 1
+        drainer = threading.Thread(target=lambda: gw.drain(timeout_s=30))
+        drainer.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not gw.is_draining():
+            time.sleep(0.01)
+        from cake_tpu.gateway import api as gw_api
+
+        req0, rej0 = gw_api.REQUESTS.value, gw_api.REJECTED.value
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(f"http://127.0.0.1:{gw.port}",
+                  {"prompt_ids": [2], "max_tokens": 2}, timeout=10)
+        assert exc.value.code == 503  # refused while draining
+        # review regression: a drain-refused request is rejected only —
+        # gateway.requests counts ACCEPTED requests
+        assert gw_api.REQUESTS.value == req0
+        assert gw_api.REJECTED.value == rej0 + 1
+        t.join(timeout=30)
+        drainer.join(timeout=30)
+        assert len(_ids_of(result["events"])) == 20  # in-flight finished
+    finally:
+        gw.close()
+        mon.stop()
+
+
+# -- the real 3-backend loopback fleet --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(7))
+
+
+@pytest.fixture(scope="module")
+def fleet(params):
+    """Three real serve replicas over the batch engine. Small prefix
+    knobs (share_min=8, block=8, ONE store entry) so prefix-affinity
+    effects are observable within tiny prompts — and so round_robin's
+    interleaving measurably thrashes the store."""
+    stacks = []
+    for _ in range(3):
+        gen = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                             settings=SamplerSettings(**GREEDY),
+                             prefix_share_min=8, prefix_block=8,
+                             prefix_cache_entries=1)
+        sched = Scheduler(gen, queue_depth=8, request_timeout_s=120)
+        sched.start(max_concurrent=2)
+        srv = start_api_server(sched)
+        stacks.append({"srv": srv, "sched": sched, "gen": gen,
+                       "addr": f"127.0.0.1:{srv.port}"})
+    yield stacks
+    for s in stacks:
+        s["srv"].close()
+        s["sched"].close()
+
+
+def test_serve_healthz_carries_load_fields(fleet):
+    """The satellite contract: the gateway's whole p2c signal is one
+    /healthz GET on the serve plane."""
+    health = _get(f"http://{fleet[0]['addr']}/healthz")
+    for field in ("ok", "draining", "queued", "running",
+                  "max_concurrent", "tok_s_ema"):
+        assert field in health, f"/healthz missing {field}"
+    assert health["max_concurrent"] == 2
+
+
+def test_sse_bit_identical_through_gateway(fleet, stub_gateway):
+    """The headline pass-through contract: every token event an SSE
+    client sees through the gateway is byte-identical to a direct
+    connection (the gateway never reframes), and unary responses carry
+    identical ids."""
+    gw, _ = stub_gateway([s["addr"] for s in fleet])
+    body = {"prompt": "abcd", "max_tokens": 8}
+    direct_ev, direct_raw = _post_sse(f"http://{fleet[0]['addr']}", body)
+    gw_ev, gw_raw = _post_sse(_url(gw), body)
+    # token events byte-for-byte (the terminal usage block carries
+    # per-request timing, so it is compared structurally instead)
+    assert [r for r in gw_raw if b'"token"' in r] \
+        == [r for r in direct_raw if b'"token"' in r]
+    assert _ids_of(gw_ev) == _ids_of(direct_ev)
+    d_direct, d_gw = _done_of(direct_ev), _done_of(gw_ev)
+    assert d_gw["finish_reason"] == d_direct["finish_reason"]
+    assert (d_gw["usage"]["completion_tokens"]
+            == d_direct["usage"]["completion_tokens"])
+    assert gw_raw[-1] == b"data: [DONE]"
+    # unary parity
+    direct_out = _post(f"http://{fleet[0]['addr']}", body)
+    gw_out = _post(_url(gw), body)
+    assert gw_out["token_ids"] == direct_out["token_ids"]
+    assert gw_out["text"] == direct_out["text"]
+
+
+def test_concurrent_sse_through_gateway_match_solo(fleet, stub_gateway):
+    """4 concurrent SSE clients through the p2c gateway: every stream
+    matches its solo run (engine batch-composition invariance survives
+    the extra hop and the load-aware scatter)."""
+    gw, _ = stub_gateway([s["addr"] for s in fleet], policy="p2c")
+    prompts = ["abcd", "bcde", "cdef", "defg"]
+    solo = {}
+    for p in prompts:
+        ev, _ = _post_sse(_url(gw), {"prompt": p, "max_tokens": 6})
+        solo[p] = _ids_of(ev)
+        assert len(solo[p]) == 6
+    results: dict = {}
+
+    def client(p):
+        ev, _ = _post_sse(_url(gw), {"prompt": p, "max_tokens": 6})
+        results[p] = _ids_of(ev)
+
+    threads = [threading.Thread(target=client, args=(p,)) for p in prompts]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for p in prompts:
+        assert results[p] == solo[p], f"stream for {p!r} diverged"
+
+
+def _affinity_groups(backends, block=8):
+    """Two 8-char prefix groups that rendezvous-hash to two DIFFERENT
+    backends (searched deterministically so the thrash-vs-hit comparison
+    is meaningful even if one pair collides)."""
+    pol = Prefix(block=block)
+    alphabet = "abcdefghijklmnopqrstuvwxyz"
+    first = alphabet[0] * block
+    pref_first = pol.choose(backends,
+                            key=policy_mod.prefix_key({"prompt": first},
+                                                      block))
+    for c in alphabet[1:]:
+        cand = c * block
+        pref = pol.choose(backends,
+                          key=policy_mod.prefix_key({"prompt": cand},
+                                                    block))
+        if pref is not pref_first:
+            return first, cand
+    raise AssertionError("no distinct-backend prefix pair found")
+
+
+def test_prefix_affinity_raises_prefix_store_hits(fleet, stub_gateway):
+    """The fleet-wide-cache claim: same-prefix requests land on ONE
+    replica under the prefix policy and its engine prefix-store hits
+    climb, while round_robin's interleaving (one store entry per engine)
+    thrashes and hits stay flat."""
+
+    def run_leg(policy):
+        gw, mon = stub_gateway([s["addr"] for s in fleet], policy=policy)
+        hits0 = [s["gen"].stats()["prefix_hits"] for s in fleet]
+        reqs0 = [b.requests.value for b in mon.backends]
+        a, b = _affinity_groups(mon.backends)
+        for i in range(4):  # alternating groups, sequential requests
+            for prefix in (a, b):
+                out = _post(_url(gw),
+                            {"prompt": prefix + "wxyz"[i] * 4,
+                             "max_tokens": 2})
+                assert out["usage"]["completion_tokens"] == 2
+        hits = sum(s["gen"].stats()["prefix_hits"] for s in fleet) \
+            - sum(hits0)
+        reqs = [b.requests.value - r0
+                for b, r0 in zip(mon.backends, reqs0)]
+        return hits, reqs
+
+    rr_hits, _ = run_leg("round_robin")
+    px_hits, px_reqs = run_leg("prefix")
+    # prefix affinity: the 8 requests landed on exactly the two preferred
+    # replicas, 4 each — and the store actually paid off
+    assert sorted(px_reqs) == [0, 4, 4], f"affinity scatter: {px_reqs}"
+    assert px_hits >= 4, f"prefix store never hit: {px_hits}"
+    # round robin interleaves the groups across every 1-entry store:
+    # consecutive same-prefix admissions never meet, hits stay flat
+    assert rr_hits == 0, f"round_robin unexpectedly hit: {rr_hits}"
+    assert px_hits > rr_hits
+
+
+def test_loadgen_retry_429_resubmits():
+    flaky = _StubBackend("flaky429", retry_after="0")
+    try:
+        from cake_tpu.tools import loadgen
+
+        stats = loadgen.run_load(f"http://{flaky.addr}", 3, concurrency=1,
+                                 max_tokens=2, prompt_lens=[2], vocab=50,
+                                 retry_429=True)
+        assert stats["completed"] == 3
+        assert stats["rejected_429"] == 0
+        assert stats["retried_429"] >= 1
+    finally:
+        flaky.close()
+
+
+def test_loadgen_counts_429_without_retry():
+    sat = _StubBackend("reject429")
+    try:
+        from cake_tpu.tools import loadgen
+
+        stats = loadgen.run_load(f"http://{sat.addr}", 2, concurrency=1,
+                                 max_tokens=2, prompt_lens=[2], vocab=50)
+        assert stats["completed"] == 0
+        assert stats["rejected_429"] == 2
+        assert stats["retried_429"] == 0
+    finally:
+        sat.close()
+
+
+def test_loadgen_through_gateway(fleet, stub_gateway):
+    """The loadgen driver against the real fleet through the gateway —
+    the gateway-smoke traffic shape."""
+    from cake_tpu.tools import loadgen
+
+    gw, _ = stub_gateway([s["addr"] for s in fleet], policy="p2c")
+    stats = loadgen.run_load(_url(gw), 6, concurrency=3, max_tokens=4,
+                             prompt_lens=[4, 8], vocab=200, seed=3,
+                             retry_429=True)
+    assert stats["completed"] == 6 and stats["errors"] == 0
+    assert stats["tokens"] == 24
+
+
+def test_gateway_cli_validation():
+    """--mode gateway flag surface: the guards that keep misconfiguration
+    loud (no silent ignores), without starting a server."""
+    from cake_tpu import cli
+
+    with pytest.raises(SystemExit, match="--backends"):
+        cli.main(["--mode", "gateway"])
+    with pytest.raises(SystemExit, match="--model"):
+        cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
+                  "--model", "x"])
+    with pytest.raises(SystemExit, match="--mode gateway"):
+        cli.main(["--model", "x", "--backends", "127.0.0.1:1"])
+    with pytest.raises(SystemExit, match="--max-concurrent"):
+        cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
+                  "--max-concurrent", "4"])
+    with pytest.raises(SystemExit, match="--probe-interval"):
+        cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
+                  "--probe-interval", "0"])
+    with pytest.raises(SystemExit, match="--fetch"):
+        cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
+                  "--fetch", "hf://org/m"])
+    with pytest.raises(SystemExit, match="--model is required"):
+        cli.main(["--mode", "serve"])
+
+
+def test_loadgen_spawn_backends_smoke():
+    """One command drives a whole loopback fleet: --spawn-backends N
+    builds N tiny replicas + a gateway in process and the load runs
+    clean through it."""
+    from cake_tpu.tools import loadgen
+
+    rc = loadgen.main(["--spawn-backends", "2", "-n", "6", "-c", "2",
+                       "--max-tokens", "3", "--prompt-len", "4",
+                       "--retry-429"])
+    assert rc == 0
+
+
+# -- mid-fleet kill: LAST on purpose (it takes a real replica down) ---------
+
+
+def test_kill_backend_mid_fleet_retries_to_survivors(fleet, stub_gateway):
+    """The acceptance chaos case: a replica dies mid-fleet; queued
+    requests transparently retry onto the survivors (zero client-visible
+    failures) while the dead replica's breaker opens."""
+    from cake_tpu.gateway import api as gw_api
+
+    gw, mon = stub_gateway([s["addr"] for s in fleet],
+                           policy="round_robin", down_after=2,
+                           probe_interval=30.0)  # passive-signal path
+    # warm: all three replicas serving through the gateway
+    for i in range(3):
+        out = _post(_url(gw), {"prompt": "abcd", "max_tokens": 2})
+        assert out["usage"]["completion_tokens"] == 2
+    # kill replica 1 (listener down: connects refuse)
+    fleet[1]["srv"].close()
+    retries0 = gw_api.RETRIES.value
+    for i in range(8):  # round robin keeps offering the dead one
+        ev, _ = _post_sse(_url(gw), {"prompt": "bcda", "max_tokens": 3})
+        assert len(_ids_of(ev)) == 3, f"request {i} lost tokens"
+        assert _done_of(ev)["finish_reason"] == "length"
+    assert gw_api.RETRIES.value > retries0
+    dead = mon.backends[1]
+    assert dead.state == DOWN
+    assert dead.breaker_open()
+    # the gateway still reports healthy: survivors carry the fleet
+    health = _get(_url(gw) + "/healthz")
+    assert health["ok"] is True and health["backends_up"] == 2
