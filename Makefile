@@ -139,6 +139,19 @@ gateway-smoke:
 	CAKE_BENCH_GATEWAY=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
 
+# paged-KV smoke: the page-pool layout (cake_tpu/kvpool) — paged-vs-slot
+# bit-identical streams across steady batch, mid-run admission,
+# retire-and-reuse, shared-prefix fan-out (n streams sharing physical
+# prefill pages, prefix_hits >= n-1) and constrained streams; pool/
+# prefix-tree/LRU units incl. eviction under pressure and admission
+# deferral; the no-retrace compile pin — then the CAKE_BENCH_KVPOOL
+# churn row (paged vs slot vs steady, legs interleaved; design target:
+# churn within 25% of steady on the same config).
+kv-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kvpool.py -q -m 'not slow'
+	CAKE_BENCH_KVPOOL=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -149,7 +162,7 @@ gateway-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -168,4 +181,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke perf-smoke deploy clean

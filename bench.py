@@ -40,6 +40,11 @@ Knobs (env):
                      directly vs through a gateway fronting it —
                      gateway tok/s with vs_baseline = gateway/direct
                      plus the TTFT p50 the extra hop adds.
+  CAKE_BENCH_KVPOOL=1 paged-KV churn (cake_tpu/kvpool): churn tok/s on
+                     the paged layout vs the slot layout vs the paged
+                     steady batch, legs interleaved A/B/A/B —
+                     vs_baseline = churn_paged/steady_paged (ROADMAP's
+                     within-25% churn target).
 """
 
 from __future__ import annotations
@@ -893,6 +898,41 @@ def _run_serve_constrain(config, params, preset, quant, dev, batch,
     return 0
 
 
+def _admit_chunk(config) -> int:
+    """Largest divisor of the window <= 512 (admit_chunk must divide
+    max_seq) — shared by both churn rows so the admission-chunk policy
+    cannot diverge between them."""
+    return max(c for c in range(1, min(512, config.max_seq_len) + 1)
+               if config.max_seq_len % c == 0)
+
+
+def _churn_drive(gen, base, batch, steps, stream_len, admits,
+                 next_sid, e0, churn=True) -> int:
+    """The ONE churn-driving loop both churn rows share (`_run_churn`
+    and `_run_kvpool`): retire each stream at ``stream_len`` tokens and
+    enqueue a replacement through the chunked admission path
+    (``churn=False``: plain steady stepping), until the token quota is
+    met or everything drains. Returns the number of admissions made."""
+    admitted = 0
+    for _ in range(steps * 4):
+        gen.step()
+        if churn:
+            for s in gen.streams:
+                if (s.active and not s.done
+                        and len(s.generated) >= stream_len):
+                    s.done = True
+                    if admitted < admits:
+                        gen.enqueue(list(base), next_sid)
+                        next_sid += 1
+                        admitted += 1
+        live = any(s.active and not s.done for s in gen.streams)
+        if not live and gen.pending_admissions() == 0:
+            break
+        if gen.stats()["tokens_emitted"] - e0 >= steps * batch:
+            break
+    return admitted
+
+
 def _run_churn(config, params, preset, quant, dev, batch, steps,
                multistep) -> int:
     """CAKE_BENCH_CHURN=1: serving under arrival churn. Streams that reach
@@ -908,9 +948,7 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     stream_len = int(os.environ.get("CAKE_BENCH_STREAM_LEN", "64"))
     admits = int(os.environ.get("CAKE_BENCH_ADMITS", str(batch)))
     settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
-    # largest divisor of the window <= 512 (admit_chunk must divide it)
-    admit_chunk = max(c for c in range(1, min(512, config.max_seq_len) + 1)
-                      if config.max_seq_len % c == 0)
+    admit_chunk = _admit_chunk(config)
     # Adaptive decode blocks (CAKE_BENCH_BLOCK_MAX, default 4x the base
     # block): the fused block doubles while no arrival waits and snaps
     # back on churn — the diagnosed lever for the r4 churn row's ~1.5 s
@@ -933,26 +971,11 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
     # outside the timed window
     gen.warm_admission(len(base))
     gen.warm_blocks()
-    next_sid = batch
     t0 = time.perf_counter()
     e0 = gen.stats()["tokens_emitted"]
     b0 = gen.stats()["busy_s"]  # exclude warm-up/compile busy time
-    admitted = 0
-    max_steps = steps * 4
-    for _ in range(max_steps):
-        gen.step()
-        for s in gen.streams:
-            if s.active and not s.done and len(s.generated) >= stream_len:
-                s.done = True
-                if admitted < admits:
-                    gen.enqueue(list(base), next_sid)
-                    next_sid += 1
-                    admitted += 1
-        live = any(s.active and not s.done for s in gen.streams)
-        if not live and gen.pending_admissions() == 0:
-            break
-        if gen.stats()["tokens_emitted"] - e0 >= steps * batch:
-            break
+    admitted = _churn_drive(gen, base, batch, steps, stream_len, admits,
+                            next_sid=batch, e0=e0)
     # measurement boundary: tokens the device already computed (buffered
     # rows + any in-flight lookahead block) are emitted and counted — the
     # final sync pays their wall-clock either way, so dropping them would
@@ -982,6 +1005,84 @@ def _run_churn(config, params, preset, quant, dev, batch, steps,
         f"{st['admit_dispatches']}a tokens/dispatch="
         f"{st['tokens_per_dispatch']} busy_s={st['busy_s'] - b0:.3f} "
         f"timed_s={dt:.3f}\n"
+    )
+    return 0
+
+
+def _run_kvpool(config, params, preset, quant, dev, batch, steps,
+                multistep) -> int:
+    """CAKE_BENCH_KVPOOL=1: churn throughput, paged vs slot KV layout
+    (cake_tpu/kvpool), plus the paged layout's own steady-batch row on
+    the same config. Three legs per rep — steady/paged, churn/paged,
+    churn/slot — INTERLEAVED across two reps (A/B/A/B) so warmup and
+    EMA drift can't flatter one layout (the gateway row's lesson: a
+    sequential comparison measured ordering bias bigger than the effect).
+    Figures of merit: churn_paged/steady_paged (ROADMAP's within-25%
+    target — admission/retirement as page-table edits instead of cache
+    splices) and churn_paged/churn_slot."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+
+    kv_quant = _kv_quant()
+    stream_len = int(os.environ.get("CAKE_BENCH_STREAM_LEN", "64"))
+    admits = int(os.environ.get("CAKE_BENCH_ADMITS", str(batch)))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    admit_chunk = _admit_chunk(config)
+    block_max = int(os.environ.get("CAKE_BENCH_BLOCK_MAX",
+                                   str(4 * multistep)))
+    base = [5, 9, 2, 4, 8, 1, 3, 7]
+
+    def build(layout):
+        gen = BatchGenerator(config, params, settings=settings,
+                             block_size=multistep, block_size_max=block_max,
+                             kv_quant=kv_quant, admit_chunk=admit_chunk,
+                             kv_layout=layout)
+        gen.set_prompts([list(base) for _ in range(batch)])
+        for _ in range(3):
+            gen.step()
+        gen.warm_admission(len(base))
+        gen.warm_blocks()
+        return gen
+
+    def leg(layout, churn):
+        gen = build(layout)
+        t0 = time.perf_counter()
+        e0 = gen.stats()["tokens_emitted"]
+        _churn_drive(gen, base, batch, steps, stream_len, admits,
+                     next_sid=batch, e0=e0, churn=churn)
+        gen.drain()
+        _sync(gen._last_tokens)
+        dt = time.perf_counter() - t0
+        return (gen.stats()["tokens_emitted"] - e0) / dt
+
+    acc = {"steady_paged": [], "churn_paged": [], "churn_slot": []}
+    for _ in range(2):  # interleaved reps: no leg owns the warm tail
+        acc["steady_paged"].append(leg("paged", churn=False))
+        acc["churn_paged"].append(leg("paged", churn=True))
+        acc["churn_slot"].append(leg("slot", churn=True))
+    mean = {k: sum(v) / len(v) for k, v in acc.items()}
+    ratio_steady = (mean["churn_paged"] / mean["steady_paged"]
+                    if mean["steady_paged"] else 0.0)
+    ratio_slot = (mean["churn_paged"] / mean["churn_slot"]
+                  if mean["churn_slot"] else 0.0)
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": (f"decode_tokens_per_sec_{_mtag(preset)}_{wtag}_1chip_"
+                   f"b{batch}_churn_paged"),
+        "value": round(mean["churn_paged"], 3),
+        "unit": "tokens/s",
+        "vs_baseline": round(ratio_steady, 4),
+    }, dev,
+        baseline=f"steady_paged_{mean['steady_paged']:.1f}tok/s",
+        churn_slot_tok_s=round(mean["churn_slot"], 3),
+        ratio_paged_vs_slot=round(ratio_slot, 4),
+        ratio_churn_vs_steady=round(ratio_steady, 4))
+    sys.stderr.write(
+        f"device={dev.device_kind} batch={batch} "
+        f"steady_paged={mean['steady_paged']:.1f} "
+        f"churn_paged={mean['churn_paged']:.1f} "
+        f"churn_slot={mean['churn_slot']:.1f} tok/s "
+        f"churn/steady={ratio_steady:.3f} paged/slot={ratio_slot:.3f}\n"
     )
     return 0
 
@@ -1390,6 +1491,9 @@ def main() -> int:
             return _run_spec_serving(config, params, preset, quant, dev,
                                      batch, steps, k)
         return _run_speculative(config, params, preset, quant, dev, steps)
+    if os.environ.get("CAKE_BENCH_KVPOOL") == "1":
+        return _run_kvpool(config, params, preset, quant, dev,
+                           max(2, batch), steps, multistep)
     if os.environ.get("CAKE_BENCH_CHURN") == "1":
         return _run_churn(config, params, preset, quant, dev,
                           max(2, batch), steps, multistep)

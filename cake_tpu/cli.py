@@ -124,6 +124,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "(half the cache HBM — roughly doubles servable "
                         "batch x window, or doubles the --sp long-context "
                         "window; local and mesh paths)")
+    p.add_argument("--kv-layout", choices=["slot", "paged"], default="slot",
+                   dest="kv_layout",
+                   help="KV cache layout for the batched serving engine: "
+                        "'slot' (per-stream contiguous rows; default) or "
+                        "'paged' (pooled fixed-size pages addressed through "
+                        "per-stream page tables, with copy-on-write "
+                        "shared-prefix pages — cake_tpu/kvpool; admission/"
+                        "retirement touch page tables, not cache tensors). "
+                        "--mode serve and --prompts-file batch runs")
+    p.add_argument("--kv-page-size", type=int, default=None,
+                   dest="kv_page_size", metavar="N",
+                   help="--kv-layout paged: tokens per KV page (must divide "
+                        "the window; default 16)")
+    p.add_argument("--kv-pool-pages", type=int, default=None,
+                   dest="kv_pool_pages", metavar="N",
+                   help="--kv-layout paged: total pool pages (power of two, "
+                        ">= batch x window/page_size + 1; default sized "
+                        "from the batch plus prefix-tree headroom)")
     p.add_argument("--decode-block", type=int, default=None,
                    dest="decode_block",
                    help="fused decode steps per dispatch (all-local and mesh "
@@ -561,7 +579,8 @@ def run_serve(args) -> int:
                                          if args.decode_block is not None
                                          else 8),
                              lookahead=args.lookahead,
-                             kv_quant=args.kv_quant, spec_k=args.speculate)
+                             kv_quant=args.kv_quant, spec_k=args.speculate,
+                             **_kv_layout_kwargs(args))
     except ValueError as e:  # e.g. --max-seq not divisible by --sp
         sys.exit(f"error: {e}")
     gen.set_prompts(prompts)
@@ -587,6 +606,17 @@ def run_serve(args) -> int:
              st["decode_dispatches"], st["admit_dispatches"],
              st["tokens_per_dispatch"] or 0.0, st["busy_s"], st["wall_s"])
     return 0
+
+
+def _kv_layout_kwargs(args) -> dict:
+    """BatchGenerator kwargs for the --kv-layout flags (defaults stay the
+    engine's own when the user did not set them)."""
+    kw = {"kv_layout": args.kv_layout}
+    if args.kv_page_size is not None:
+        kw["kv_page_size"] = args.kv_page_size
+    if args.kv_pool_pages is not None:
+        kw["kv_pool_pages"] = args.kv_pool_pages
+    return kw
 
 
 def _serve_flags(args) -> list[str]:
@@ -700,6 +730,10 @@ def run_http_serve(args) -> int:
                      "engine; the host-topology serve path has no "
                      "logprob outputs (it would otherwise be silently "
                      "ignored)")
+        if args.kv_layout == "paged":
+            sys.exit("error: --kv-layout paged rides the batched mesh "
+                     "engine; a host-addressed --topology serve runs "
+                     "the single-stream wire master")
         if max_concurrent > 1:
             log.warning("--max-concurrent %d: a host-addressed --topology "
                         "serves over the single-stream wire master; "
@@ -744,7 +778,8 @@ def run_http_serve(args) -> int:
                 block_size=(args.decode_block
                             if args.decode_block is not None else 8),
                 lookahead=args.lookahead, kv_quant=args.kv_quant,
-                spec_k=args.speculate, logprobs=args.serve_logprobs)
+                spec_k=args.speculate, logprobs=args.serve_logprobs,
+                **_kv_layout_kwargs(args))
         except ValueError as e:
             sys.exit(f"error: {e}")
         # compile the admission path outside the serving window (requests
@@ -1369,6 +1404,16 @@ def main(argv=None) -> int:
             fetch_checkpoint(args.fetch, args.model, force=args.refetch)
         except Exception as e:
             sys.exit(f"error: fetch from {args.fetch} failed: {e}")
+    if args.kv_layout != "paged" and (args.kv_page_size is not None
+                                      or args.kv_pool_pages is not None):
+        sys.exit("error: --kv-page-size/--kv-pool-pages configure the "
+                 "paged KV pool; they require --kv-layout paged")
+    if args.kv_layout == "paged" and (
+            args.mode in ("worker", "gateway")
+            or (args.mode == "master" and not args.prompts_file)):
+        sys.exit("error: --kv-layout paged applies to the batched serving "
+                 "engine; it requires --mode serve or a --prompts-file "
+                 "batch run (it would otherwise be silently ignored)")
     if args.mode not in ("serve", "gateway") and _serve_flags(args):
         sys.exit(f"error: {'/'.join(_serve_flags(args))} configure the "
                  "HTTP serving plane; they require --mode serve or "

@@ -1,0 +1,37 @@
+"""Paged KV-cache pool with copy-on-write prefix sharing.
+
+The slot layout (``runtime.batch_generator`` default) gives every batch
+row a contiguous ``max_seq`` KV strip: admission and retirement move
+cache-sized tensors, and two streams can never share prefill work
+physically. This package pools the same HBM as fixed-size pages
+addressed through per-stream page tables (the vLLM / PagedAttention
+design, on the mesh):
+
+- :mod:`cake_tpu.kvpool.pool` — the device page array and the compiled
+  gather/scatter programs (page tables enter the decode step as int32
+  gather indices; static shapes, no retrace);
+- :mod:`cake_tpu.kvpool.table` — host-side free list + refcounts
+  (admission/retire touch page tables, never cache tensors);
+- :mod:`cake_tpu.kvpool.prefix` — the page-granular shared-prefix trie
+  (n same-system-prompt streams share physical prefill pages) with real
+  LRU eviction, plus :class:`~cake_tpu.kvpool.prefix.PrefixLRU` for the
+  legacy slot store.
+
+Select with ``BatchGenerator(kv_layout="paged")`` / ``--kv-layout
+paged``; token streams are bit-identical between layouts.
+"""
+
+from cake_tpu.kvpool.pool import (  # noqa: F401
+    batch_scatter_prog,
+    gather_view,
+    init_pool_on_mesh,
+    num_pages_of,
+    page_size_of,
+    pool_specs,
+    row_gather_prog,
+    row_scatter_prog,
+    scatter_back,
+    writeback_width,
+)
+from cake_tpu.kvpool.prefix import PrefixLRU, PrefixTree  # noqa: F401
+from cake_tpu.kvpool.table import SINK, PagePool, PoolExhausted  # noqa: F401

@@ -66,6 +66,21 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "requests landed on their prefix-preferred replica"),
     "gateway.saturated": (
         COUNTER, "429s propagated because every UP backend was saturated"),
+    # -- paged KV pool (cake_tpu/kvpool) ---------------------------------
+    "kvpool.admit_defers": (
+        COUNTER, "admissions deferred waiting for free pages"),
+    "kvpool.cow_copies": (
+        COUNTER, "private copy-on-write materializations of partially "
+                 "shared prefix pages"),
+    "kvpool.evictions": (
+        COUNTER, "prefix-tree page claims evicted to refill the free "
+                 "list"),
+    "kvpool.pages_free": (GAUGE, "pool pages on the free list"),
+    "kvpool.pages_shared": (
+        GAUGE, "physical pages referenced more than once (streams and/or "
+               "the prefix tree)"),
+    "kvpool.prefix_nodes": (
+        GAUGE, "prefix-tree nodes (cached shared-prefix pages)"),
     # -- generator (local single-stream decode) --------------------------
     "generator.decode_ms": (HISTOGRAM, "per-token decode latency"),
     "generator.prefill_ms": (HISTOGRAM, "prompt prefill latency"),

@@ -227,6 +227,7 @@ def build_sharded_decode(
     config: LlamaConfig, settings: SamplerSettings, plan: MeshPlan,
     params_like: dict | None = None, steps: int = 1, per_row: bool = False,
     kv_quant: str | None = None, masked: bool = False, logprobs_k: int = 0,
+    paged: bool = False,
 ):
     """Compile the fused multi-chip decode step.
 
@@ -276,6 +277,23 @@ def build_sharded_decode(
     trailing ``(lp_vals, lp_ids)`` (``[B, k]``, or ``[steps, B, k]`` for
     fused blocks). The sampled stream is unchanged: the top-k is a pure
     extra read of logits the program already computed.
+
+    ``paged=True`` (requires ``per_row``; composes with ``masked`` and
+    ``logprobs_k``) is the page-pool layout (:mod:`cake_tpu.kvpool`):
+    the ``cache`` operand becomes the pooled page array
+    ``[L, P, KH, page_size, D]`` and the signature gains two trailing
+    int32 operands — ``page_map [B, pages_per_stream]`` (each stream's
+    logical->physical page list, sink-padded past its frontier) and
+    ``scatter_ids [B, W]`` (the physical pages receiving this dispatch's
+    KV writes; sink for retired/dummy rows). The body gathers each
+    stream's pages into the standard contiguous view, runs the UNCHANGED
+    decode math over it (bit-identity with the slot layout by
+    construction), and scatters only the written pages back. Both
+    operand shapes are static, so page-table churn never retraces —
+    admitting or retiring a stream is a host-side table edit.
+    Requires ``plan.dp == 1`` and ``plan.sp == 1`` (the page axis is
+    unsharded; batch and sequence sharding of pooled pages is future
+    work — ``BatchGenerator`` enforces this at construction).
     """
     heads_l, kv_heads_l = _local_counts(config, plan.tp)
     if masked and (not per_row or steps != 1):
@@ -283,6 +301,11 @@ def build_sharded_decode(
                          "(the DFA advance is host-side between steps)")
     if logprobs_k and not per_row:
         raise ValueError("logprobs_k requires the per_row serving mode")
+    if paged and not per_row:
+        raise ValueError("paged decode requires the per_row serving mode")
+    if paged and (plan.dp != 1 or plan.sp != 1):
+        raise ValueError("paged decode requires dp == 1 and sp == 1 "
+                         "(the page axis is unsharded)")
 
     def one_step(params, token, cache, pos, key, history, hist_slot,
                  mask=None):
@@ -316,10 +339,16 @@ def build_sharded_decode(
             return jax.vmap(jax.random.fold_in)(key, index)
         return jax.random.fold_in(key, index)
 
+    if paged:
+        from cake_tpu.kvpool import pool_specs
+
+        kv_specs = pool_specs(kv_quant)
+    else:
+        kv_specs = cache_specs(kv_quant)
     in_specs = [
         param_specs(params_like),
         P(DP),
-        cache_specs(kv_quant),
+        kv_specs,
         P(DP) if per_row else P(),
         P(DP, None) if per_row else P(None),
         P(DP, None),
@@ -332,15 +361,30 @@ def build_sharded_decode(
             return tok, cache, history, hist_slot
     else:
         def step(params, token, cache, pos, key, history, hist_slot,
-                 index0, *mask_args):
+                 index0, *rest):
+            rest = list(rest)
             if masked:
-                mask_table, mask_row = mask_args
+                mask_table, mask_row = rest[0], rest[1]
+                del rest[:2]
                 # one gather + unpack per dispatch: each stream's current
                 # DFA-state bitmask row, from the table uploaded once
                 row_mask = sampling.unpack_mask_bits(
                     mask_table[mask_row], config.vocab_size)
             else:
                 row_mask = None
+            if paged:
+                from cake_tpu import kvpool
+
+                page_map, scatter_ids = rest
+                pool_in = cache
+                ps = kvpool.page_size_of(pool_in)
+                ppp = page_map.shape[1]
+                w = scatter_ids.shape[1]
+                # the contiguous view of every stream's pages; the decode
+                # body below is untouched, so paged streams reproduce the
+                # slot layout's math bit for bit
+                cache = kvpool.gather_view(pool_in, page_map)
+                first_page = jnp.minimum(pos // ps, ppp - w)
 
             def body(carry, i):
                 token, cache, history, hist_slot = carry
@@ -355,6 +399,10 @@ def build_sharded_decode(
                 body, (token, cache, history, hist_slot),
                 jnp.arange(steps, dtype=jnp.int32),
             )
+            if paged:
+                # only the pages this dispatch wrote go back to the pool
+                cache = kvpool.scatter_back(pool_in, cache, first_page,
+                                            scatter_ids)
             if logprobs_k:
                 toks, lpv, lpi = ys
             else:
@@ -369,6 +417,9 @@ def build_sharded_decode(
         if masked:
             in_specs.append(P(None, None))  # mask_table: replicated
             in_specs.append(P(DP))          # mask_row: per-stream
+        if paged:
+            in_specs.append(P(None, None))  # page_map
+            in_specs.append(P(None, None))  # scatter_ids
 
     lp_specs = ()
     if logprobs_k:
@@ -380,7 +431,7 @@ def build_sharded_decode(
         in_specs=tuple(in_specs),
         out_specs=(
             P(DP) if steps == 1 else P(None, DP),
-            cache_specs(kv_quant),
+            kv_specs,
             P(DP, None),
             P(DP) if per_row else P(),
         ) + lp_specs,

@@ -73,6 +73,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from cake_tpu.kvpool import (
+    SINK,
+    PagePool,
+    PoolExhausted,
+    PrefixLRU,
+    PrefixTree,
+)
+from cake_tpu.kvpool import pool as kvpool_pool
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.obs import flight as obs_flight
 from cake_tpu.obs import metrics as obs_metrics
@@ -147,6 +155,9 @@ class BatchGenerator:
         spec_ngram: int = 3,
         spec_rounds: int = 8,
         logprobs: int = 0,
+        kv_layout: str = "slot",
+        kv_page_size: int = 16,
+        kv_pool_pages: int | None = None,
     ):
         if plan is None:
             plan = MeshPlan.build(config, num_stages=num_stages, tp=tp,
@@ -183,6 +194,53 @@ class BatchGenerator:
                 f"max_seq {self.max_seq} must divide by sp {plan.sp} (the "
                 "KV window shards over the sp axis)"
             )
+        # Paged KV (cake_tpu/kvpool): the per-slot contiguous cache is
+        # replaced by a pooled page array addressed through per-stream
+        # page tables fed into the compiled decode step as gather
+        # indices. Admission and retirement become host-side page-table
+        # edits (plus a one-page-per-stream write-back per dispatch)
+        # instead of cache-tensor splices, and refcounted pages turn the
+        # prefix store into a real shared-prefix tree — n streams with
+        # the same system prompt share physical prefill pages.
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(
+                f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
+        self._paged = kv_layout == "paged"
+        self._page_size = int(kv_page_size)
+        self._pool_pages_req = kv_pool_pages
+        if self._paged:
+            if plan.dp != 1 or plan.sp != 1:
+                raise ValueError(
+                    "kv_layout='paged' requires dp == 1 and sp == 1 (the "
+                    "page axis is unsharded; batch/sequence sharding of "
+                    "pooled pages is future work)")
+            if spec_k:
+                raise ValueError(
+                    "kv_layout='paged' does not compose with batched "
+                    "speculation (spec_k): the fused verify rounds write "
+                    "K+1 slots per row outside the page write-back plan")
+            if self._page_size < 1 or self.max_seq % self._page_size:
+                raise ValueError(
+                    f"kv_page_size {self._page_size} must be a positive "
+                    f"divisor of max_seq {self.max_seq}")
+            if kv_pool_pages is not None and (
+                    kv_pool_pages < 2
+                    or kv_pool_pages & (kv_pool_pages - 1)):
+                # shape validation belongs HERE with the other paged
+                # knobs (the CLI's try/except turns ctor ValueErrors into
+                # clean exits); only the batch-dependent >= need bound
+                # waits for set_prompts (_init_pool)
+                raise ValueError(
+                    f"kv_pool_pages must be a power of two >= 2, got "
+                    f"{kv_pool_pages}")
+            self._ppp = self.max_seq // self._page_size  # pages per stream
+        self._pagepool = None          # host free-list/refcounts (kvpool)
+        self._prefix_tree = None       # page-granular shared-prefix trie
+        self._tables: list[list[int]] = []  # per-slot physical page lists
+        self._page_map_dev = None      # memoized device page map (tables
+        #                                change rarely; scatter ids do not)
+        self._staged_prefix = None     # set_prompts staged prefix row
+        self._admit_deferred = False   # last tick deferred on pool pressure
         self.tokenizer = tokenizer
         self.block_size = max(1, block_size)
         # Adaptive decode blocks (the continuous-batching dispatch lever):
@@ -251,17 +309,23 @@ class BatchGenerator:
         self._params_int4 = _has_quant(self.params, quant.Quantized4Linear)
         self._prefill = self._pinned(build_sharded_prefill(
             config, plan, params_like=self.params, kv_quant=kv_quant))
-        self._decode_single = self._pinned(build_sharded_decode(
+        # raw jit handle kept so tests can pin the compile count — the
+        # paged layout's page-table operands are DATA, so table churn
+        # (admission, retirement, page growth) must never retrace
+        self._decode_single_jit = build_sharded_decode(
             config, self.settings, plan, params_like=self.params,
             per_row=True, kv_quant=kv_quant, logprobs_k=self.logprobs_k,
-        ))
+            paged=self._paged,
+        )
+        self._decode_single = self._pinned(self._decode_single_jit)
         self._decode_block = (
             self._pinned(build_sharded_decode(config, self.settings, plan,
                                               params_like=self.params,
                                               steps=self.block_size,
                                               per_row=True,
                                               kv_quant=kv_quant,
-                                              logprobs_k=self.logprobs_k))
+                                              logprobs_k=self.logprobs_k,
+                                              paged=self._paged))
             if self.block_size > 1 else None
         )
         # Interleaved-microbatch schedule (pipeline.build_interleaved_decode):
@@ -279,6 +343,11 @@ class BatchGenerator:
             # the interleaved schedule has no logprob outputs (its head
             # runs vocab-split per stage); serialized programs are
             # bit-identical, so logprob serving just uses those
+            self._interleave = False
+        if self._paged:
+            # the interleaved schedule has no paged twin yet; serialized
+            # paged programs are bit-identical, so paged serving uses
+            # those (same fallback contract as logprobs)
             self._interleave = False
         self._decode_single_il = (
             self._pinned(build_interleaved_decode(
@@ -337,19 +406,25 @@ class BatchGenerator:
         self.__prefill_offset = None
         self.__broadcast_progs: dict = {}
         self.__splice = None  # slot-traced admission splice (one compile)
-        # Generalized prefix store: staged batch-1 KV rows keyed by their
-        # token prefix (insertion-ordered dict = LRU). Populated by the
-        # set_prompts shared prefix AND by every completed admission (its
-        # prefix truncated to a prefix_block boundary), so arrivals with
-        # DIFFERENT system prompts each hit their own cached prefix. A row
-        # may hold donor KV past the match length — positions >= the match
-        # base are beyond the reusing stream's causal frontier until its
-        # own remainder prefill/decode overwrites them, the same
+        self.__splice_small = None  # paged: sampler-state-only splice
+        self._contiguous_cache = None  # set_prompts -> _pageify_batch hand-off
+        # Generalized prefix store (slot layout): staged batch-1 KV rows
+        # keyed by their token prefix in an explicit LRU
+        # (kvpool.PrefixLRU). Populated by the set_prompts shared prefix
+        # AND by every completed admission (its prefix truncated to a
+        # prefix_block boundary), so arrivals with DIFFERENT system
+        # prompts each hit their own cached prefix. A row may hold donor
+        # KV past the match length — positions >= the match base are
+        # beyond the reusing stream's causal frontier until its own
+        # remainder prefill/decode overwrites them, the same
         # never-attendable invariant as bucketed-prefill padding. Entries
         # cost one batch-1 cache each; prefix_cache_entries caps HBM
-        # (0 disables reuse).
-        self._prefix_store: dict[tuple, object] = {}
+        # (0 disables reuse). The paged layout replaces this whole-row
+        # store with the page-granular shared-prefix tree (_prefix_tree):
+        # hits SHARE physical pages via refcounts instead of copying a
+        # staged row, and eviction is pool-pressure-driven.
         self._prefix_entries = max(0, prefix_cache_entries)
+        self._prefix_store = PrefixLRU(self._prefix_entries)
         self._prefix_block = max(1, prefix_block)
         self._prefix_hits = 0
         # Batched n-gram speculation (spec_k > 0): each dispatch verifies
@@ -429,9 +504,14 @@ class BatchGenerator:
                 jnp.asarray([max(0, len(prefix) - 1 - pos)], jnp.int32),
             )
             self._n_admit_dispatches += 1
-        # keep the staged prefix row: arrivals opening with the same
-        # prefix start from a copy of it instead of re-prefilling
-        self._store_prefix(list(prefix), staging)
+        if self._paged:
+            # the staged row's full pages become SHARED pool pages at
+            # pageification (_pageify_batch) — keep the row until then
+            self._staged_prefix = (list(prefix), staging)
+        else:
+            # keep the staged prefix row: arrivals opening with the same
+            # prefix start from a copy of it instead of re-prefilling
+            self._store_prefix(list(prefix), staging)
         self.cache = self._broadcast_prog(b)(staging)
 
     def _broadcast_prog(self, b: int):
@@ -570,7 +650,7 @@ class BatchGenerator:
                 self.config, self.settings, self.plan,
                 params_like=self.params, per_row=True,
                 kv_quant=self.kv_quant, masked=True,
-                logprobs_k=self.logprobs_k,
+                logprobs_k=self.logprobs_k, paged=self._paged,
             )
             self.__masked = self._pinned(self._masked_jit)
         return self.__masked
@@ -689,6 +769,7 @@ class BatchGenerator:
             self._keys, self._history, self._hist_slot,
             jnp.asarray(self._index), table,
             jnp.zeros((len(self.streams),), jnp.int32),
+            *self._paged_args_warm(1),
         )
         jax.block_until_ready(out)
 
@@ -870,6 +951,13 @@ class BatchGenerator:
         # but not yet handed to a step() caller
         self._pending_rows: list[list[Token | None]] = []
         self._inflight = None  # any prior in-flight block is stale now
+        if self._paged:
+            # hand the freshly prefilled contiguous cache to the pool:
+            # from here on self.cache IS the page array and every decode
+            # dispatch addresses it through the per-stream page tables
+            self._contiguous_cache = self.cache
+            self._pageify_batch(
+                lcp, self.streams[0].prompt[:lcp] if lcp else [])
         if getattr(self, "_splice_warm_pending", False):
             # warm_admission ran before this set_prompts; the splice warm
             # needs the batch state that only now exists
@@ -908,29 +996,213 @@ class BatchGenerator:
         return len(self._arrivals) + (1 if self._staging is not None else 0)
 
     def _store_prefix(self, ids: list[int], row) -> None:
-        """Insert a staged batch-1 KV row under its token prefix,
-        LRU-capped at ``prefix_cache_entries`` rows."""
+        """Slot layout: insert a staged batch-1 KV row under its token
+        prefix, LRU-capped at ``prefix_cache_entries`` rows (the
+        eviction policy lives in :class:`cake_tpu.kvpool.PrefixLRU`)."""
         if self._prefix_entries <= 0 or len(ids) < self._prefix_share_min:
             return
-        key = tuple(ids)
-        self._prefix_store.pop(key, None)
-        self._prefix_store[key] = row
-        while len(self._prefix_store) > self._prefix_entries:
-            self._prefix_store.pop(next(iter(self._prefix_store)))
+        self._prefix_store.put(tuple(ids), row)
 
     def _match_prefix(self, ids: list[int]):
-        """Longest stored prefix STRICTLY shorter than the prompt (at
-        least one remainder token must produce the first-token logits).
-        Returns ``(base, row)``; a hit is bumped to LRU-most-recent."""
-        best, row = 0, None
-        for key in self._prefix_store:
-            m = len(key)
-            if m > best and m < len(ids) and tuple(ids[:m]) == key:
-                best, row = m, self._prefix_store[key]
-        if row is not None:
-            key = tuple(ids[:best])
-            self._prefix_store[key] = self._prefix_store.pop(key)
-        return best, row
+        """Slot layout: longest stored prefix STRICTLY shorter than the
+        prompt (at least one remainder token must produce the first-token
+        logits). Returns ``(base, row)``; a hit becomes LRU-most-recent."""
+        return self._prefix_store.match(ids)
+
+    # -- paged KV layout (cake_tpu/kvpool) -----------------------------------
+    def _init_pool(self, b: int) -> None:
+        """(Re)build the page pool for a ``b``-row batch: the device page
+        array, the host free-list/refcounts, and a fresh prefix tree.
+        Sizing guarantees mid-decode allocation can NEVER fail: with
+        ``pages >= b * pages_per_stream + 1`` (sink included), live
+        streams can all fill their windows and the only other claims —
+        prefix-tree nodes — are evictable."""
+        ps = self._page_size
+        need = b * self._ppp + 1
+        pages = self._pool_pages_req
+        if pages is None:
+            want = need + 2 * self._ppp  # headroom: tree-held warm prefixes
+            pages = 1 << (want - 1).bit_length()
+        if pages < need:
+            raise ValueError(
+                f"kv_pool_pages {pages} < {need} required for batch {b} x "
+                f"{self._ppp} pages/stream + sink: a live batch could "
+                "exhaust the pool mid-decode")
+        self._pagepool = PagePool(pages, ps)
+        self._prefix_tree = PrefixTree(self._pagepool)
+        self._tables = [[] for _ in range(b)]
+        self._page_map_dev = None
+        self.cache = kvpool_pool.init_pool_on_mesh(
+            self.config, self.plan.mesh, pages, ps, self.kv_quant)
+        mesh = self.plan.mesh
+        self._row_gather = kvpool_pool.row_gather_prog(
+            self.config, mesh, self.kv_quant)
+        self._row_scatter = kvpool_pool.row_scatter_prog(
+            self.config, mesh, self.kv_quant)
+        self._batch_scatter = kvpool_pool.batch_scatter_prog(
+            self.config, mesh, self.kv_quant)
+
+    def _alloc_page(self) -> int:
+        """One free page, evicting prefix-tree claims under pressure (the
+        tree is a cache; live streams are not)."""
+        try:
+            return self._pagepool.alloc()
+        except PoolExhausted:
+            if self._prefix_tree.evict_until_free(1):
+                return self._pagepool.alloc()
+            raise
+
+    def _release_pages(self, slot: int) -> None:
+        """Retire a slot's page claims — the whole KV free is this loop
+        over a host list (pages shared with the prefix tree or other
+        streams survive until their last reference drops)."""
+        if not self._paged or slot >= len(self._tables):
+            return
+        if self._tables[slot]:
+            self._page_map_dev = None
+        for pid in self._tables[slot]:
+            self._pagepool.unref(pid)
+        self._tables[slot] = []
+
+    def _ensure_pages(self, size: int) -> None:
+        """Grow each live stream's page table to cover the ``size``
+        positions this dispatch writes — the one allocation point of the
+        steady-state decode path (a handful of list appends per page
+        boundary crossed; no device work)."""
+        ps = self._page_size
+        for i, s in enumerate(self.streams):
+            if not s.active or s.done:
+                continue
+            t = self._tables[i]
+            last = min(int(self._pos[i]) + size - 1, self.max_seq - 1) // ps
+            while len(t) <= last:
+                t.append(self._alloc_page())
+                self._page_map_dev = None
+
+    def _page_map_np(self) -> np.ndarray:
+        """[B, pages_per_stream] logical->physical map, sink-padded past
+        each stream's allocated frontier."""
+        m = np.full((len(self.streams), self._ppp), SINK, np.int32)
+        for i, t in enumerate(self._tables):
+            if t:
+                m[i, : len(t)] = t
+        return m
+
+    def _scatter_ids_np(self, size: int) -> np.ndarray:
+        """[B, W] physical pages receiving this dispatch's KV writes:
+        the pages covering ``[pos, pos+size)`` per live row, the sink for
+        retired/dummy rows and in-page overrun slots (their writes are
+        discarded garbage either way — same invariant as the slot
+        layout's clamped overrun writes)."""
+        ps = self._page_size
+        w = kvpool_pool.writeback_width(size, ps, self._ppp)
+        ids = np.full((len(self.streams), w), SINK, np.int32)
+        for i, s in enumerate(self.streams):
+            if not s.active or s.done:
+                continue
+            t = self._tables[i]
+            pos = int(self._pos[i])
+            first = min(pos // ps, self._ppp - w)
+            last = min(pos + size - 1, self.max_seq - 1) // ps
+            for j in range(w):
+                p = first + j
+                if first + j <= last and p < len(t):
+                    ids[i, j] = t[p]
+        return ids
+
+    def _paged_args(self, size: int) -> tuple:
+        """The two extra decode operands of the paged layout (empty in
+        slot mode, so dispatch sites splat unconditionally). Allocates
+        the pages the dispatch will write first. The page map re-uploads
+        only when a table actually changed (admission, retirement, page
+        growth) — steady-state dispatches reuse the device array; the
+        tiny [B, W] scatter-id vector is genuinely per-dispatch."""
+        if not self._paged:
+            return ()
+        self._ensure_pages(size)
+        if self._page_map_dev is None:
+            self._page_map_dev = jnp.asarray(self._page_map_np())
+        return (self._page_map_dev,
+                jnp.asarray(self._scatter_ids_np(size)))
+
+    def _paged_args_warm(self, size: int) -> tuple:
+        """Warm-path variant: current page map, all-sink write-back (the
+        warm dispatch must not allocate pages or touch live content)."""
+        if not self._paged:
+            return ()
+        w = kvpool_pool.writeback_width(size, self._page_size, self._ppp)
+        return (jnp.asarray(self._page_map_np()),
+                jnp.zeros((len(self.streams), w), jnp.int32))
+
+    def _pageify_batch(self, lcp: int, prefix_ids: list[int]) -> None:
+        """Move a freshly prefilled contiguous batch cache into pool
+        pages (set_prompts only — every later admission writes pages
+        directly). Full pages of a shared prefix become ONE physical copy
+        referenced by every stream + the prefix tree; each stream's
+        unaligned boundary page (prefix tail + its own remainder) is a
+        private copy-on-write materialization."""
+        ps = self._page_size
+        b = len(self.streams)
+        self._init_pool(b)
+        pool, contiguous = self.cache, self._contiguous_cache
+        n_full = lcp // ps
+        shared: list[int] = []
+        if n_full:
+            _, staging = self._staged_prefix
+            shared = [self._pagepool.alloc() for _ in range(n_full)]
+            ids_vec = np.zeros((self._ppp,), np.int32)
+            ids_vec[:n_full] = shared
+            pool = self._row_scatter(pool, staging, jnp.asarray(ids_vec))
+            if self._prefix_entries > 0:
+                # register for future ADMISSION reuse only when the
+                # prefix cache is enabled (0 disables it, same contract
+                # as the slot store) — the batch itself still shares the
+                # physical pages either way, and without the tree claim
+                # they free when the last sharer retires
+                self._prefix_tree.insert(prefix_ids[: n_full * ps], shared)
+        self._staged_prefix = None
+        ids = np.zeros((b * self._ppp,), np.int32)
+        cow = 0
+        for i, s in enumerate(self.streams):
+            if not s.active:
+                continue
+            for pid in shared:
+                self._pagepool.ref(pid)
+            t = list(shared)
+            last_page = (len(s.prompt) - 1) // ps
+            for p in range(n_full, last_page + 1):
+                pid = self._alloc_page()
+                t.append(pid)
+                ids[i * self._ppp + p] = pid
+            if lcp % ps and last_page >= n_full:
+                cow += 1  # boundary page: private copy of shared tail
+            self._tables[i] = t
+        for pid in shared:
+            self._pagepool.unref(pid)  # hand the alloc claim off
+        if cow:
+            self._pagepool.count_cow(cow)
+        self.cache = self._batch_scatter(pool, contiguous, jnp.asarray(ids))
+        self._contiguous_cache = None
+
+    def _splice_small_fn(self):
+        """The paged admission splice: only the per-stream sampler state
+        (keys/history/ring slots/feedback token) splices — KV moved by
+        the page write-back (``row_scatter``), never by a cache-sized
+        scatter. Slot index traced; compiles once."""
+        if self.__splice_small is None:
+            def splice(keys, history, hist_slot, last, key, hist_row,
+                       hist_used, tok, slot):
+                upd1 = lambda buf, v: jax.lax.dynamic_update_index_in_dim(
+                    buf, v, slot, 0)
+                return (
+                    upd1(keys, key),
+                    upd1(history, hist_row),
+                    upd1(hist_slot, hist_used),
+                    upd1(last, tok),
+                )
+
+            self.__splice_small = jax.jit(splice)
+        return self.__splice_small
 
     def _admission_chunk_for(self, prompt_len: int) -> int:
         """The per-dispatch admission chunk for a prompt of this length:
@@ -990,14 +1262,31 @@ class BatchGenerator:
         np.asarray(np.asarray(tok).ravel()[:1])  # synchronize
 
     def _warm_splice(self, staging=None) -> None:
-        """Compile the slot-traced admission splice against the live batch
-        state's shapes (outputs discarded; nothing is donated)."""
+        """Compile the admission-completion programs against the live
+        batch state's shapes (outputs discarded; live state untouched).
+        Slot: the slot-traced cache splice. Paged: the row gather/scatter
+        page programs plus the small sampler-state splice — warmed on
+        pool/staging COPIES (both programs donate their first argument)
+        with all-sink ids, so no live page is read or written."""
         if staging is None:
             staging = init_cache_on_mesh(
                 self.config, self.plan.mesh, batch=1, max_seq=self.max_seq,
                 quant=self.kv_quant, batch_replicated=True,
             )
         n_hist = self.settings.repeat_last_n
+        if self._paged:
+            sink = jnp.zeros((self._ppp,), jnp.int32)
+            pool_copy = jax.tree.map(lambda x: x.copy(), self.cache)
+            out_pool = self._row_scatter(pool_copy, staging, sink)
+            out_row = self._row_gather(self.cache, sink)
+            out = self._splice_small_fn()(
+                self._keys, self._history, self._hist_slot,
+                self._last_tokens, jax.random.fold_in(self._base_key, 0),
+                jnp.full((n_hist,), -1, jnp.int32), jnp.int32(0),
+                jnp.int32(0), jnp.int32(0),
+            )
+            jax.block_until_ready((out_pool, out_row, out))
+            return
         out = self._splice_fn()(
             self.cache, staging, self._keys, self._history,
             self._hist_slot, self._last_tokens,
@@ -1013,30 +1302,77 @@ class BatchGenerator:
         if self._staging is None:
             if not self._arrivals or self._free_slot() is None:
                 return
+            slot = self._free_slot()
+            if self._paged:
+                # claim point: the slot's previous stream (retired by ANY
+                # path, including a caller writing s.done directly) frees
+                # its page claims before the arrival's needs are priced
+                self._release_pages(slot)
             ids, sid, guide = self._arrivals.pop(0)
             # Prefix reuse: an arrival whose opening tokens match a stored
-            # prefix row (its batch's system prompt, or ANY earlier
-            # admission's block-aligned prefix) starts from a COPY of that
-            # row and prefills only its remainder — re-prefilling a known
-            # prefix is exactly the waste the store exists to kill. Falls
-            # back to a from-scratch prefill when the remainder's bucket
-            # would not fit above the prefix.
-            base, row = self._match_prefix(ids)
+            # prefix (a staged row in the slot layout, a page chain in the
+            # paged one) starts from that content and prefills only its
+            # remainder — re-prefilling a known prefix is exactly the
+            # waste the store exists to kill. Falls back to a from-scratch
+            # prefill when the remainder's bucket would not fit above the
+            # prefix.
+            row = None
+            shared_pages: list[int] = []
+            if self._paged:
+                base = 0
+                if self._prefix_entries > 0:
+                    base, shared_pages = self._prefix_tree.match(ids)
+            else:
+                base, row = self._match_prefix(ids)
             rem = len(ids) - base
             chunk = self._admission_chunk_for(rem)
             t_pad = -(-rem // chunk) * chunk
             if base and base + t_pad > self.max_seq:
-                base, row = 0, None
+                base, row, shared_pages = 0, None, []
                 rem = len(ids)
                 chunk = self._admission_chunk_for(rem)
                 t_pad = -(-rem // chunk) * chunk
+            if self._paged:
+                # hold the matched pages BEFORE any eviction can touch
+                # them, then price the remainder; when its pages cannot
+                # be found even by evicting warm prefixes, the arrival
+                # defers (stays FIFO head) until retirements free pages
+                for pid in shared_pages:
+                    self._pagepool.ref(pid)
+                ps = self._page_size
+                need = (len(ids) - 1) // ps + 1 - len(shared_pages)
+                if (self._pagepool.free_count < need
+                        and not self._prefix_tree.evict_until_free(need)):
+                    for pid in shared_pages:
+                        self._pagepool.unref(pid)
+                    if not self._admit_deferred:
+                        # count DEFERRED ADMISSIONS, not re-priced ticks
+                        # (the head arrival is re-tried every step while
+                        # it waits). Unreachable under the enforced pool
+                        # sizing — a belt for future preemption/spill
+                        # features that pin pages outside stream tables.
+                        self._pagepool.count_defer()
+                    self._admit_deferred = True
+                    self._arrivals.insert(0, (ids, sid, guide))
+                    return
+                self._admit_deferred = False
             tokens = np.zeros((1, t_pad), np.int32)
             tokens[0, :rem] = ids[base:]
             if base:
                 self._prefix_hits += 1
-                # copy: the admission program donates its cache argument,
-                # and the stored row must survive for future hits
-                cache = jax.tree.map(lambda x: x.copy(), row)
+                if self._paged:
+                    # the staging starts as a GATHER of the shared pages
+                    # (prefix KV the remainder chunks attend), not a copy
+                    # of a stored row — the pages themselves stay shared
+                    ids_vec = np.zeros((self._ppp,), np.int32)
+                    ids_vec[: len(shared_pages)] = shared_pages
+                    cache = self._row_gather(self.cache,
+                                             jnp.asarray(ids_vec))
+                else:
+                    # copy: the admission program donates its cache
+                    # argument, and the stored row must survive for
+                    # future hits
+                    cache = jax.tree.map(lambda x: x.copy(), row)
             else:
                 cache = init_cache_on_mesh(
                     self.config, self.plan.mesh, batch=1,
@@ -1044,9 +1380,9 @@ class BatchGenerator:
                     batch_replicated=True,
                 )
             self._staging = {
-                "ids": ids, "sid": sid, "slot": self._free_slot(),
+                "ids": ids, "sid": sid, "slot": slot,
                 "tokens": tokens, "pos": 0, "chunk": chunk, "base": base,
-                "cache": cache, "guide": guide,
+                "cache": cache, "guide": guide, "shared": shared_pages,
             }
         st = self._staging
         pos, chunk, base = st["pos"], st["chunk"], st["base"]
@@ -1159,13 +1495,40 @@ class BatchGenerator:
             lp_row = [(int(i), float(v))
                       for v, i in zip(np.asarray(lpv0), np.asarray(lpi0))]
 
-        (self.cache, self._keys, self._history, self._hist_slot,
-         self._last_tokens) = self._splice_fn()(
-            self.cache, st["cache"], self._keys, self._history,
-            self._hist_slot, self._last_tokens, key,
-            jnp.asarray(hist_row), jnp.int32(len(tail) + 1),
-            jnp.int32(tok_id), jnp.int32(slot),
-        )
+        if self._paged:
+            # the paged "splice": scatter the staged row's NEW pages into
+            # the pool (shared prefix pages are already there — their
+            # id-vector slots stay sink, so refcounted pages are never
+            # rewritten) and install the table. Only the small sampler
+            # state splices as tensors; the KV hand-off is a page write.
+            ps = self._page_size
+            shared = st.get("shared", [])
+            n_shared = len(shared)
+            last_page = (len(ids) - 1) // ps
+            new_pages = [self._alloc_page()
+                         for _ in range(last_page + 1 - n_shared)]
+            ids_vec = np.zeros((self._ppp,), np.int32)
+            ids_vec[n_shared: last_page + 1] = new_pages
+            self.cache = self._row_scatter(self.cache, st["cache"],
+                                           jnp.asarray(ids_vec))
+            self._release_pages(slot)  # idempotent (freed at claim too)
+            self._tables[slot] = shared + new_pages
+            self._page_map_dev = None
+            (self._keys, self._history, self._hist_slot,
+             self._last_tokens) = self._splice_small_fn()(
+                self._keys, self._history, self._hist_slot,
+                self._last_tokens, key, jnp.asarray(hist_row),
+                jnp.int32(len(tail) + 1), jnp.int32(tok_id),
+                jnp.int32(slot),
+            )
+        else:
+            (self.cache, self._keys, self._history, self._hist_slot,
+             self._last_tokens) = self._splice_fn()(
+                self.cache, st["cache"], self._keys, self._history,
+                self._hist_slot, self._last_tokens, key,
+                jnp.asarray(hist_row), jnp.int32(len(tail) + 1),
+                jnp.int32(tok_id), jnp.int32(slot),
+            )
         self._pos = np.asarray(self._pos).copy()
         self._pos[slot] = len(ids)
         self._index = np.asarray(self._index).copy()
@@ -1198,15 +1561,29 @@ class BatchGenerator:
                           logprobs=lp_row)
         self._pending_rows.append(row)
 
-        # Feed the store: this arrival's prefix, truncated to a
-        # prefix_block boundary, becomes reusable by future arrivals with
-        # the same opening (a hit-extended row — base old-prefix + this
-        # remainder — works the same way: st["cache"] holds KV for the
-        # whole prompt). The splice above copied values out, so retaining
-        # the staging row costs no extra dispatch.
-        base_new = ((len(ids) - 1) // self._prefix_block) * self._prefix_block
-        if base_new >= max(1, self._prefix_share_min):
-            self._store_prefix(ids[:base_new], st["cache"])
+        # Feed the store: this arrival's prefix becomes reusable by future
+        # arrivals with the same opening. Paged: the stream's FULL prompt
+        # pages register in the prefix tree (zero copies — the tree just
+        # takes references; a later same-prefix arrival shares the
+        # physical pages, which is the copy-on-write fan-out). Slot: the
+        # staging row is retained under the prefix truncated to a
+        # prefix_block boundary (the splice above copied values out, so
+        # retaining it costs no extra dispatch).
+        if self._paged:
+            n_full = len(ids) // self._page_size
+            if (self._prefix_entries > 0 and n_full
+                    and n_full * self._page_size
+                    >= max(1, self._prefix_share_min)):
+                self._prefix_tree.insert(ids, self._tables[slot][:n_full])
+        else:
+            base_new = ((len(ids) - 1) // self._prefix_block) \
+                * self._prefix_block
+            if base_new >= max(1, self._prefix_share_min):
+                self._store_prefix(ids[:base_new], st["cache"])
+        if s.done and self._paged:
+            # first sampled token ended the stream: free its claims now
+            # (AFTER the tree store above took its references)
+            self._release_pages(slot)
 
     def finish(self, stream_id: int) -> bool:
         """Retire the stream with this ``stream_id`` at ANY point in its
@@ -1230,8 +1607,14 @@ class BatchGenerator:
             if s.active and not s.done and s.stream_id == stream_id:
                 s.done = True
                 self._drop_guide(i)
+                # paged: retirement IS the KV free — a host-side unref
+                # loop over the slot's page list, no cache tensor touched
+                self._release_pages(i)
                 return True
         if self._staging is not None and self._staging["sid"] == stream_id:
+            if self._paged:
+                for pid in self._staging.get("shared", []):
+                    self._pagepool.unref(pid)
             self._staging = None  # staged KV row is dropped with it
             return True
         n0 = len(self._arrivals)
@@ -1261,6 +1644,15 @@ class BatchGenerator:
                                   if a[0] is not ids]
                 raise RuntimeError("no free slot: every stream is still live")
             self._admission_tick()
+            if self._staging is None and self._admit_deferred:
+                # paged pool pressure: nothing inside a synchronous
+                # admit() will retire streams and free pages, so busy-
+                # looping on the deferred head would never terminate
+                self._arrivals = [a for a in self._arrivals
+                                  if a[0] is not ids]
+                raise RuntimeError(
+                    "kv page pool exhausted: admission deferred (retire "
+                    "streams via step()/finish(), or grow kv_pool_pages)")
         # the emission row just queued duplicates the returned Token: drop it
         row = self._pending_rows.pop()
         slot = next(i for i, t in enumerate(row) if t is not None)
@@ -1289,6 +1681,10 @@ class BatchGenerator:
             if s.done:
                 s.end_reason = "eos" if is_eos else "length"
             self._advance_guide(i, s, tok_id)
+            if s.done and self._paged:
+                # EOS/window/constraint retirement frees the pages here —
+                # the slot is admissible the moment the row is emitted
+                self._release_pages(i)
             # the EOS id is an end marker, not text: detokenizing it would
             # append its (toy tokenizers: arbitrary) surface form
             text = (s.detok.next_token(tok_id)
@@ -1633,7 +2029,7 @@ class BatchGenerator:
                     self.config, self.settings, self.plan,
                     params_like=self.params, steps=steps, per_row=True,
                     kv_quant=self.kv_quant,
-                    logprobs_k=self.logprobs_k))
+                    logprobs_k=self.logprobs_k, paged=self._paged))
             self.__block_progs[key] = prog
         return prog
 
@@ -1677,6 +2073,7 @@ class BatchGenerator:
                 self.params, self._last_tokens, cache,
                 jnp.asarray(self._pos), self._keys, self._history,
                 self._hist_slot, jnp.asarray(self._index),
+                *self._paged_args_warm(size),
             )
             jax.block_until_ready(out)
 
@@ -1714,6 +2111,7 @@ class BatchGenerator:
                 self.params, self._last_tokens, self.cache,
                 jnp.asarray(self._pos), self._keys, self._history,
                 self._hist_slot, jnp.asarray(self._index),
+                *self._paged_args(size),
             )
             if self.logprobs_k:
                 (toks, self.cache, self._history, self._hist_slot,
@@ -1822,9 +2220,11 @@ class BatchGenerator:
                 out = self._decode_single_masked(
                     *args, self._mask_table,
                     jnp.asarray(self._mask_rows_np()),
+                    *self._paged_args(1),
                 )
             else:
-                out = self._pick_decode(block=False)(*args)
+                out = self._pick_decode(block=False)(
+                    *args, *self._paged_args(1))
             if self.logprobs_k:
                 (tok, self.cache, self._history, self._hist_slot,
                  lpv_d, lpi_d) = out
@@ -1875,7 +2275,14 @@ class BatchGenerator:
             "decode_dispatches": self._n_decode_dispatches,
             "admit_dispatches": self._n_admit_dispatches,
             "prefix_hits": self._prefix_hits,
-            "prefix_entries": len(self._prefix_store),
+            "prefix_entries": (
+                len(self._prefix_tree) if self._paged
+                and self._prefix_tree is not None
+                else len(self._prefix_store)
+            ),
+            "kv_layout": "paged" if self._paged else "slot",
+            **({"kvpool": self._pagepool.stats()}
+               if self._paged and self._pagepool is not None else {}),
             "spec_dispatches": self._n_spec_dispatches,
             "spec_chains": self._n_spec_chains,
             "tokens_per_dispatch": (

@@ -256,14 +256,23 @@ def _make_handler(server: ApiServer):
                 # level: balancers route on the code, not the body. The
                 # body carries the cheap load fields the gateway's p2c
                 # signal reads — one GET, not a /metrics scrape.
-                self._json(200 if not st["draining"] else 503, {
+                body = {
                     "ok": not st["draining"],
                     "draining": st["draining"],
                     "queued": st["queued"],
                     "running": st["running"],
                     "max_concurrent": st["max_concurrent"],
                     "tok_s_ema": st["observed_tok_s"],
-                })
+                }
+                eng_st = st.get("engine")
+                kv = (eng_st.get("kvpool")
+                      if isinstance(eng_st, dict) else None)
+                if kv:
+                    # paged-KV pressure rides the same cheap load body:
+                    # a pool out of free pages defers admissions even
+                    # when slots look open
+                    body["kv_pages_free"] = kv["pages_free"]
+                self._json(200 if not st["draining"] else 503, body)
             elif path == "/v1/models":
                 eng = scheduler.engine
                 self._json(200, {"object": "list", "data": [{

@@ -16,6 +16,12 @@ the end-to-end proof that grammar-constrained decoding produced valid
 JSON through the whole HTTP plane. Needs a server-side tokenizer.
 Invalid responses land in ``json_invalid`` (nonzero exit).
 
+``--workload churn`` (ISSUE 11) is the admission/retirement regime the
+paged KV pool (cake_tpu/kvpool) exists for: Poisson arrivals, a
+short/long prompt-length mix, and every Nth client disconnecting
+mid-stream (``--disconnect-every``), so slot churn is drivable over
+HTTP instead of only in-process.
+
 ``--retry-429`` makes a 429 honor its ``Retry-After`` and resubmit
 (bounded) instead of counting a hard rejection — the realistic open-loop
 client against a saturated server or gateway. ``--spawn-backends N``
@@ -70,10 +76,14 @@ def _percentile(xs: list[float], q: float) -> float:
     return s[i]
 
 
-def _one_request(url: str, body: dict, timeout: float) -> dict:
+def _one_request(url: str, body: dict, timeout: float,
+                 abort_after: int | None = None) -> dict:
     """Fire one streaming completions request; measure TTFT (first SSE
     token event), per-token gaps, and end-to-end wall. Returns a result
-    dict ({"error"/"status": ...} on failure)."""
+    dict ({"error"/"status": ...} on failure). ``abort_after``: walk away
+    after that many tokens — the early-disconnect client the churn
+    workload injects (the server must reap the slot/KV, not the
+    client)."""
     req = urllib.request.Request(
         url.rstrip("/") + "/v1/completions",
         data=json.dumps(body).encode(),
@@ -114,6 +124,12 @@ def _one_request(url: str, body: dict, timeout: float) -> dict:
                     out["ids"].append(ev["token"])
                     if ev.get("text"):
                         out["text"] += ev["text"]
+                    if abort_after and out["tokens"] >= abort_after:
+                        # early disconnect: close mid-stream (the with
+                        # block tears the connection down) and leave the
+                        # server to cancel + reap the slot
+                        out["disconnected"] = True
+                        break
                 elif "error" in ev:
                     out["error"] = ev["error"]
                     break
@@ -152,17 +168,37 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
              rate: float | None = None, seed: int = 0,
              prompts: list[str] | None = None, stream: bool = True,
              timeout: float = 300.0, workload: str = "text",
-             retry_429: bool = False) -> dict:
+             retry_429: bool = False,
+             disconnect_every: int | None = None) -> dict:
     """Run the load; returns aggregate stats (also the in-process entry
     the bench row and tests use). ``workload="json"`` attaches the
     schema constraint to every request and json-validates every
-    response's text. ``retry_429`` makes a 429 response honor its
+    response's text. ``workload="churn"`` is the admission/retirement
+    regime (ISSUE 11): Poisson arrivals (defaults ``rate`` to ~2x the
+    concurrency when unset), a short/long prompt-length mix (defaults
+    the mix to 8,64), and every ``disconnect_every``-th client walking
+    away mid-stream (defaults to 4) — the slot-churn traffic shape the
+    paged KV pool exists for, drivable over HTTP instead of only
+    in-process. ``retry_429`` makes a 429 response honor its
     ``Retry-After`` and resubmit (bounded) instead of counting a hard
     rejection — the honest open-loop behavior against a saturated
     server or gateway (a real client backs off; it does not give up)."""
-    if workload not in ("text", "json"):
-        raise ValueError(f"workload must be 'text' or 'json', "
+    if workload not in ("text", "json", "churn"):
+        raise ValueError(f"workload must be 'text', 'json' or 'churn', "
                          f"got {workload!r}")
+    if workload == "churn":
+        # churn shape unless the caller pinned its own knobs (None is the
+        # unset sentinel — an explicit 0 really means "never disconnect")
+        if prompt_lens is None:
+            prompt_lens = [8, 64]
+        if rate is None:
+            rate = max(2.0, 2.0 * concurrency)
+        if disconnect_every is None:
+            disconnect_every = 4
+        if not stream:
+            raise ValueError("workload='churn' needs streaming responses "
+                             "(early disconnects abort an SSE stream)")
+    disconnect_every = disconnect_every or 0
     frags = _make_prompts(n, prompt_lens or [8], vocab, seed, prompts or [])
     results: list[dict] = [None] * n  # type: ignore[list-item]
     t_start = time.perf_counter()
@@ -172,7 +208,10 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         if workload == "json":
             body["response_format"] = {"type": "json_schema",
                                        "schema": JSON_WORKLOAD_SCHEMA}
-        r = _one_request(url, body, timeout)
+        abort_after = (2 if disconnect_every
+                       and i % disconnect_every == disconnect_every - 1
+                       else None)
+        r = _one_request(url, body, timeout, abort_after=abort_after)
         tries = 0
         while retry_429 and r.get("status") == 429 and tries < 8:
             try:
@@ -181,7 +220,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
                 delay = 1.0
             time.sleep(min(max(delay, 0.0), 30.0))
             tries += 1
-            r = _one_request(url, body, timeout)
+            r = _one_request(url, body, timeout, abort_after=abort_after)
         if tries:
             r["retries_429"] = tries
         results[i] = r
@@ -226,6 +265,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     rejected = [r for r in results if r and r.get("status") == 429]
     errors = [r for r in results if r and (
         "error" in r or ("status" in r and r["status"] != 429))]
+    disconnected = sum(1 for r in results if r and r.get("disconnected"))
     json_invalid = 0
     if workload == "json":
         for r in done:
@@ -244,6 +284,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         "retried_429": sum(r.get("retries_429", 0)
                            for r in results if r),
         "errors": len(errors),
+        "disconnected": disconnected,
         "json_invalid": json_invalid,
         "wall_s": round(wall, 3),
         "tokens": total_tokens,
@@ -322,9 +363,10 @@ def main(argv=None) -> int:
                    help="open-loop Poisson arrival rate (req/s); omit for "
                         "closed loop")
     p.add_argument("--max-tokens", type=int, default=32, dest="max_tokens")
-    p.add_argument("--prompt-len", default="8", dest="prompt_len",
+    p.add_argument("--prompt-len", default=None, dest="prompt_len",
                    help="comma-separated prompt-length mix for random "
-                        "prompt_ids requests (cycled per request)")
+                        "prompt_ids requests (cycled per request; "
+                        "default 8, or 8,64 for --workload churn)")
     p.add_argument("--vocab", type=int, default=256,
                    help="vocab bound for the random prompt ids")
     p.add_argument("--prompt", action="append", default=[],
@@ -332,10 +374,21 @@ def main(argv=None) -> int:
                         "server-side tokenizer; overrides --prompt-len)")
     p.add_argument("--no-stream", action="store_true",
                    help="unary JSON responses instead of SSE")
-    p.add_argument("--workload", choices=["text", "json"], default="text",
+    p.add_argument("--workload", choices=["text", "json", "churn"],
+                   default="text",
                    help="json: schema-constrained requests "
                         "(response_format json_schema), responses "
-                        "asserted json.loads-parseable")
+                        "asserted json.loads-parseable. churn: the "
+                        "admission/retirement regime — Poisson arrivals "
+                        "(--rate defaults to 2x concurrency), a "
+                        "short/long prompt mix (--prompt-len defaults "
+                        "to 8,64), every 4th client disconnecting "
+                        "mid-stream (--disconnect-every)")
+    p.add_argument("--disconnect-every", type=int, default=None,
+                   dest="disconnect_every", metavar="N",
+                   help="every Nth request walks away after 2 tokens "
+                        "(0 = never; churn workload defaults to 4) — "
+                        "the server must reap the slot and its KV")
     p.add_argument("--retry-429", action="store_true", dest="retry_429",
                    help="honor Retry-After on a 429 and resubmit "
                         "(bounded) instead of counting a hard rejection "
@@ -353,7 +406,8 @@ def main(argv=None) -> int:
         p.error("--spawn-backends must be >= 1")
     if args.url is None and args.spawn_backends is None:
         p.error("a server url is required (or --spawn-backends N)")
-    lens = [int(x) for x in args.prompt_len.split(",") if x.strip()]
+    lens = ([int(x) for x in args.prompt_len.split(",") if x.strip()]
+            if args.prompt_len else None)
     url, cleanup = args.url, None
     if args.spawn_backends:
         gateway, cleanup = spawn_fleet(args.spawn_backends)
@@ -365,6 +419,7 @@ def main(argv=None) -> int:
             rate=args.rate, seed=args.seed, prompts=args.prompt,
             stream=not args.no_stream, timeout=args.timeout,
             workload=args.workload, retry_429=args.retry_429,
+            disconnect_every=args.disconnect_every,
         )
     finally:
         if cleanup is not None:

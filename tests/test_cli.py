@@ -332,6 +332,23 @@ def test_failure_domain_flags_rejected_in_worker_mode(model_dir):
     assert "master process" in str(e.value)
 
 
+def test_kv_layout_flags_validated(model_dir):
+    """--kv-layout paged rides the batched serving engine (serve /
+    --prompts-file); elsewhere — and for the page knobs without paged —
+    the CLI errors loudly instead of silently ignoring the layout."""
+    from cake_tpu import cli
+
+    with pytest.raises(SystemExit) as e:
+        cli.main(["--model", str(model_dir), "--prompt-ids", "1", "--cpu",
+                  "-n", "1", "--kv-layout", "paged"])
+    assert "--kv-layout paged" in str(e.value)
+    for flag, val in (("--kv-page-size", "8"), ("--kv-pool-pages", "64")):
+        with pytest.raises(SystemExit) as e:
+            cli.main(["--model", str(model_dir), "--prompt-ids", "1",
+                      "--cpu", "-n", "1", flag, val])
+        assert "--kv-layout paged" in str(e.value)
+
+
 def test_serve_flags_need_serve_mode(model_dir):
     """--serve-port/--max-concurrent/... configure the HTTP serving plane;
     on the one-shot master/worker paths they must error loudly instead of
