@@ -152,6 +152,21 @@ kv-smoke:
 	CAKE_BENCH_KVPOOL=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
 
+# disagg smoke: the disaggregated prefill/decode tiers (cake_tpu/disagg)
+# — KV-page snapshot round trips bit-identical to an uninterrupted
+# stream (greedy + sampled, none/bf16/int8 codecs, constrained streams
+# resuming mid-grammar, mid-window multi-page), import-into-full-pool
+# deferring FIFO-fair, pinned transfer pages surviving eviction storms,
+# transfer-channel chaos (kill/truncate/corrupt/stall) recovered by
+# retry, and the gateway two-stage route (prefill tier -> transfer ->
+# decode resume) bit-identical end to end with transparent re-prefill
+# on a dead channel — then the CAKE_BENCH_DISAGG tiered-vs-mixed
+# decode-tier TPOT p95 row under the mixed-prefill workload.
+disagg-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_disagg.py -q -m 'not slow'
+	CAKE_BENCH_DISAGG=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  JAX_PLATFORMS=cpu $(PY) bench.py
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -162,7 +177,7 @@ kv-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -181,4 +196,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke perf-smoke deploy clean

@@ -45,6 +45,14 @@ Knobs (env):
                      steady batch, legs interleaved A/B/A/B —
                      vs_baseline = churn_paged/steady_paged (ROADMAP's
                      within-25% churn target).
+  CAKE_BENCH_DISAGG=1 disaggregated prefill/decode tiers
+                     (cake_tpu/disagg): the mixed-prefill workload
+                     against a tiered fleet (1 prefill + 1 decode, KV
+                     pages over the transfer channel) vs 2 mixed
+                     replicas, legs interleaved A/B/A/B — decode-tier
+                     TPOT p95 with vs_baseline = tiered/mixed (< 1.0 =
+                     the tier split wins), TTFT p95 split by prompt
+                     bucket.
 """
 
 from __future__ import annotations
@@ -811,6 +819,161 @@ def _run_gateway_http(config, params, preset, quant, dev, batch,
     return 0
 
 
+def _run_disagg(config, params, preset, quant, dev, batch, steps) -> int:
+    """CAKE_BENCH_DISAGG=1: the disaggregated prefill/decode tiers
+    (cake_tpu/disagg) under the interference regime they exist for — the
+    mixed-prefill workload (bimodal prompt lengths, Poisson arrivals)
+    against a TIERED fleet (1 prefill + 1 decode replica, KV pages
+    shipped over the transfer channel) vs 2 MIXED replicas, both behind
+    a routing gateway, legs interleaved A/B/A/B. The figure of merit is
+    the decode-tier TPOT p95 (long neighbors' prefill dispatches no
+    longer interleave with anyone's decode) with vs_baseline =
+    tiered/mixed (< 1.0 = the tier split pays for its transfer hop);
+    TTFT p95 rides along split by prompt bucket."""
+    from cake_tpu.disagg import TransferServer
+    from cake_tpu.gateway.api import start_gateway
+    from cake_tpu.gateway.health import Backend, HealthMonitor
+    from cake_tpu.gateway.policy import make_policy
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    kv_quant = _kv_quant()
+    batch = max(2, batch)
+    max_tokens = max(4, min(steps, 32))
+    # the bimodal mix: chatty short prompts next to long-document ones
+    # (the long bucket is capped so prompt + decode fits the window)
+    short_len = 8
+    long_len = max(short_len * 2,
+                   min(512, config.max_seq_len - max_tokens - 8))
+    n_req = 4 * batch
+    rate = max(2.0, 1.5 * batch)
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+
+    def _stack(role):
+        gen = BatchGenerator(config, params, settings=settings,
+                             kv_quant=kv_quant, kv_layout="paged")
+        sched = Scheduler(gen, queue_depth=4 * batch, role=role)
+        sched.start(max_concurrent=batch, warm_prompt_len=8)
+        return start_api_server(sched), sched
+
+    def _fleet(roles, tag):
+        stacks = [_stack(r) for r in roles]
+        xfers = []
+        for _, sched in stacks:
+            if sched.role == "decode":
+                ts = TransferServer(sched).start()
+                sched.transfer_port = ts.port
+                xfers.append(ts)
+        monitor = HealthMonitor(
+            [Backend(f"{tag}{i}", f"127.0.0.1:{srv.port}")
+             for i, (srv, _) in enumerate(stacks)],
+            probe_interval=0.5).start()
+        gw = start_gateway(monitor, make_policy("p2c"))
+        deadline = time.monotonic() + 15.0
+        want = {r for r in roles if r != "mixed"}
+        while time.monotonic() < deadline and want:
+            if want <= {b.role for b in monitor.routable()}:
+                break
+            time.sleep(0.05)
+
+        def close():
+            gw.close()
+            monitor.stop()
+            for ts in xfers:
+                ts.stop()
+            for srv, sched in stacks:
+                srv.close()
+                sched.close()
+
+        return f"http://127.0.0.1:{gw.port}", close
+
+    def _leg(url, seed):
+        return loadgen.run_load(
+            url, n_req, concurrency=batch, max_tokens=max_tokens,
+            prompt_lens=[short_len, long_len],
+            vocab=config.vocab_size - 1, rate=rate, seed=seed,
+            workload="mixed-prefill")
+
+    tiered_url, tiered_close = _fleet(["prefill", "decode"], "dt")
+    mixed_url, mixed_close = _fleet(["mixed", "mixed"], "dm")
+    tiered_legs, mixed_legs = [], []
+    try:
+        # warm both fleets (compiles, transfer channel, gateway probes),
+        # then interleave the measured legs A/B/A/B
+        _leg(tiered_url, 1)
+        _leg(mixed_url, 1)
+        for rep in range(2):
+            tiered_legs.append(_leg(tiered_url, 2 + rep))
+            mixed_legs.append(_leg(mixed_url, 2 + rep))
+    finally:
+        tiered_close()
+        mixed_close()
+
+    def _agg(legs):
+        gaps = [g for s in legs for r in s["results"]
+                if r for g in r.get("gaps_s", ())]
+        ttfts = [r["ttft_s"] for s in legs for r in s["results"]
+                 if r and r.get("ttft_s") is not None]
+        gaps.sort()
+        ttfts.sort()
+
+        def pct(xs, q):
+            return round(
+                xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))] * 1e3,
+                2) if xs else 0.0
+
+        by_len = {}
+        for s in legs:
+            for ln, st in s.get("ttft_ms_by_prompt_len", {}).items():
+                by_len.setdefault(ln, []).append(st["p95"])
+        return {
+            "tpot_p95_ms": pct(gaps, 0.95),
+            "tpot_p50_ms": pct(gaps, 0.5),
+            "ttft_p95_ms": pct(ttfts, 0.95),
+            "ttft_p95_by_len": {ln: round(max(v), 1)
+                                for ln, v in sorted(by_len.items())},
+            "completed": sum(s["completed"] for s in legs),
+            "errors": sum(s["errors"] for s in legs),
+            "tok_s": round(sum(s["tokens"] for s in legs)
+                           / max(1e-9, sum(s["wall_s"] for s in legs)),
+                           2),
+        }
+
+    tiered, mixed = _agg(tiered_legs), _agg(mixed_legs)
+    if (tiered["errors"] or mixed["errors"]
+            or tiered["completed"] != 2 * n_req
+            or mixed["completed"] != 2 * n_req):
+        sys.stderr.write(f"disagg bench failed: tiered={tiered} "
+                         f"mixed={mixed}\n")
+        return 1
+    ratio = (tiered["tpot_p95_ms"] / mixed["tpot_p95_ms"]
+             if mixed["tpot_p95_ms"] else 0.0)
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": (f"disagg_decode_tpot_p95_ms_{_mtag(preset)}_{wtag}_"
+                   f"1chip_c{batch}"),
+        "value": tiered["tpot_p95_ms"],
+        "unit": "ms",
+        "vs_baseline": round(ratio, 4),
+    }, dev,
+        baseline=f"mixed_fleet_{mixed['tpot_p95_ms']}ms",
+        tiered=tiered, mixed=mixed,
+        prompt_lens=[short_len, long_len], max_tokens=max_tokens,
+        requests_per_leg=n_req, rate_rps=rate, interleaved_reps=2)
+    sys.stderr.write(
+        f"device={dev.device_kind} clients={batch} "
+        f"prompts={short_len}/{long_len} "
+        f"tiered tpot_p95={tiered['tpot_p95_ms']}ms "
+        f"ttft_p95={tiered['ttft_p95_ms']}ms | "
+        f"mixed tpot_p95={mixed['tpot_p95_ms']}ms "
+        f"ttft_p95={mixed['ttft_p95_ms']}ms | ratio={ratio:.3f}\n"
+    )
+    return 0
+
+
 class _AsciiTok:
     """Printable-ASCII toy tokenizer for the constrained-serving row: id
     -> one printable char (mod 95), so grammar compilation has real vocab
@@ -1482,6 +1645,9 @@ def main() -> int:
     if os.environ.get("CAKE_BENCH_GATEWAY") == "1":
         return _run_gateway_http(config, params, preset, quant, dev,
                                  batch, steps)
+    if os.environ.get("CAKE_BENCH_DISAGG") == "1":
+        return _run_disagg(config, params, preset, quant, dev,
+                           max(2, batch), steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

@@ -318,6 +318,32 @@ def build_parser() -> argparse.ArgumentParser:
                         "log-softmax, so requests may ask 'logprobs': N "
                         "for any N <= K (default 0: refused with 400; "
                         "needs the batched mesh engine)")
+    p.add_argument("--role", choices=["mixed", "prefill", "decode"],
+                   default="mixed",
+                   help="--mode serve: replica tier (cake_tpu/disagg) — "
+                        "mixed (default) runs the classic everything-"
+                        "replica; prefill runs bucketed prefill only and "
+                        "ships the finished KV pages to a decode replica "
+                        "over the transfer channel; decode imports pages "
+                        "and runs only the steady-state batched step "
+                        "(both need --kv-layout paged)")
+    p.add_argument("--transfer-port", type=int, default=None,
+                   dest="transfer_port", metavar="PORT",
+                   help="--mode serve: KV transfer-channel listener port "
+                        "(0 = ephemeral; advertised on /healthz as "
+                        "transfer_port so the gateway's tier map finds "
+                        "it). Defaults to ephemeral for --role decode; "
+                        "setting it on a mixed replica lets it accept "
+                        "imports too (session resume without a tier "
+                        "split)")
+    p.add_argument("--transfer-codec", choices=["none", "bf16", "int8"],
+                   default="none", dest="transfer_codec",
+                   help="--mode serve: per-page codec for exported KV "
+                        "snapshots (the --wire-codec path; default "
+                        "none). Round trips are bit-identical whenever "
+                        "the codec is lossless for the cache dtype — "
+                        "none always, bf16 on a bf16 cache, int8 on an "
+                        "int8-quantized pool")
     # -- routing gateway (--mode gateway: cake_tpu/gateway) ------------------
     p.add_argument("--backends", default=None, metavar="HOST:PORT,...",
                    help="--mode gateway: comma-separated serve-replica "
@@ -635,6 +661,12 @@ def _serve_flags(args) -> list[str]:
         out.append("--request-timeout")
     if args.serve_logprobs:
         out.append("--serve-logprobs")
+    if args.role != "mixed":
+        out.append("--role")
+    if args.transfer_port is not None:
+        out.append("--transfer-port")
+    if args.transfer_codec != "none":
+        out.append("--transfer-codec")
     return out
 
 
@@ -684,6 +716,13 @@ def run_http_serve(args) -> int:
                  "serve (arrivals prefill chunk-by-chunk through the "
                  "admission path instead; it would otherwise be silently "
                  "ignored)")
+    if args.role != "mixed" and args.kv_layout != "paged":
+        sys.exit(f"error: --role {args.role} moves KV between replicas "
+                 "as pool pages; it requires --kv-layout paged")
+    if args.role == "prefill" and args.transfer_port is not None:
+        sys.exit("error: --transfer-port opens the IMPORT listener; a "
+                 "prefill replica only exports (its targets arrive "
+                 "per-request from the gateway)")
 
     config = _load_config(args)
     tokenizer = _load_tokenizer(args.model)
@@ -786,13 +825,33 @@ def run_http_serve(args) -> int:
         # of any length share the chunked program for this bucket)
         warm_len = min(64, engine.max_seq // 2)
 
-    scheduler = Scheduler(engine, queue_depth=queue_depth,
-                          request_timeout_s=request_timeout)
+    try:
+        scheduler = Scheduler(engine, queue_depth=queue_depth,
+                              request_timeout_s=request_timeout,
+                              role=args.role,
+                              transfer_codec=args.transfer_codec)
+    except ValueError as e:
+        sys.exit(f"error: {e}")
     # warm the masked (constrained-decoding) program too when requests
     # could carry response_format — i.e. whenever a tokenizer is loaded
     # (grammars compile against the vocab's decoded strings)
     scheduler.start(max_concurrent=max_concurrent, warm_prompt_len=warm_len,
                     warm_constrain=tokenizer is not None)
+
+    # KV transfer listener (cake_tpu/disagg): a decode replica always
+    # accepts imports (ephemeral port unless pinned); a mixed replica
+    # only when --transfer-port asked for one (session suspend/resume
+    # without a tier split). Its port rides /healthz so the gateway's
+    # tier map discovers it.
+    xfer_server = None
+    if args.role == "decode" or args.transfer_port is not None:
+        from cake_tpu.disagg import TransferServer
+
+        xfer_server = TransferServer(scheduler, bind=serve_bind,
+                                     port=args.transfer_port or 0).start()
+        scheduler.transfer_port = xfer_server.port
+        log.info("KV transfer channel on %s:%d (--role %s)", serve_bind,
+                 xfer_server.port, args.role)
 
     def serve_status():
         return {
@@ -838,6 +897,8 @@ def run_http_serve(args) -> int:
         stop.wait()
     finally:
         server.drain(timeout_s=request_timeout)
+        if xfer_server is not None:
+            xfer_server.stop()
         if status_httpd is not None:
             status_httpd.shutdown()
             status_httpd.server_close()
@@ -906,6 +967,9 @@ def run_gateway(args) -> int:
         ("--max-concurrent", args.max_concurrent is not None),
         ("--queue-depth", args.queue_depth is not None),
         ("--serve-logprobs", bool(args.serve_logprobs)),
+        ("--role", args.role != "mixed"),
+        ("--transfer-port", args.transfer_port is not None),
+        ("--transfer-codec", args.transfer_codec != "none"),
     ) if on]
     if engine_flags:
         sys.exit(f"error: {'/'.join(engine_flags)} configure a serve "

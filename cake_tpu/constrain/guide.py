@@ -30,10 +30,18 @@ DEAD_ENDS = obs_metrics.counter("constrain.dead_ends")
 
 
 class Guide:
-    """Host-side DFA cursor for one constrained stream."""
+    """Host-side DFA cursor for one constrained stream.
 
-    def __init__(self, dfa: TokenDFA):
+    ``spec`` (optional) is the serve-plane ``response_format`` body the
+    DFA compiled from. Carrying it lets the disagg plane export a
+    constrained stream mid-grammar: the snapshot ships the spec + the
+    integer cursor, and the importer recompiles the (cached) DFA and
+    resumes exactly where the exporter stopped.
+    """
+
+    def __init__(self, dfa: TokenDFA, spec: dict | None = None):
         self.dfa = dfa
+        self.spec = spec
         self.state = dfa.start
 
     def reset(self) -> None:
@@ -80,4 +88,4 @@ def guide_for(spec: dict, tokenizer, config) -> Guide:
     pattern = spec_to_regex(spec)
     vocab = cached_token_strings(tokenizer, config.vocab_size)
     dfa = compile_constraint(pattern, vocab, eos_ids=config.eos_ids())
-    return Guide(dfa)
+    return Guide(dfa, spec=spec)

@@ -1,0 +1,47 @@
+"""Disaggregated prefill/decode serving: KV-page export/import + transfer.
+
+The paper's design — and this repo's serve plane until ISSUE 13 — walks
+every request through one engine: a long prefill dispatch on a mixed
+replica inflates every decoding neighbor's TPOT, and TTFT p95 is hostage
+to batch composition. This package splits the two phases across replica
+tiers:
+
+- :mod:`cake_tpu.disagg.snapshot` — a versioned, self-describing
+  snapshot of one stream's KV pages + sampler/cursor state
+  (``BatchGenerator.export_stream`` / ``import_stream``), serialized
+  per-page through the existing wire activation codec
+  (``--wire-codec none|bf16|int8``). Round-trips are bit-identical to an
+  uninterrupted stream whenever the codec is lossless for the cache
+  dtype (``none`` always; ``bf16`` on a bf16 cache; ``int8`` on an
+  int8-quantized pool) — which alone buys session suspend/resume and
+  multi-turn reconnection;
+- :mod:`cake_tpu.disagg.transfer` — the length-prefixed transfer channel
+  between replicas: :mod:`cake_tpu.runtime.wire` framing (magic + type +
+  length + CRC trailer) with retry/backoff on
+  :class:`cake_tpu.runtime.retry.RetryPolicy`, so the chaos proxy and
+  every recovery lesson of the worker wire plane apply verbatim.
+
+The serve plane grows ``--role prefill|decode|mixed`` on top
+(``serve/scheduler.py``): prefill replicas run bucketed prefill only and
+hand the finished pages to a decode replica; decode replicas import
+pages straight into the pool (page-table edits, no cache-tensor
+splices) and run only the steady-state batched step. The gateway
+(``gateway/api.py``) learns the two-stage route — prefill tier by queue
+depth, decode tier by p2c + prefix affinity — with fallback to mixed
+replicas and transparent re-prefill on a transfer failure.
+"""
+
+from cake_tpu.disagg.snapshot import (  # noqa: F401
+    SNAPSHOT_VERSION,
+    SnapshotError,
+    SnapshotMismatch,
+    decode_snapshot,
+    encode_snapshot,
+    peek_xfer_id,
+)
+from cake_tpu.disagg.transfer import (  # noqa: F401
+    TransferError,
+    TransferRejected,
+    TransferServer,
+    send_snapshot,
+)

@@ -49,6 +49,12 @@ RETRIES = obs_metrics.counter("gateway.retries")
 REJECTED = obs_metrics.counter("gateway.rejected")
 SATURATED = obs_metrics.counter("gateway.saturated")
 ADDED_MS = obs_metrics.histogram("gateway.added_ms")
+# disagg two-stage routing (cake_tpu/disagg): tiered routes that went
+# prefill -> transfer -> decode resume end-to-end, and fallbacks that
+# re-prefilled the request on the classic path after a tiered-path
+# failure (transfer lost, import expired, decode replica gone)
+HANDOFFS = obs_metrics.counter("disagg.handoffs")
+REPREFILLS = obs_metrics.counter("disagg.reprefills")
 
 _HOP_HEADERS = ("Content-Type", "Cache-Control", "Retry-After")
 
@@ -227,10 +233,16 @@ def _make_handler(server: GatewayServer):
                 ups = monitor.routable()
                 draining = server.is_draining()
                 ok = bool(ups) and not draining
+                tiers: dict[str, int] = {}
+                for b in ups:
+                    tiers[b.role] = tiers.get(b.role, 0) + 1
                 self._json(200 if ok else 503, {
                     "ok": ok,
                     "draining": draining,
                     "backends_up": len(ups),
+                    # the tier map: two-stage routing engages while both
+                    # "prefill" and "decode" are nonzero here
+                    "tiers": tiers,
                     "backends": {b.name: b.state
                                  for b in monitor.backends},
                 })
@@ -309,11 +321,23 @@ def _make_handler(server: GatewayServer):
                     key = policy_mod.prefix_key(body,
                                                 server.prefix_block)
             t0 = time.perf_counter()
+            # two-stage tiered route (cake_tpu/disagg): when the fleet
+            # has both a prefill and a decode tier, prefill runs on one
+            # replica and the KV pages ship to another that decodes.
+            # Any tiered-path failure falls through to the classic loop
+            # below — the transparent re-prefill (the client never
+            # learns the tiered attempt happened).
+            if self._tiered_completions(raw, t0):
+                return
             tried: list = []
             last_429: tuple | None = None
             while True:
                 now = time.monotonic()
-                cands = [b for b in monitor.routable() if b not in tried]
+                # prefill-tier replicas refuse plain completions by
+                # contract (serve 400s them loudly); the classic path
+                # routes over everything else
+                cands = [b for b in monitor.routable()
+                         if b not in tried and b.role != "prefill"]
                 if not cands:
                     if last_429 is not None:
                         # every routable backend is saturated: only now
@@ -340,12 +364,108 @@ def _make_handler(server: GatewayServer):
                 if isinstance(outcome, tuple):  # a 429: remember, go on
                     last_429 = outcome
 
-        def _try_backend(self, b: Backend, raw: bytes, t0: float):
+        def _tiered_completions(self, raw: bytes, t0: float) -> bool:
+            """The disagg two-stage route. Returns True when a response
+            reached the client; False means "route classically" — a
+            tier is empty, the body opted out, or the tiered attempt
+            failed somewhere recoverable (the transparent re-prefill:
+            the classic path redoes the prefill on a mixed/decode
+            replica and the client never learns)."""
+            now = time.monotonic()
+            routable = monitor.routable()
+            prefill_tier = [b for b in routable if b.role == "prefill"]
+            decode_tier = [b for b in routable
+                           if b.role == "decode" and b.transfer_addr()]
+            if not prefill_tier or not decode_tier:
+                return False
+            try:
+                body = json.loads(raw or b"{}")
+            except ValueError:
+                return False  # malformed: let the backend 400 it
+            if not isinstance(body, dict) or "_disagg" in body \
+                    or "_resume" in body:
+                return False  # the caller drives its own disagg route
+            key = policy_mod.prefix_key(body, server.prefix_block)
+            dec = policy_mod.pick_decode(decode_tier, key=key, now=now)
+            if dec.role != "decode":  # prober raced a role flip: loud
+                log.error("decode-tier pick %s (%s) no longer advertises "
+                          "role=decode (now %r); refusing the tiered "
+                          "route", dec.name, dec.addr, dec.role)
+                return False
+            pre = policy_mod.pick_prefill(prefill_tier)
+            xfer_id = self._handoff(pre, body, dec)
+            if xfer_id is None:
+                REPREFILLS.inc()
+                return False
+            rraw = json.dumps(
+                dict(body, _resume={"xfer_id": xfer_id})).encode()
+            dec.requests.inc()
+            # 409 = the decode import is gone (TTL raced, replica
+            # restarted): bounce instead of relaying — the classic path
+            # re-prefills and the stream is reproduced bit-identically
+            outcome = self._try_backend(dec, rraw, t0, bounce=(409,))
+            if outcome == "done":
+                HANDOFFS.inc()
+                return True
+            REPREFILLS.inc()
+            return False
+
+        def _handoff(self, pre: Backend, body: dict,
+                     dec: Backend) -> str | None:
+            """Stage 1: ask ``pre`` to prefill and ship the KV pages to
+            ``dec``'s transfer channel. Returns the transfer id to
+            resume, or None when the tiered path must fall back."""
+            praw = json.dumps(
+                dict(body, _disagg={"target": dec.transfer_addr()})
+            ).encode()
+            pre.requests.inc()
+            att = _Attempt(pre, server.connect_timeout,
+                           server.read_timeout)
+            try:
+                try:
+                    resp = att.send("POST", "/v1/completions", praw)
+                    data = resp.read()
+                except OSError as e:
+                    log.debug("prefill backend %s failed: %s",
+                              pre.name, e)
+                    pre.errors.inc()
+                    monitor.report_failure(pre)
+                    return None
+            finally:
+                att.close()
+            if resp.status == 429:
+                monitor.report_saturated(
+                    pre, _as_seconds(resp.getheader("Retry-After")))
+                return None
+            if resp.status == 503:
+                monitor.report_draining(pre)
+                return None
+            if resp.status != 200:
+                # a 502 is the TRANSFER failing (the prefill replica is
+                # alive and answered); 4xx/5xx all mean the same thing
+                # here: this route is off, re-prefill classically
+                log.debug("handoff via %s answered %d", pre.name,
+                          resp.status)
+                return None
+            try:
+                reply = json.loads(data)
+            except ValueError:
+                return None
+            monitor.report_success(pre)
+            if not (isinstance(reply, dict) and reply.get("handoff")
+                    and isinstance(reply.get("xfer_id"), str)):
+                return None
+            return reply["xfer_id"]
+
+        def _try_backend(self, b: Backend, raw: bytes, t0: float,
+                         bounce: tuple = ()):
             """One routed attempt. Returns ``"done"`` when a response
             (success or deterministic client error) reached the client,
             a ``(body, retry_after)`` tuple on 429, or ``None`` when the
             attempt failed and the retry loop should pick another
-            backend."""
+            backend. ``bounce``: statuses to swallow and return ``None``
+            for instead of relaying (the tiered route's 409 — the caller
+            re-prefills; nothing reaches the client)."""
             att = _Attempt(b, server.connect_timeout, server.read_timeout)
             try:
                 try:
@@ -356,6 +476,10 @@ def _make_handler(server: GatewayServer):
                               b.name, e)
                     b.errors.inc()
                     monitor.report_failure(b)
+                    return None
+                if resp.status in bounce:
+                    log.debug("backend %s bounced with %d", b.name,
+                              resp.status)
                     return None
                 if resp.status == 429:
                     monitor.report_saturated(

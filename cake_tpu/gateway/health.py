@@ -69,6 +69,8 @@ class Backend:
         "_saturated_until": "_lock",
         "_breaker_attempt": "_lock",
         "_next_probe_t": "_lock",
+        "_role": "_lock",
+        "_transfer_port": "_lock",
     }
 
     def __init__(self, name: str, addr: str):
@@ -90,6 +92,12 @@ class Backend:
         self._saturated_until = 0.0
         self._breaker_attempt = 0
         self._next_probe_t = 0.0
+        # disagg tier map (cake_tpu/disagg): the replica's own /healthz
+        # body states its role and transfer address — the prober RECORDS
+        # what it discovered rather than trusting static config, so a
+        # decode-tier route can never silently land on a prefill replica
+        self._role = "mixed"
+        self._transfer_port = 0
         # per-backend traffic/health series (dynamic gateway.* family)
         self.requests = obs_metrics.counter(f"gateway.{name}.requests")
         self.retries = obs_metrics.counter(f"gateway.{name}.retries")
@@ -106,6 +114,27 @@ class Backend:
     def routable(self) -> bool:
         with self._lock:
             return self._state == UP
+
+    @property
+    def role(self) -> str:
+        """The role the last probe DISCOVERED ("mixed" until a probe
+        says otherwise — a plain serve replica advertises mixed)."""
+        with self._lock:
+            return self._role
+
+    def transfer_addr(self) -> str | None:
+        """``host:port`` of the replica's KV transfer channel, or None
+        when it advertises none (it cannot be a decode-tier target)."""
+        with self._lock:
+            port = self._transfer_port
+        return f"{self.host}:{port}" if port else None
+
+    def queue_score(self) -> float:
+        """Queued work — the prefill-tier routing signal (prefill cost
+        scales with waiting prompts, not decoding neighbors)."""
+        with self._lock:
+            return self._load["queued"] + self._load.get(
+                "kv_transfers_inflight", 0)
 
     def load_score(self) -> float:
         """Outstanding work per slot — the p2c comparison key."""
@@ -139,6 +168,9 @@ class Backend:
                 "name": self.name,
                 "addr": self.addr,
                 "state": self._state,
+                "role": self._role,
+                **({"transfer_addr": f"{self.host}:{self._transfer_port}"}
+                   if self._transfer_port else {}),
                 "load": dict(self._load),
                 "consecutive_failures": self._fails,
                 "requests": self.requests.value,
@@ -155,6 +187,15 @@ class Backend:
             for k in self._load:
                 if k in load:
                     self._load[k] = load[k]
+            if "kv_transfers_inflight" in load:
+                self._load["kv_transfers_inflight"] = \
+                    load["kv_transfers_inflight"]
+            role = load.get("role", "mixed")
+            if role != self._role:
+                log.info("backend %s (%s): role %s -> %s", self.name,
+                         self.addr, self._role, role)
+                self._role = role
+            self._transfer_port = int(load.get("transfer_port", 0) or 0)
             self._fails = 0
             self._oks += 1
             if self._state == DRAINING or (

@@ -146,6 +146,38 @@ class Prefix:
         return preferred
 
 
+def pick_prefill(candidates, rng: random.Random | None = None):
+    """Prefill-tier choice (the disagg two-stage route's first hop):
+    least queued work wins — a prefill replica's cost is its prompt
+    queue (plus KV transfers still draining), not decoding neighbors,
+    so queue depth is the whole signal and p2c's sampled-pair dance
+    buys nothing over just reading it. Ties break randomly so equal
+    replicas share the load."""
+    # snapshot scores once: the probe thread mutates load fields
+    # concurrently, and re-reading between min() and the tie filter
+    # could leave no backend matching the stale minimum
+    scored = [(b.queue_score(), b) for b in candidates]
+    best = min(score for score, _ in scored)
+    tied = [b for score, b in scored if score == best]
+    return (rng or random).choice(tied)
+
+
+_DECODE_PREFIX = Prefix()
+
+
+def pick_decode(candidates, key=None, now: float = 0.0,
+                rng: random.Random | None = None):
+    """Decode-tier choice (the two-stage route's second hop): p2c on the
+    live load signal, with prefix affinity when the request carries a
+    key — a decode replica's engine prefix store serves imported
+    streams too, so same-prefix resumes landing together keep their
+    shared pages hot. Delegates to the Prefix policy (tier-scoped), so
+    a saturated preferred replica falls back to p2c over the rest and
+    the affinity hit/fallback counters cover the tiered route too."""
+    policy = Prefix(rng=rng) if rng is not None else _DECODE_PREFIX
+    return policy.choose(candidates, key=key, now=now)
+
+
 def make_policy(name: str, prefix_block: int = 64,
                 rng: random.Random | None = None):
     """Policy registry (the ``--route-policy`` values)."""

@@ -14,7 +14,17 @@ instead of cache-tensor dispatches. A physical page is:
   which sits beyond every full prompt page it shares. Copy-on-write is
   therefore an allocation policy, not a trap: content that would be
   written into a partially-shared page is materialized into a fresh
-  owned page instead (counted by ``kvpool.cow_copies``).
+  owned page instead (counted by ``kvpool.cow_copies``);
+- **pinned**: held by an in-flight KV transfer (``pin``/``unpin`` — the
+  disagg export/import plane, :mod:`cake_tpu.disagg`). A pin is a claim
+  OUTSIDE stream tables and the prefix tree: a page a decode replica
+  imported but no stream has attached yet, or one an export still reads.
+  Refcounts used to assume only those two claim kinds existed; the pin
+  kind makes the third explicit, so eviction under pool pressure can
+  never free a page mid-transfer (the pin's reference protects it) and
+  admission deferral (``kvpool.admit_defers``) becomes reachable even
+  under the enforced pool sizing — pinned pages sit outside the
+  batch*pages_per_stream budget.
 
 Page 0 is the reserved **sink** page: every gather index that points
 beyond a stream's frontier — and every scatter index for a retired /
@@ -65,13 +75,16 @@ class PagePool:
         # histograms use): gauges must reflect THIS pool, not a predecessor
         self._free_g = obs_metrics.Gauge("kvpool.pages_free")
         self._shared_g = obs_metrics.Gauge("kvpool.pages_shared")
+        self._pinned_g = obs_metrics.Gauge("kvpool.pages_pinned")
         self._cow_ctr = obs_metrics.Counter("kvpool.cow_copies")
         self._evict_ctr = obs_metrics.Counter("kvpool.evictions")
         self._defer_ctr = obs_metrics.Counter("kvpool.admit_defers")
         obs_metrics.registry().publish(
-            self._free_g, self._shared_g, self._cow_ctr, self._evict_ctr,
-            self._defer_ctr)
+            self._free_g, self._shared_g, self._pinned_g, self._cow_ctr,
+            self._evict_ctr, self._defer_ctr)
         self._shared = 0  # pages with refcount > 1 (kept incrementally)
+        self._pins = [0] * num_pages  # transfer-pin claims per page
+        self._pinned = 0  # pages with >= 1 pin claim
         self._sync_gauges()
 
     # -- allocation -----------------------------------------------------------
@@ -115,9 +128,46 @@ class PagePool:
         self._sync_gauges()
         return freed
 
+    # -- transfer pins --------------------------------------------------------
+    def pin(self, pid: int) -> None:
+        """Take a TRANSFER claim on a live page (an in-flight export, or
+        an imported page no stream has attached yet). Counts as a
+        reference — eviction storms can drop every tree claim and every
+        sharing stream can retire, and the page still cannot return to
+        the free list (and so can never be reallocated and overwritten)
+        until the last pin drops."""
+        if pid == SINK:
+            return
+        self.ref(pid)
+        self._pins[pid] += 1
+        if self._pins[pid] == 1:
+            self._pinned += 1
+        self._sync_gauges()
+
+    def unpin(self, pid: int) -> bool:
+        """Drop one transfer claim; returns True when the page freed
+        (the transfer was its last claim)."""
+        if pid == SINK:
+            return False
+        if self._pins[pid] <= 0:
+            raise ValueError(f"unpin of unpinned page {pid}")
+        self._pins[pid] -= 1
+        if self._pins[pid] == 0:
+            self._pinned -= 1
+        return self.unref(pid)
+
     # -- views ----------------------------------------------------------------
     def refcount(self, pid: int) -> int:
         return self._refs[pid]
+
+    def pincount(self, pid: int) -> int:
+        return self._pins[pid]
+
+    @property
+    def pinned_count(self) -> int:
+        """Pages held by >= 1 in-flight transfer claim — the
+        ``kvpool.pages_pinned`` gauge."""
+        return self._pinned
 
     @property
     def free_count(self) -> int:
@@ -145,6 +195,7 @@ class PagePool:
     def _sync_gauges(self) -> None:
         self._free_g.set(len(self._free))
         self._shared_g.set(self._shared)
+        self._pinned_g.set(self._pinned)
 
     def stats(self) -> dict:
         return {
@@ -153,4 +204,5 @@ class PagePool:
             "pages_free": self.free_count,
             "pages_used": self.used_count,
             "pages_shared": self.shared_count,
+            "pages_pinned": self.pinned_count,
         }

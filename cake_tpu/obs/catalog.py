@@ -46,6 +46,34 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "token-DFA compiles that ran the vocab walk"),
     "constrain.fsm_compile_ms": (
         HISTOGRAM, "grammar -> token-DFA compile wall time"),
+    # -- disaggregated prefill/decode (cake_tpu/disagg) ------------------
+    "disagg.exports": (
+        COUNTER, "stream snapshots exported (prefill handoffs + session "
+                 "suspends)"),
+    "disagg.handoffs": (
+        COUNTER, "gateway two-stage routes completed (prefill -> "
+                 "transfer -> decode resume)"),
+    "disagg.import_aborts": (
+        COUNTER, "imports dropped unresumed (TTL expiry, cancelled "
+                 "resume, pool rebuild)"),
+    "disagg.imports": (
+        COUNTER, "snapshots whose pages landed in the local pool"),
+    "disagg.inflight": (
+        GAUGE, "KV transfers in flight on this replica (outgoing sends "
+               "+ imports awaiting resume) — the /healthz "
+               "kv_transfers_inflight field"),
+    "disagg.reprefills": (
+        COUNTER, "gateway fallbacks that re-prefilled a request after a "
+                 "tiered-path failure"),
+    "disagg.resumes": (
+        COUNTER, "imported streams attached to a slot and decoding"),
+    "disagg.transfer_bytes": (
+        HISTOGRAM, "snapshot payload size per completed transfer"),
+    "disagg.transfer_failures": (
+        COUNTER, "transfers that exhausted their retry budget or were "
+                 "rejected"),
+    "disagg.transfer_ms": (
+        HISTOGRAM, "export-to-ACK wall time per completed transfer"),
     # -- gateway (multi-replica routing front door) ----------------------
     "gateway.added_ms": (
         HISTOGRAM, "gateway-added latency ahead of the backend "
@@ -76,6 +104,9 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "prefix-tree page claims evicted to refill the free "
                  "list"),
     "kvpool.pages_free": (GAUGE, "pool pages on the free list"),
+    "kvpool.pages_pinned": (
+        GAUGE, "pages held by in-flight KV-transfer pins (claims outside "
+               "stream tables and the prefix tree)"),
     "kvpool.pages_shared": (
         GAUGE, "physical pages referenced more than once (streams and/or "
                "the prefix tree)"),

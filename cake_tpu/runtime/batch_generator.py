@@ -119,6 +119,20 @@ class _Stream:
 # attach, so the masked decode program compiles once per pow2 table shape
 _MASK_CAP0 = 64
 
+# Disaggregated-serving counters (cake_tpu/disagg): KV-page snapshots
+# leaving and entering this engine's pool. Process-wide get-or-create —
+# the serve scheduler and the gateway's tier map read the same story.
+_EXPORTS = obs_metrics.counter("disagg.exports")
+_IMPORTS = obs_metrics.counter("disagg.imports")
+_RESUMES = obs_metrics.counter("disagg.resumes")
+_IMPORT_ABORTS = obs_metrics.counter("disagg.import_aborts")
+
+# arrival-queue entry kinds (4th tuple field): None marks a plain prompt
+# arrival; imports ride the SAME FIFO so pool-pressure deferral stays
+# FIFO-fair between admissions and KV-page imports
+_ARR_IMPORT = "import"  # (xfer_id, None, None, _ARR_IMPORT)
+_ARR_ATTACH = "attach"  # (xfer_id, sid, None, _ARR_ATTACH)
+
 
 class BatchGenerator:
     """Serve N prompts concurrently over one sharded model instance.
@@ -237,6 +251,13 @@ class BatchGenerator:
         self._pagepool = None          # host free-list/refcounts (kvpool)
         self._prefix_tree = None       # page-granular shared-prefix trie
         self._tables: list[list[int]] = []  # per-slot physical page lists
+        # KV-page imports (cake_tpu/disagg): xfer_id -> record. Pages of
+        # a begun-but-unattached import are PINNED in the pool (a claim
+        # outside stream tables and the prefix tree — kvpool pin/unpin),
+        # so eviction storms under pressure can never free them before
+        # the resume attaches or the import is aborted.
+        self._imports: dict[str, dict] = {}
+        self._attach_failures: list[int] = []  # sids whose attach missed
         self._page_map_dev = None      # memoized device page map (tables
         #                                change rarely; scatter ids do not)
         self._staged_prefix = None     # set_prompts staged prefix row
@@ -801,6 +822,11 @@ class BatchGenerator:
             raise ValueError("stream_ids/prompts length mismatch")
         if guides is not None and len(guides) != len(ids_list):
             raise ValueError("guides/prompts length mismatch")
+        if self._paged and self._imports:
+            # the pool is rebuilt below (_init_pool): pending KV imports
+            # reference pages of the OLD pool and cannot survive
+            for xid in list(self._imports):
+                self.import_abort(xid)
         self._guides = {}
         self._guide_rows = {}
 
@@ -989,7 +1015,13 @@ class BatchGenerator:
         ValueError into a 400) rather than at attach time on the engine
         thread (where it would read as an engine fault)."""
         self._check_guide_ok(guide)
-        self._arrivals.append((self._encode(prompt), stream_id, guide))
+        self._arrivals.append((self._encode(prompt), stream_id, guide, None))
+
+    @property
+    def paged(self) -> bool:
+        """Paged KV layout (the disagg plane's capability gate: KV moves
+        between engines as pool pages)."""
+        return self._paged
 
     def pending_admissions(self) -> int:
         """Arrivals not yet fully admitted (queued + in-flight)."""
@@ -1204,6 +1236,412 @@ class BatchGenerator:
             self.__splice_small = jax.jit(splice)
         return self.__splice_small
 
+    # -- KV-page export/import (cake_tpu/disagg) -----------------------------
+    def _disagg_fingerprint(self) -> dict:
+        """Geometry a snapshot must match to land in this engine's pool
+        (the import-side twin of the worker handshake's max_seq check)."""
+        cfg = self.config
+        return {
+            "layers": cfg.num_hidden_layers,
+            "kv_heads": cfg.num_key_value_heads,
+            "head_dim": cfg.head_dim,
+            "dtype": str(cfg.dtype),
+            "kv_quant": self.kv_quant,
+            "page_size": self._page_size,
+            "max_seq": self.max_seq,
+            "vocab": cfg.vocab_size,
+            "repeat_last_n": self.settings.repeat_last_n,
+        }
+
+    def _require_paged(self, what: str) -> None:
+        if not self._paged:
+            raise ValueError(
+                f"{what} needs kv_layout='paged': KV moves between "
+                "engines as pool pages (construct with kv_layout='paged' "
+                "/ --kv-layout paged)")
+
+    def export_stream(self, stream_id: int, codec: str = "none") -> bytes:
+        """Snapshot a LIVE stream's KV pages + sampler/cursor state into
+        versioned, self-describing bytes (cake_tpu/disagg/snapshot) —
+        the suspend half of session suspend/resume and the payload the
+        prefill tier ships to a decode replica. Engine-thread only.
+
+        Buffered device rows are emitted first (the snapshot must
+        reflect the emitted state, not a mid-block one); the stream
+        itself keeps running — callers that hand the stream off call
+        ``finish(stream_id)`` after. Pages are PINNED for the gather
+        (kvpool pin/unpin: a claim outside stream tables and the prefix
+        tree), so nothing — not an eviction storm, not the stream
+        retiring mid-call — can free one mid-export. ``codec`` rides
+        each page through the wire activation codec (``--wire-codec``);
+        round trips are bit-identical whenever the codec is lossless for
+        the cache dtype (none always; bf16 on a bf16 cache; int8 on an
+        int8-quantized pool)."""
+        from cake_tpu.disagg import snapshot as _snapshot
+
+        self._require_paged("export_stream")
+        self._drain_buffered_rows()
+        slot = next(
+            (i for i, s in enumerate(self.streams)
+             if s.active and not s.done and s.stream_id == stream_id),
+            None)
+        if slot is None:
+            raise ValueError(f"no live stream with id {stream_id}")
+        s = self.streams[slot]
+        ps = self._page_size
+        n_kv = int(self._pos[slot])
+        n_pages = (n_kv - 1) // ps + 1
+        table = self._tables[slot][:n_pages]
+        guide = self._guides.get(slot)
+        guide_spec = getattr(guide, "spec", None) if guide else None
+        if guide is not None and guide_spec is None:
+            raise ValueError(
+                "cannot export a constrained stream whose Guide carries "
+                "no grammar spec (build it via constrain.guide_for, or "
+                "Guide(dfa, spec=...)) — the importer must recompile "
+                "the DFA to resume the cursor")
+        import uuid
+
+        for pid in table:
+            self._pagepool.pin(pid)
+        try:
+            ids_vec = np.zeros((self._ppp,), np.int32)
+            ids_vec[:n_pages] = table
+            staging = self._row_gather(self.cache, jnp.asarray(ids_vec))
+            host = jax.tree.map(np.asarray, staging)
+        finally:
+            for pid in table:
+                self._pagepool.unpin(pid)
+        pages = []
+        for j in range(n_pages):
+            lo, hi = j * ps, (j + 1) * ps
+            if self.kv_quant == "int8":
+                pages.append({
+                    "kq": host.k.q[:, 0, :, lo:hi],
+                    "ks": host.k.scale[:, 0, :, lo:hi],
+                    "vq": host.v.q[:, 0, :, lo:hi],
+                    "vs": host.v.scale[:, 0, :, lo:hi],
+                })
+            else:
+                pages.append({"k": host.k[:, 0, :, lo:hi],
+                              "v": host.v[:, 0, :, lo:hi]})
+        data = _snapshot.encode_snapshot(
+            xfer_id=uuid.uuid4().hex,
+            fingerprint=self._disagg_fingerprint(),
+            codec=codec,
+            stream_id=s.stream_id,
+            prompt=s.prompt,
+            generated=s.generated,
+            pos=n_kv,
+            index=int(self._index[slot]),
+            last_token=int(self._last_tokens[slot]),
+            key=np.asarray(self._keys[slot]),
+            history=np.asarray(self._history[slot]),
+            hist_slot=int(self._hist_slot[slot]),
+            guide_spec=guide_spec,
+            guide_state=guide.state if guide is not None else 0,
+            pages=pages,
+        )
+        # the original stream id rides along so a same-seed resume can
+        # keep the identity (the raw key above is what bit-identity
+        # actually needs — it survives differing seeds/sids)
+        _EXPORTS.inc()
+        return data
+
+    def import_begin(self, data) -> dict:
+        """Parse + register an inbound snapshot (engine-thread only).
+        Validation — magic/version/layout, model fingerprint — happens
+        HERE, so a transfer listener can ACK/REJECT before the pages
+        land; the pool work itself queues as an arrival in the SAME FIFO
+        as prompt admissions (pool pressure defers it FIFO-fair, never
+        drops it). Idempotent by transfer id: a duplicate send (retry
+        after a lost ACK) returns the existing registration. Returns the
+        resume metadata ``{"xfer_id", "stream_id", "prompt",
+        "generated", "texts", "n_kv"}`` (``texts`` = the incremental
+        detok replay of the generated tokens, what a serve session
+        replays to its client)."""
+        from cake_tpu.disagg import snapshot as _snapshot
+
+        self._require_paged("import_begin")
+        if not self.streams:
+            raise RuntimeError("set_prompts first")
+        snap = _snapshot.decode_snapshot(data)
+        if snap.xfer_id in self._imports:
+            return self._imports[snap.xfer_id]["meta"]
+        snap.check_fingerprint(self._disagg_fingerprint())
+        ps = self._page_size
+        if snap.n_pages != (snap.pos - 1) // ps + 1:
+            raise _snapshot.SnapshotError(
+                f"snapshot carries {snap.n_pages} pages for pos "
+                f"{snap.pos} at page_size {ps}")
+        if not 0 < snap.pos < self.max_seq:
+            raise _snapshot.SnapshotError(
+                f"snapshot pos {snap.pos} outside (0, {self.max_seq}) — "
+                "only live streams export")
+        shapes = self._page_shapes()
+        for page in snap.pages:
+            for k, want in shapes.items():
+                got = page.get(k)
+                if got is None or got.shape != want[0] \
+                        or got.dtype != want[1]:
+                    raise _snapshot.SnapshotError(
+                        f"page tensor {k!r} is "
+                        f"{None if got is None else (got.shape, got.dtype)}"
+                        f", expected {want}")
+        if snap.guide_spec is not None and self.tokenizer is None:
+            raise _snapshot.SnapshotError(
+                "snapshot carries a constrained-decoding cursor but this "
+                "engine has no tokenizer to recompile its grammar")
+        detok = TokenOutputStream(self.tokenizer) if self.tokenizer \
+            else None
+        texts = [detok.next_token(t) if detok is not None else None
+                 for t in snap.generated]
+        meta = {
+            "xfer_id": snap.xfer_id,
+            "stream_id": snap.stream_id,
+            "prompt": list(snap.prompt),
+            "generated": list(snap.generated),
+            "texts": texts,
+            "n_kv": snap.pos,
+        }
+        self._imports[snap.xfer_id] = {
+            "snap": snap, "pages": None, "detok": detok, "meta": meta,
+            "deferred": False, "t": time.monotonic(),
+        }
+        self._arrivals.append((snap.xfer_id, None, None, _ARR_IMPORT))
+        return meta
+
+    def _page_shapes(self) -> dict:
+        """Expected (shape, dtype) per page tensor for this geometry."""
+        cfg = self.config
+        L, KH, D = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        ps = self._page_size
+        if self.kv_quant == "int8":
+            return {
+                "kq": ((L, KH, ps, D), np.dtype(np.int8)),
+                "ks": ((L, KH, ps), np.dtype(np.float32)),
+                "vq": ((L, KH, ps, D), np.dtype(np.int8)),
+                "vs": ((L, KH, ps), np.dtype(np.float32)),
+            }
+        dt = np.dtype(cfg.jax_dtype)
+        return {"k": ((L, KH, ps, D), dt), "v": ((L, KH, ps, D), dt)}
+
+    def _import_begin_tick(self) -> None:
+        """Head-of-queue import: land its pages in the pool, or defer
+        FIFO-fair under pool pressure (the arrival stays at the head,
+        re-priced next tick — same discipline as a prompt admission)."""
+        xid = self._arrivals[0][0]
+        rec = self._imports.get(xid)
+        if rec is None:  # aborted while queued
+            self._arrivals.pop(0)
+            return
+        snap = rec["snap"]
+        need = snap.n_pages
+        if (self._pagepool.free_count < need
+                and not self._prefix_tree.evict_until_free(need)):
+            if not rec["deferred"]:
+                rec["deferred"] = True
+                self._pagepool.count_defer()
+            self._admit_deferred = True
+            return
+        self._admit_deferred = False
+        self._arrivals.pop(0)
+        staging = self._import_staging(snap)
+        pages = []
+        for _ in range(need):
+            pid = self._alloc_page()
+            # reclassify the alloc claim as a transfer PIN: until a
+            # stream attaches (or the import aborts), these pages are
+            # held by neither a stream table nor the prefix tree, and
+            # must still survive any eviction storm
+            self._pagepool.pin(pid)
+            self._pagepool.unref(pid)
+            pages.append(pid)
+        ids_vec = np.zeros((self._ppp,), np.int32)
+        ids_vec[:need] = pages
+        self.cache = self._row_scatter(self.cache, staging,
+                                       jnp.asarray(ids_vec))
+        rec["pages"] = pages
+        _IMPORTS.inc()
+
+    def _import_staging(self, snap) -> object:
+        """Snapshot pages -> the batch-1 staging cache ``row_scatter``
+        scatters from (host assembly + one upload; positions past the
+        snapshot's pages stay zero — beyond the resumed frontier, never
+        attendable)."""
+        from cake_tpu.ops.kvcache import KVCache, QuantizedKV
+
+        cfg = self.config
+        L, KH, D = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                    cfg.head_dim)
+        S, ps = self.max_seq, self._page_size
+        if self.kv_quant == "int8":
+            bufs = {"kq": np.zeros((L, 1, KH, S, D), np.int8),
+                    "ks": np.zeros((L, 1, KH, S), np.float32),
+                    "vq": np.zeros((L, 1, KH, S, D), np.int8),
+                    "vs": np.zeros((L, 1, KH, S), np.float32)}
+        else:
+            dt = np.dtype(cfg.jax_dtype)
+            bufs = {"k": np.zeros((L, 1, KH, S, D), dt),
+                    "v": np.zeros((L, 1, KH, S, D), dt)}
+        for j, page in enumerate(snap.pages):
+            lo, hi = j * ps, (j + 1) * ps
+            for k, arr in page.items():
+                bufs[k][:, 0, :, lo:hi] = arr
+        if self.kv_quant == "int8":
+            return KVCache(
+                k=QuantizedKV(q=jnp.asarray(bufs["kq"]),
+                              scale=jnp.asarray(bufs["ks"])),
+                v=QuantizedKV(q=jnp.asarray(bufs["vq"]),
+                              scale=jnp.asarray(bufs["vs"])))
+        return KVCache(k=jnp.asarray(bufs["k"]), v=jnp.asarray(bufs["v"]))
+
+    def import_attach(self, xfer_id: str, stream_id: int) -> None:
+        """Queue the attach of a begun import: when it reaches the FIFO
+        head with a free slot, the imported pages become the stream's
+        table (page-table edit — ref then unpin, no cache tensor moves)
+        and its sampler/cursor state splices in. Decode then continues
+        bit-identically to the exporting engine's next step."""
+        self._require_paged("import_attach")
+        if xfer_id not in self._imports:
+            raise KeyError(f"unknown or expired transfer {xfer_id!r}")
+        self._arrivals.append((xfer_id, stream_id, None, _ARR_ATTACH))
+
+    def _import_attach_tick(self) -> None:
+        xid, sid, _, _ = self._arrivals.pop(0)
+        rec = self._imports.pop(xid, None)
+        if rec is None or rec["pages"] is None:
+            # aborted/expired between queue and tick (rec["pages"] is
+            # None only if the begin was aborted while queued — FIFO
+            # order guarantees the begin tick ran before this one)
+            if rec is not None:
+                _IMPORT_ABORTS.inc()
+            self._attach_failures.append(sid)
+            return
+        snap, pages = rec["snap"], rec["pages"]
+        slot = self._free_slot()
+        self._release_pages(slot)
+        # rows computed under the slot's previous meaning are recorded
+        # before the attach changes it (same rule as the admission splice)
+        self._drain_buffered_rows()
+        for pid in pages:
+            self._pagepool.ref(pid)    # the stream table's claim...
+            self._pagepool.unpin(pid)  # ...replaces the transfer pin
+        self._tables[slot] = list(pages)
+        self._page_map_dev = None
+        (self._keys, self._history, self._hist_slot,
+         self._last_tokens) = self._splice_small_fn()(
+            self._keys, self._history, self._hist_slot,
+            self._last_tokens, jnp.asarray(snap.key, jnp.uint32),
+            jnp.asarray(snap.history, jnp.int32),
+            jnp.int32(snap.hist_slot), jnp.int32(snap.last_token),
+            jnp.int32(slot),
+        )
+        self._pos = np.asarray(self._pos).copy()
+        self._pos[slot] = snap.pos
+        self._index = np.asarray(self._index).copy()
+        self._index[slot] = snap.index
+        s = _Stream(stream_id=sid, prompt=list(snap.prompt),
+                    detok=rec["detok"])
+        s.generated = list(snap.generated)
+        self.streams[slot] = s
+        self._drop_guide(slot)
+        if snap.guide_spec is not None:
+            from cake_tpu.constrain.guide import guide_for
+
+            g = guide_for(snap.guide_spec, self.tokenizer, self.config)
+            self._attach_guide(slot, g)  # resets the cursor...
+            g.state = snap.guide_state   # ...then resume mid-grammar
+        _RESUMES.inc()
+
+    def import_abort(self, xfer_id: str) -> bool:
+        """Drop a begun import and release its page pins (resume never
+        came — gateway died, TTL expired, client cancelled). Returns
+        False when the id is unknown (already attached or aborted)."""
+        rec = self._imports.pop(xfer_id, None)
+        if rec is None:
+            return False
+        if rec["pages"] is not None:
+            for pid in rec["pages"]:
+                self._pagepool.unpin(pid)
+        else:
+            self._arrivals = [a for a in self._arrivals
+                              if not (a[3] == _ARR_IMPORT
+                                      and a[0] == xfer_id)]
+        _IMPORT_ABORTS.inc()
+        return True
+
+    def expire_imports(self, ttl_s: float) -> int:
+        """Abort begun-but-unattached imports older than ``ttl_s``; the
+        serve scheduler sweeps this so an orphaned transfer cannot pin
+        pool pages forever. Returns the number aborted."""
+        if not self._imports:
+            return 0
+        now = time.monotonic()
+        expired = [xid for xid, rec in self._imports.items()
+                   if now - rec["t"] > ttl_s]
+        for xid in expired:
+            self.import_abort(xid)
+        return len(expired)
+
+    def take_attach_failures(self) -> list[int]:
+        """Stream ids whose attach found its import gone (aborted or
+        expired) — the serve scheduler fails those sessions with a
+        resumable-elsewhere status instead of letting them hang."""
+        out, self._attach_failures = self._attach_failures, []
+        return out
+
+    def imports_pending(self) -> int:
+        """Begun-but-unattached imports (pages pinned or queued) — the
+        ``kv_transfers_inflight`` signal /healthz exposes."""
+        return len(self._imports)
+
+    def import_stream(self, data, stream_id: int | None = None,
+                      ) -> tuple[int, str]:
+        """Synchronous import: begin + attach + drive admission ticks to
+        completion (the ``admit()`` of the disagg plane — tests and
+        single-process suspend/resume). Returns ``(slot, xfer_id)``.
+        Raises when the attach cannot complete without outside help (no
+        retirable slot, pool exhausted with nothing evictable)."""
+        meta = self.import_begin(data)
+        xid = meta["xfer_id"]
+        sid = meta["stream_id"] if stream_id is None else stream_id
+        self.import_attach(xid, sid)
+
+        def ours_pending() -> bool:
+            return any(a[3] in (_ARR_IMPORT, _ARR_ATTACH) and a[0] == xid
+                       for a in self._arrivals)
+
+        while ours_pending():
+            head = self._arrivals[0]
+            # admit()'s no-busy-loop rule, FIFO-wide: any head that needs
+            # a slot to start (an attach, ours or not, or a queued
+            # prompt — everything but a pages-only import admission)
+            # blocks the whole queue when every stream is live, so raise
+            # instead of spinning on a no-op tick
+            if (head[3] != _ARR_IMPORT and self._staging is None
+                    and self._free_slot() is None):
+                self.import_abort(xid)
+                raise RuntimeError(
+                    "no free slot: every stream is still live")
+            self._admission_tick()
+            # a pool-deferred head — whoever owns it — can only unblock
+            # via retires that never happen inside this synchronous loop
+            if self._staging is None and self._admit_deferred:
+                self.import_abort(xid)
+                raise RuntimeError(
+                    "kv page pool exhausted: import deferred (retire "
+                    "streams, or grow kv_pool_pages)")
+        if sid in self._attach_failures:
+            self._attach_failures.remove(sid)
+            raise RuntimeError(f"import {xid} was aborted before attach")
+        slot = next(i for i, s in enumerate(self.streams)
+                    if s.active and not s.done and s.stream_id == sid
+                    and s.generated[:len(meta["generated"])]
+                    == meta["generated"])
+        return slot, xid
+
     def _admission_chunk_for(self, prompt_len: int) -> int:
         """The per-dispatch admission chunk for a prompt of this length:
         the configured interleave granularity, but never padded past the
@@ -1298,9 +1736,23 @@ class BatchGenerator:
 
     def _admission_tick(self) -> None:
         """Advance the in-flight admission by one chunk dispatch (or start
-        the next queued arrival if a slot is free)."""
+        the next queued arrival if a slot is free). KV-page imports
+        (cake_tpu/disagg) ride the same FIFO: a begin lands the pages in
+        the pool (deferring FIFO-fair under pool pressure exactly like a
+        prompt admission), an attach installs the resumed stream into a
+        free slot — each one tick, no prefill dispatches."""
         if self._staging is None:
-            if not self._arrivals or self._free_slot() is None:
+            if not self._arrivals:
+                return
+            kind = self._arrivals[0][3]
+            if kind == _ARR_IMPORT:
+                self._import_begin_tick()
+                return
+            if kind == _ARR_ATTACH:
+                if self._free_slot() is not None:
+                    self._import_attach_tick()
+                return
+            if self._free_slot() is None:
                 return
             slot = self._free_slot()
             if self._paged:
@@ -1308,7 +1760,7 @@ class BatchGenerator:
                 # path, including a caller writing s.done directly) frees
                 # its page claims before the arrival's needs are priced
                 self._release_pages(slot)
-            ids, sid, guide = self._arrivals.pop(0)
+            ids, sid, guide, _ = self._arrivals.pop(0)
             # Prefix reuse: an arrival whose opening tokens match a stored
             # prefix (a staged row in the slot layout, a page chain in the
             # paged one) starts from that content and prefills only its
@@ -1349,11 +1801,12 @@ class BatchGenerator:
                         # count DEFERRED ADMISSIONS, not re-priced ticks
                         # (the head arrival is re-tried every step while
                         # it waits). Unreachable under the enforced pool
-                        # sizing — a belt for future preemption/spill
-                        # features that pin pages outside stream tables.
+                        # sizing — reachable the moment in-flight KV
+                        # transfers pin pages outside stream tables
+                        # (cake_tpu/disagg imports).
                         self._pagepool.count_defer()
                     self._admit_deferred = True
-                    self._arrivals.insert(0, (ids, sid, guide))
+                    self._arrivals.insert(0, (ids, sid, guide, None))
                     return
                 self._admit_deferred = False
             tokens = np.zeros((1, t_pad), np.int32)
@@ -1455,22 +1908,7 @@ class BatchGenerator:
         # step() consumers still receive every Token. An in-flight
         # lookahead block is the same chronology, one block later — fetch
         # and record it too (its rows are also pre-admission tokens).
-        while self._block_buf:
-            self._pending_rows.append(
-                self._emit_buffered(self._block_buf.popleft()))
-        if self._inflight is not None:
-            toks_if, lpv_if, lpi_if, _ = self._inflight
-            self._inflight = None
-            t0 = time.perf_counter()
-            rows_if = self._host(toks_if)
-            lp_if = ((self._host(lpv_if), self._host(lpi_if))
-                     if lpv_if is not None else None)
-            self._busy_s += time.perf_counter() - t0
-            for i in range(rows_if.shape[0]):
-                self._pending_rows.append(self._emit(
-                    rows_if[i],
-                    lp=(lp_if[0][i], lp_if[1][i]) if lp_if else None,
-                ))
+        self._drain_buffered_rows()
 
         # the slot's previous stream is gone; its guide (if any) with it
         self._drop_guide(slot)
@@ -1618,7 +2056,13 @@ class BatchGenerator:
             self._staging = None  # staged KV row is dropped with it
             return True
         n0 = len(self._arrivals)
+        # a cancelled resume drops its queued attach AND aborts the
+        # import behind it (the pinned pages must not wait out the TTL)
+        drop_xfers = [a[0] for a in self._arrivals
+                      if a[1] == stream_id and a[3] == _ARR_ATTACH]
         self._arrivals = [a for a in self._arrivals if a[1] != stream_id]
+        for xid in drop_xfers:
+            self.import_abort(xid)
         return len(self._arrivals) != n0
 
     def admit(self, prompt, stream_id: int) -> tuple[int, Token]:
@@ -1631,7 +2075,7 @@ class BatchGenerator:
         if not self.streams:
             raise RuntimeError("set_prompts first")
         ids = self._encode(prompt)
-        self._arrivals.append((ids, stream_id, None))
+        self._arrivals.append((ids, stream_id, None, None))
         # Drain until OUR arrival (tracked by list identity — FIFO order
         # admits anything queued ahead of it first) is fully admitted. If
         # the queue head cannot start because every stream is live, raise
@@ -2084,6 +2528,14 @@ class BatchGenerator:
         tokens are recorded against their streams and counted immediately
         (same `_emit` path as stepping); the Token rows land in the
         pending queue for any consumer still calling step()."""
+        self._drain_buffered_rows()
+
+    def _drain_buffered_rows(self) -> None:
+        """Record every device-computed-but-unemitted row (buffered fused
+        -block rows, then any in-flight lookahead block) into the pending
+        queue — shared by drain(), the admission splice, the import
+        attach, and export (all points where a slot's column is about to
+        change meaning or the emitted state must be complete)."""
         while self._block_buf:
             self._pending_rows.append(
                 self._emit_buffered(self._block_buf.popleft()))
@@ -2281,7 +2733,8 @@ class BatchGenerator:
                 else len(self._prefix_store)
             ),
             "kv_layout": "paged" if self._paged else "slot",
-            **({"kvpool": self._pagepool.stats()}
+            **({"kvpool": self._pagepool.stats(),
+                "imports_pending": self.imports_pending()}
                if self._paged and self._pagepool is not None else {}),
             "spec_dispatches": self._n_spec_dispatches,
             "spec_chains": self._n_spec_chains,

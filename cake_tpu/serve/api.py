@@ -115,6 +115,37 @@ def _parse_guide(body: dict, engine):
         raise ValueError(f"bad response_format: {e}")
 
 
+def _parse_disagg(body: dict, scheduler) -> tuple[dict | None, str | None]:
+    """Validate the disagg extension fields a tier-aware gateway injects:
+    ``_disagg`` ({"target": "host:port"}) asks this replica to prefill
+    and ship the KV pages to the target's transfer channel; ``_resume``
+    ({"xfer_id": ...}) asks it to continue an imported stream. Returns
+    ``(handoff, resume_xfer)``; raises ValueError on a malformed or
+    unsupported combination."""
+    dis, res = body.get("_disagg"), body.get("_resume")
+    if dis is None and res is None:
+        return None, None
+    if dis is not None and res is not None:
+        raise ValueError("'_disagg' and '_resume' are mutually exclusive")
+    if not (hasattr(scheduler.engine, "export_stream")
+            and getattr(scheduler.engine, "paged", False)):
+        raise ValueError(
+            "this replica cannot move KV pages (disagg needs the batched "
+            "mesh engine with --kv-layout paged)")
+    if dis is not None:
+        if not isinstance(dis, dict) or not isinstance(
+                dis.get("target"), str):
+            raise ValueError("'_disagg' must be {\"target\": \"host:port\"}")
+        host, _, port = dis["target"].rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"'_disagg' target {dis['target']!r} is not host:port")
+        return {"host": host, "port": int(port)}, None
+    if not isinstance(res, dict) or not isinstance(res.get("xfer_id"), str):
+        raise ValueError("'_resume' must be {\"xfer_id\": \"...\"}")
+    return None, res["xfer_id"]
+
+
 def _parse_request(body: dict, scheduler) -> Session:
     """Validate one completions body into a Session (raises ValueError
     with a client-facing message)."""
@@ -263,7 +294,16 @@ def _make_handler(server: ApiServer):
                     "running": st["running"],
                     "max_concurrent": st["max_concurrent"],
                     "tok_s_ema": st["observed_tok_s"],
+                    # disagg tier map: the gateway's prober learns the
+                    # replica's role, its transfer address, and the KV
+                    # transfers currently in flight from the SAME GET
+                    # that feeds the p2c load signal
+                    "role": st.get("role", "mixed"),
+                    "kv_transfers_inflight": st.get(
+                        "kv_transfers_inflight", 0),
                 }
+                if st.get("transfer_port"):
+                    body["transfer_port"] = st["transfer_port"]
                 eng_st = st.get("engine")
                 kv = (eng_st.get("kvpool")
                       if isinstance(eng_st, dict) else None)
@@ -308,18 +348,34 @@ def _make_handler(server: ApiServer):
                 return
             try:
                 sess = _parse_request(body, scheduler)
+                sess.handoff, sess.resume_xfer = _parse_disagg(body,
+                                                               scheduler)
             except ValueError as e:
                 self._error(400, str(e))
                 return
+            if scheduler.role == "prefill" and sess.handoff is None:
+                # a prefill-tier replica runs bucketed prefill ONLY; a
+                # request without a handoff target would decode here and
+                # defeat the tier split — refuse loudly so a misrouted
+                # gateway (or curl) learns immediately
+                self._error(400, "this replica is prefill-tier: "
+                                 "completions must arrive via a "
+                                 "disagg-aware gateway (_disagg target)")
+                return
+            if sess.resume_xfer is not None:
+                if not self._replay_resume(sess):
+                    return  # 409 (unknown transfer) or completed-by-replay
             try:
                 scheduler.submit(sess)
             except QueueFull as e:
                 # never block the accept loop: full queue answers 429 with
                 # the observed-throughput Retry-After hint
+                self._abort_resume_import(sess)
                 self._error(429, str(e), headers={
                     "Retry-After": str(max(1, round(e.retry_after_s)))})
                 return
             except Draining:
+                self._abort_resume_import(sess)
                 self._error(503, "server is draining")
                 return
             # a handler dying mid-pump (any reason, not just the client
@@ -327,13 +383,91 @@ def _make_handler(server: ApiServer):
             # would keep generating into a queue nobody drains until its
             # token budget runs out
             try:
-                if sess.stream:
+                if sess.handoff is not None:
+                    self._handoff_response(sess)
+                elif sess.stream:
                     self._stream_response(sess)
                 else:
                     self._unary_response(sess)
             finally:
                 if sess.finish_reason is None:
                     scheduler.cancel(sess)
+
+        def _abort_resume_import(self, sess) -> None:
+            """A resume refused before admission will never attach: drop
+            its begun import NOW so the pinned pages do not sit out the
+            import TTL while the gateway re-prefills elsewhere."""
+            if sess.resume_xfer is not None:
+                scheduler.abort_import(sess.resume_xfer)
+
+        def _replay_resume(self, sess) -> bool:
+            """Prime a resume session with the snapshot's already-
+            generated tokens (the decode replica re-emits the WHOLE
+            stream, so the client's view is identical to an
+            uninterrupted one). Returns False when the response was
+            already written: unknown transfer (409 — the gateway
+            re-prefills) or the replay alone satisfied the request (the
+            import is aborted and the stream never attaches)."""
+            meta = scheduler.import_meta(sess.resume_xfer)
+            if meta is None:
+                self._error(409, f"unknown or expired transfer "
+                                 f"{sess.resume_xfer!r}; re-prefill")
+                return False
+            for tok, text in zip(meta["generated"], meta["texts"]):
+                sess.on_token(tok, text)
+                # clamp inside the loop: a snapshot may carry more
+                # tokens than THIS request's budget allows
+                if sess.stop_hit or len(sess.generated) >= sess.max_tokens:
+                    break
+            if sess.stop_hit or len(sess.generated) >= sess.max_tokens:
+                scheduler.abort_import(sess.resume_xfer)
+                sess.finish("stop" if sess.stop_hit else "length")
+                if sess.stream:
+                    self._stream_response(sess)
+                else:
+                    self._unary_response(sess)
+                return False
+            return True
+
+        def _handoff_response(self, sess) -> None:
+            """Wait for the engine's export, ship it over the transfer
+            channel (retry/backoff — on THIS thread, never the engine's),
+            and answer the gateway with the transfer id to resume."""
+            from cake_tpu.disagg import (
+                TransferError,
+                peek_xfer_id,
+                send_snapshot,
+            )
+
+            ev = self._next_event(sess)
+            if ev[0] == "error":
+                _, status, message = ev
+                self._error(status, message)
+                return
+            if ev[0] != "handoff":  # e.g. a deadline fired mid-prefill
+                self._error(504, f"prefill did not complete ({ev[0]}); "
+                                 "re-prefill")
+                return
+            payload = ev[1]
+            scheduler.xfer_out_enter()
+            try:
+                send_snapshot(sess.handoff["host"], sess.handoff["port"],
+                              payload,
+                              deadline_s=scheduler.transfer_deadline_s)
+            except TransferError as e:
+                # retry budget exhausted or receiver rejected: the pages
+                # are gone with this replica's slot — tell the gateway
+                # to re-prefill (502: infrastructure, not client, fault)
+                self._json(502, {"handoff": False, "error": str(e)})
+                return
+            finally:
+                scheduler.xfer_out_exit()
+            self._json(200, {
+                "handoff": True,
+                "xfer_id": peek_xfer_id(payload),
+                "prompt_tokens": len(sess.prompt_ids),
+                "snapshot_bytes": len(payload),
+            })
 
         def _next_event(self, sess):
             """Block on the session queue, but never past a dead engine

@@ -27,14 +27,29 @@ cancellation, and drain.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from collections import deque
 
+from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.serve import session as _session
 from cake_tpu.serve.session import Session
 
 log = logging.getLogger("cake_tpu.serve.scheduler")
+
+# replica roles (cake_tpu/disagg): what this scheduler DOES with a
+# request is role-driven — "prefill" runs bucketed prefill only and
+# hands the finished KV pages off at the first token; "decode" imports
+# pages and runs the steady-state batched step (it still serves plain
+# requests: that is the gateway's transparent re-prefill fallback);
+# "mixed" is the classic everything-replica.
+ROLES = ("mixed", "prefill", "decode")
+
+# KV transfers in flight on this replica (outgoing handoff sends +
+# imports awaiting their resume) — the /healthz kv_transfers_inflight
+# field the gateway's tier map reads
+_INFLIGHT = obs_metrics.gauge("disagg.inflight")
 
 
 class QueueFull(Exception):
@@ -72,15 +87,36 @@ class Scheduler:
         "_by_sid": "_cond",
         "_draining": "_cond",
         "_stopping": "_cond",
+        "_import_inbox": "_cond",
+        "_imports_meta": "_cond",
+        "_xfer_out": "_cond",
     }
 
     def __init__(self, engine, queue_depth: int = 64,
-                 request_timeout_s: float | None = None):
+                 request_timeout_s: float | None = None,
+                 role: str = "mixed", transfer_codec: str = "none",
+                 transfer_deadline_s: float = 15.0,
+                 import_ttl_s: float = 120.0):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        if role != "mixed" and not (hasattr(engine, "export_stream")
+                                    and getattr(engine, "paged", False)):
+            raise ValueError(
+                f"role {role!r} needs a disagg-capable engine "
+                "(BatchGenerator with kv_layout='paged')")
         self.engine = engine
         self.queue_depth = queue_depth
         self.request_timeout_s = request_timeout_s
+        # disagg plane (cake_tpu/disagg): role + KV-transfer knobs. The
+        # transfer listener (if any) reports its port here so /healthz
+        # can advertise it to the gateway's tier map.
+        self.role = role
+        self.transfer_codec = transfer_codec
+        self.transfer_deadline_s = transfer_deadline_s
+        self.import_ttl_s = import_ttl_s
+        self.transfer_port: int | None = None
         self.max_concurrent = 0  # set by start() (dp may pad the batch up)
         self._queue: deque[Session] = deque()
         self._by_sid: dict[int, Session] = {}
@@ -89,6 +125,15 @@ class Scheduler:
         self._thread: threading.Thread | None = None
         self._stopping = False
         self._draining = False
+        # KV-transfer state: the import inbox feeds snapshot payloads
+        # from transfer-listener threads to the engine thread (the only
+        # thread allowed to touch the engine/pool); the meta map mirrors
+        # begun imports for the resume handler; _xfer_out counts
+        # outgoing handoff sends in flight
+        self._import_inbox: deque = deque()
+        self._imports_meta: dict[str, dict] = {}
+        self._xfer_out = 0
+        self._last_sweep = time.monotonic()
         # observed-throughput window for the Retry-After estimate
         self._rate_tokens = 0
         self._rate_t0 = time.perf_counter()
@@ -192,6 +237,116 @@ class Scheduler:
         with self._cond:
             self._cond.notify_all()
 
+    # -- KV-transfer plane (cake_tpu/disagg) ----------------------------------
+    def submit_import(self, payload: bytes, timeout_s: float = 10.0) -> dict:
+        """Hand an inbound snapshot to the engine thread and wait for its
+        verdict (called by the transfer listener). Parse + fingerprint
+        validation happen on the engine thread (`import_begin`); pool
+        pressure does NOT delay the verdict — the pages land later via
+        the engine's FIFO-fair arrival queue. Raises ``ValueError`` with
+        the refusal reason (the sender's XFER_REJECT) on a bad snapshot,
+        ``TimeoutError`` when the engine thread is wedged or gone."""
+        reply: queue.Queue = queue.Queue()
+        with self._cond:
+            if self._draining:
+                raise ValueError("replica is draining; re-prefill elsewhere")
+            self._import_inbox.append(("begin", payload, reply))
+            self._cond.notify_all()
+        try:
+            verdict, value = reply.get(timeout=timeout_s)
+        except queue.Empty:
+            raise TimeoutError("engine thread did not pick up the import")
+        if verdict == "err":
+            raise ValueError(value)
+        return value
+
+    def abort_import(self, xfer_id: str) -> None:
+        """Queue an import abort (resume satisfied by the replay alone,
+        or the caller gave up) — processed on the engine thread."""
+        with self._cond:
+            self._import_inbox.append(("abort", xfer_id, None))
+            self._cond.notify_all()
+
+    def import_meta(self, xfer_id: str) -> dict | None:
+        """Resume metadata for a begun import (None = unknown/expired)."""
+        with self._cond:
+            meta = self._imports_meta.get(xfer_id)
+            return dict(meta) if meta is not None else None
+
+    def xfer_out_enter(self) -> None:
+        with self._cond:
+            self._xfer_out += 1
+        self._sync_inflight()
+
+    def xfer_out_exit(self) -> None:
+        with self._cond:
+            self._xfer_out -= 1
+        self._sync_inflight()
+
+    def kv_transfers_inflight(self) -> int:
+        with self._cond:
+            return self._xfer_out + len(self._imports_meta)
+
+    def _sync_inflight(self) -> None:
+        _INFLIGHT.set(self.kv_transfers_inflight())
+
+    def _drain_import_inbox(self) -> None:
+        """Engine thread: apply queued KV-transfer ops."""
+        while True:
+            with self._cond:
+                if not self._import_inbox:
+                    return
+                kind, payload, reply = self._import_inbox.popleft()
+            if kind == "begin":
+                try:
+                    meta = self.engine.import_begin(payload)
+                except Exception as e:
+                    if reply is not None:
+                        reply.put(("err", str(e)))
+                    continue
+                with self._cond:
+                    self._imports_meta[meta["xfer_id"]] = dict(
+                        meta, t=time.monotonic())
+                self._sync_inflight()
+                if reply is not None:
+                    reply.put(("ok", meta))
+            else:  # abort
+                self.engine.import_abort(payload)
+                with self._cond:
+                    self._imports_meta.pop(payload, None)
+                self._sync_inflight()
+
+    def _sweep_imports(self) -> None:
+        """Engine thread, ~1/s: expire begun-but-unresumed imports so an
+        orphaned transfer (gateway died between ACK and resume) cannot
+        pin pool pages forever."""
+        now = time.monotonic()
+        if now - self._last_sweep < 1.0:
+            return
+        self._last_sweep = now
+        if hasattr(self.engine, "expire_imports"):
+            self.engine.expire_imports(self.import_ttl_s)
+        with self._cond:
+            stale = [x for x, m in self._imports_meta.items()
+                     if now - m["t"] > self.import_ttl_s]
+            for x in stale:
+                self._imports_meta.pop(x, None)
+        if stale:
+            self._sync_inflight()
+
+    def _fail_lost_attaches(self) -> None:
+        """Engine thread: sessions whose resume attach found its import
+        gone (TTL raced the resume) fail with a retryable status instead
+        of hanging until their deadline."""
+        if not hasattr(self.engine, "take_attach_failures"):
+            return
+        for sid in self.engine.take_attach_failures():
+            with self._cond:
+                sess = self._by_sid.pop(sid, None)
+            if sess is not None and sess.finish_reason is None:
+                sess.fail(409, "kv import expired before the resume "
+                               "attached; re-prefill elsewhere")
+
     def retry_after_s(self) -> float:
         """Backpressure hint: outstanding token budget over the observed
         aggregate tokens/sec, clamped to something a client can act on."""
@@ -217,12 +372,16 @@ class Scheduler:
             "queue_depth": self.queue_depth,
             "draining": draining,
             "observed_tok_s": round(self._tok_s, 2),
+            "role": self.role,
+            "kv_transfers_inflight": self.kv_transfers_inflight(),
+            **({"transfer_port": self.transfer_port}
+               if self.transfer_port else {}),
             "engine": self.engine.stats(),
         }
 
     # -- engine thread --------------------------------------------------------
     def _has_work_locked(self) -> bool:
-        return bool(self._queue or self._by_sid
+        return bool(self._queue or self._by_sid or self._import_inbox
                     or self.engine.pending_admissions())
 
     def _run(self) -> None:
@@ -234,14 +393,20 @@ class Scheduler:
                         break  # drained dry: park
                     self._cond.wait(timeout=0.1)
                     self._expire_queued_locked()
+                    # imports awaiting resume are not "work" (nothing to
+                    # step), but their TTL must still tick while parked
+                    self._sweep_imports()
                 if self._stopping or (self._draining
                                       and not self._has_work_locked()):
                     break
             try:
+                self._drain_import_inbox()
+                self._sweep_imports()
                 self._admit()
                 row = self.engine.step()
                 self._deliver(row)
                 self._retire()
+                self._fail_lost_attaches()
             except Exception as e:  # engine fault: fail every session
                 log.exception("engine thread fault: %s", e)
                 with self._cond:
@@ -271,6 +436,11 @@ class Scheduler:
                 s.fail(504, "deadline expired while queued")
             else:
                 keep.append(s)
+                continue
+            # a refused resume will never attach: release its begun
+            # import's pinned pages now instead of waiting out the TTL
+            if s.resume_xfer is not None:
+                self._import_inbox.append(("abort", s.resume_xfer, None))
         if len(keep) != len(self._queue):
             self._queue = keep
             _session.QUEUE_DEPTH.set(len(self._queue))
@@ -288,13 +458,24 @@ class Scheduler:
                 sid = self._next_sid
                 self._next_sid += 1
             try:
+                if sess.resume_xfer is not None:
+                    # a resumed import: attach the already-landed pages
+                    # to a slot (page-table edit) — the snapshot, not
+                    # the request body, is the source of stream state
+                    self.engine.import_attach(sess.resume_xfer, sid)
+                    with self._cond:
+                        self._imports_meta.pop(sess.resume_xfer, None)
+                    self._sync_inflight()
                 # guide= only when constrained: unconstrained admission
                 # keeps the bare protocol every engine stub speaks
-                if sess.guide is not None:
+                elif sess.guide is not None:
                     self.engine.enqueue(sess.prompt_ids, sid,
                                         guide=sess.guide)
                 else:
                     self.engine.enqueue(sess.prompt_ids, sid)
+            except KeyError as e:  # unknown/expired transfer
+                sess.fail(409, str(e))
+                continue
             except ValueError as e:  # encode raced the window, etc.
                 sess.fail(400, str(e))
                 continue
@@ -303,8 +484,13 @@ class Scheduler:
                 self._by_sid[sid] = sess
 
     def _deliver(self, row) -> None:
-        """Fan one emitted row out to its sessions' event queues."""
+        """Fan one emitted row out to its sessions' event queues. A
+        handoff session (prefill role: the gateway asked for the KV to
+        ship elsewhere) gets NO token events — its first token is the
+        export trigger, and every token it has rides the snapshot to be
+        replayed by the decode replica's resume."""
         n = 0
+        handoffs: list[tuple[int, Session, object]] = []
         with self._cond:
             # _by_sid is written only on this (engine) thread; the locked
             # snapshot keeps the _GUARDED_BY annotation honest and stays
@@ -317,6 +503,9 @@ class Scheduler:
             sess = by_sid.get(stream.stream_id)
             if sess is None:
                 continue  # priming/dummy slot, or already aborted
+            if sess.handoff is not None:
+                handoffs.append((stream.stream_id, sess, tok))
+                continue
             sess.on_token(tok.id, tok.text,
                           logprobs=getattr(tok, "logprobs", None))
             n += 1
@@ -329,6 +518,8 @@ class Scheduler:
                     or ("eos" if tok.id in self.engine.eos_ids
                         else "length")
                 )
+        for sid, sess, tok in handoffs:
+            self._handoff_one(sid, sess, tok)
         if n:
             self._rate_tokens += n
             dt = time.perf_counter() - self._rate_t0
@@ -339,6 +530,37 @@ class Scheduler:
                     0.5 * self._tok_s + 0.5 * inst)
                 self._rate_tokens = 0
                 self._rate_t0 = time.perf_counter()
+
+    def _handoff_one(self, sid: int, sess: Session, tok) -> None:
+        """Export + retire a prefilled stream at its first token; the
+        snapshot payload rides the session's event queue to the handler
+        thread, which ships it over the transfer channel (the slow part
+        — retry/backoff against the decode replica — must never run on
+        the engine thread)."""
+        if tok.is_end_of_stream:
+            # nothing to hand off: the stream completed AT its first
+            # token (EOS / window / grammar dead end). 409 tells the
+            # gateway to re-prefill elsewhere — rare, and the plain
+            # path reproduces the 1-token stream deterministically.
+            self.engine.finish(sid)
+            with self._cond:
+                self._by_sid.pop(sid, None)
+            sess.fail(409, "stream completed during prefill; re-prefill")
+            return
+        try:
+            payload = self.engine.export_stream(
+                sid, codec=self.transfer_codec)
+        except Exception as e:
+            log.exception("export of stream %d failed", sid)
+            self.engine.finish(sid)
+            with self._cond:
+                self._by_sid.pop(sid, None)
+            sess.fail(500, f"kv export failed: {e}")
+            return
+        self.engine.finish(sid)
+        with self._cond:
+            self._by_sid.pop(sid, None)
+        sess.handoff_ready(payload)
 
     def _slot_of(self, sid: int) -> int | None:
         for i, s in enumerate(self.engine.streams):

@@ -88,6 +88,13 @@ class Session:
         self._held: list[tuple[int, str, list | None]] = []
         self._held_text = ""
         self.logprob_rows: list[list] | None = [] if self.logprobs else None
+        # disagg plane (cake_tpu/disagg): a handoff session prefills and
+        # ships its KV instead of streaming tokens (``handoff`` = the
+        # target parsed from the request's ``_disagg`` extension); a
+        # resume session continues an imported stream (``resume_xfer`` =
+        # the transfer id from ``_resume``)
+        self.handoff: dict | None = None
+        self.resume_xfer: str | None = None
         # scheduler-owned identity/state
         self.stream_id: int | None = None  # engine stream id once admitted
         self.finish_reason: str | None = None
@@ -246,6 +253,13 @@ class Session:
         """Reject/abort the session with an HTTP-statused error event."""
         self.finish_reason = "error"
         self.events.put(("error", status, message))
+
+    def handoff_ready(self, payload: bytes) -> None:
+        """The engine exported this session's stream (engine thread):
+        hand the snapshot payload to the handler thread, which ships it
+        over the transfer channel and answers the gateway."""
+        self.finish_reason = "handoff"
+        self.events.put(("handoff", payload))
 
     # -- stats ----------------------------------------------------------------
     @property

@@ -22,6 +22,16 @@ short/long prompt-length mix, and every Nth client disconnecting
 mid-stream (``--disconnect-every``), so slot churn is drivable over
 HTTP instead of only in-process.
 
+``--workload mixed-prefill`` (ISSUE 13) is the interference regime the
+disaggregated prefill/decode tiers (cake_tpu/disagg) exist for: Poisson
+arrivals with a BIMODAL prompt-length mix (``--prompt-len 8,512`` —
+chatty short prompts sharing a fleet with long-document ones), every
+request streaming. On a mixed fleet the long prefills inflate every
+decoding neighbor's TPOT and TTFT p95 is hostage to batch composition;
+a tiered fleet isolates them. The report splits TTFT p50/p95 by prompt
+bucket (``ttft_ms_by_prompt_len``) so the short-prompt tail is visible
+next to the long one.
+
 ``--retry-429`` makes a 429 honor its ``Retry-After`` and resubmit
 (bounded) instead of counting a hard rejection — the realistic open-loop
 client against a saturated server or gateway. ``--spawn-backends N``
@@ -179,13 +189,28 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     the mix to 8,64), and every ``disconnect_every``-th client walking
     away mid-stream (defaults to 4) — the slot-churn traffic shape the
     paged KV pool exists for, drivable over HTTP instead of only
-    in-process. ``retry_429`` makes a 429 response honor its
-    ``Retry-After`` and resubmit (bounded) instead of counting a hard
-    rejection — the honest open-loop behavior against a saturated
-    server or gateway (a real client backs off; it does not give up)."""
-    if workload not in ("text", "json", "churn"):
-        raise ValueError(f"workload must be 'text', 'json' or 'churn', "
-                         f"got {workload!r}")
+    in-process. ``workload="mixed-prefill"`` is the disagg interference
+    regime (ISSUE 13): Poisson arrivals with a bimodal prompt mix
+    (defaults to 8,512) — the result gains ``ttft_ms_by_prompt_len``
+    so the short-prompt TTFT tail is visible next to the long one.
+    ``retry_429`` makes a 429 response honor its ``Retry-After`` and
+    resubmit (bounded) instead of counting a hard rejection — the
+    honest open-loop behavior against a saturated server or gateway (a
+    real client backs off; it does not give up)."""
+    if workload not in ("text", "json", "churn", "mixed-prefill"):
+        raise ValueError(f"workload must be 'text', 'json', 'churn' or "
+                         f"'mixed-prefill', got {workload!r}")
+    if workload == "mixed-prefill":
+        # the disagg interference regime: bimodal prompt lengths under
+        # Poisson arrivals (open loop — the honest view of the tail the
+        # tier split exists to fix)
+        if prompt_lens is None:
+            prompt_lens = [8, 512]
+        if rate is None:
+            rate = max(2.0, 2.0 * concurrency)
+        if not stream:
+            raise ValueError("workload='mixed-prefill' measures TTFT/"
+                             "TPOT tails; it needs streaming responses")
     if workload == "churn":
         # churn shape unless the caller pinned its own knobs (None is the
         # unset sentinel — an explicit 0 really means "never disconnect")
@@ -277,6 +302,21 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     ttfts = [r["ttft_s"] for r in done if r.get("ttft_s") is not None]
     gaps = [g for r in done for g in r.get("gaps_s", ())]
     total_tokens = sum(r["tokens"] for r in done)
+    # TTFT split by prompt bucket: with a bimodal mix, the aggregate p95
+    # is just the long bucket's p50 — the split is what shows whether
+    # short prompts kept their latency next to long ones (the
+    # mixed-prefill acceptance signal)
+    by_len: dict[int, list[float]] = {}
+    for i, r in enumerate(results):
+        if r and r.get("tokens") and r.get("ttft_s") is not None:
+            ln = len(frags[i].get("prompt_ids")
+                     or frags[i].get("prompt", ""))
+            by_len.setdefault(ln, []).append(r["ttft_s"])
+    ttft_by_len = {
+        str(ln): {"p50": round(_percentile(xs, 0.5) * 1e3, 1),
+                  "p95": round(_percentile(xs, 0.95) * 1e3, 1),
+                  "n": len(xs)}
+        for ln, xs in sorted(by_len.items())}
     return {
         "requests": n,
         "completed": len(done),
@@ -297,19 +337,27 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
             "p50": round(_percentile(gaps, 0.5) * 1e3, 2),
             "p95": round(_percentile(gaps, 0.95) * 1e3, 2),
         },
+        **({"ttft_ms_by_prompt_len": ttft_by_len}
+           if len(ttft_by_len) > 1 else {}),
         "results": results,
     }
 
 
 def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
-                policy: str = "p2c"):
+                policy: str = "p2c", roles: list[str] | None = None,
+                max_seq: int = 128):
     """Smoke support for the gateway plane: build ``n`` tiny
     random-weight serve replicas IN PROCESS plus a routing gateway in
     front, so one command (``--spawn-backends N``) drives a whole
     loopback fleet with zero setup. Returns ``(gateway, cleanup)`` —
-    call ``cleanup()`` when done. Deliberately heavyweight imports live
-    here, not at module top: plain loadgen against a remote URL stays
-    stdlib-only."""
+    call ``cleanup()`` when done. ``roles`` (ISSUE 13, aligned with the
+    replicas) builds a TIERED fleet: every engine goes paged (KV moves
+    between replicas as pool pages), decode replicas get a transfer
+    listener, and the gateway's two-stage route engages by itself once
+    its prober discovers the tiers — e.g. ``roles=["prefill",
+    "decode"]`` is the minimal disagg deployment. Deliberately
+    heavyweight imports live here, not at module top: plain loadgen
+    against a remote URL stays stdlib-only."""
     import jax
 
     from cake_tpu.gateway.api import start_gateway
@@ -322,24 +370,56 @@ def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
     from cake_tpu.serve.api import start_api_server
     from cake_tpu.serve.scheduler import Scheduler
 
-    cfg = tiny(max_seq_len=128, eos_token_id=-1)
+    if roles is not None:
+        if len(roles) != n:
+            raise ValueError(f"{len(roles)} roles for {n} replicas")
+        bad = [r for r in roles if r not in ("mixed", "prefill", "decode")]
+        if bad:
+            raise ValueError(f"unknown role(s) {bad}")
+    cfg = tiny(max_seq_len=max_seq, eos_token_id=-1)
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
     stacks = []
-    for _ in range(n):
+    xfer_servers = []
+    for i in range(n):
+        role = roles[i] if roles is not None else "mixed"
+        # tiered fleets run paged engines everywhere (the A/B against a
+        # mixed fleet must compare the tier split, not the KV layout)
+        kw = ({"kv_layout": "paged", "kv_page_size": 16}
+              if roles is not None else {})
         gen = BatchGenerator(
             cfg, params,
-            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.0))
-        sched = Scheduler(gen, queue_depth=queue_depth)
+            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.0),
+            **kw)
+        sched = Scheduler(gen, queue_depth=queue_depth, role=role)
         sched.start(max_concurrent=max_concurrent, warm_prompt_len=8)
+        if role == "decode":
+            from cake_tpu.disagg import TransferServer
+
+            ts = TransferServer(sched).start()
+            sched.transfer_port = ts.port
+            xfer_servers.append(ts)
         stacks.append((start_api_server(sched), sched))
     backends = [Backend(f"b{i}", f"127.0.0.1:{srv.port}")
                 for i, (srv, _) in enumerate(stacks)]
     monitor = HealthMonitor(backends, probe_interval=0.5).start()
     gateway = start_gateway(monitor, make_policy(policy))
+    if roles is not None and any(r != "mixed" for r in roles):
+        # the two-stage route needs the prober's tier map before the
+        # first request (an undiscovered decode tier would silently
+        # route classically — and 400 off the prefill replicas)
+        deadline = time.monotonic() + 10.0
+        want = {r for r in roles if r != "mixed"}
+        while time.monotonic() < deadline:
+            seen = {b.role for b in monitor.routable()}
+            if want <= seen:
+                break
+            time.sleep(0.05)
 
     def cleanup() -> None:
         gateway.close()
         monitor.stop()
+        for ts in xfer_servers:
+            ts.stop()
         for srv, sched in stacks:
             srv.close()
             sched.close()
@@ -374,7 +454,8 @@ def main(argv=None) -> int:
                         "server-side tokenizer; overrides --prompt-len)")
     p.add_argument("--no-stream", action="store_true",
                    help="unary JSON responses instead of SSE")
-    p.add_argument("--workload", choices=["text", "json", "churn"],
+    p.add_argument("--workload", choices=["text", "json", "churn",
+                                          "mixed-prefill"],
                    default="text",
                    help="json: schema-constrained requests "
                         "(response_format json_schema), responses "
@@ -383,7 +464,11 @@ def main(argv=None) -> int:
                         "(--rate defaults to 2x concurrency), a "
                         "short/long prompt mix (--prompt-len defaults "
                         "to 8,64), every 4th client disconnecting "
-                        "mid-stream (--disconnect-every)")
+                        "mid-stream (--disconnect-every). "
+                        "mixed-prefill: the disagg interference regime "
+                        "— Poisson arrivals with a bimodal prompt mix "
+                        "(--prompt-len defaults to 8,512); the report "
+                        "splits TTFT by prompt bucket")
     p.add_argument("--disconnect-every", type=int, default=None,
                    dest="disconnect_every", metavar="N",
                    help="every Nth request walks away after 2 tokens "
@@ -399,6 +484,13 @@ def main(argv=None) -> int:
                         "replicas plus a routing gateway and drive the "
                         "gateway (no url needed) — one command exercises "
                         "the whole loopback fleet")
+    p.add_argument("--spawn-roles", default=None, dest="spawn_roles",
+                   metavar="ROLE,...",
+                   help="with --spawn-backends: per-replica roles "
+                        "(mixed|prefill|decode, comma-separated, count "
+                        "must match) — 'prefill,decode' spawns the "
+                        "minimal tiered fleet and the gateway's "
+                        "two-stage route engages by itself")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=300.0)
     args = p.parse_args(argv)
@@ -406,11 +498,20 @@ def main(argv=None) -> int:
         p.error("--spawn-backends must be >= 1")
     if args.url is None and args.spawn_backends is None:
         p.error("a server url is required (or --spawn-backends N)")
+    roles = None
+    if args.spawn_roles is not None:
+        if args.spawn_backends is None:
+            p.error("--spawn-roles needs --spawn-backends")
+        roles = [r.strip() for r in args.spawn_roles.split(",")
+                 if r.strip()]
+        if len(roles) != args.spawn_backends:
+            p.error(f"--spawn-roles lists {len(roles)} roles for "
+                    f"--spawn-backends {args.spawn_backends}")
     lens = ([int(x) for x in args.prompt_len.split(",") if x.strip()]
             if args.prompt_len else None)
     url, cleanup = args.url, None
     if args.spawn_backends:
-        gateway, cleanup = spawn_fleet(args.spawn_backends)
+        gateway, cleanup = spawn_fleet(args.spawn_backends, roles=roles)
         url = args.url or f"http://127.0.0.1:{gateway.port}"
     try:
         stats = run_load(

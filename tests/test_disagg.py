@@ -1,0 +1,826 @@
+"""Disaggregated prefill/decode serving (cake_tpu/disagg).
+
+`make disagg-smoke` acceptance: a stream's KV-page snapshot round-trips
+BIT-IDENTICALLY to an uninterrupted run — greedy and sampled, across
+wire codecs (none always; bf16 on a bf16 cache; int8 on an
+int8-quantized pool), for constrained streams resuming mid-grammar, and
+for mid-window multi-page streams; an import into a full pool defers
+FIFO-fair instead of dropping; pinned transfer pages survive eviction
+storms (the kvpool pin/unpin regression); the transfer channel retries
+through chaos-proxy kill/truncate/corrupt/stall faults and NEVER
+retries a deterministic reject; and the gateway's two-stage route
+(prefill tier -> KV transfer -> decode resume) serves streams
+bit-identical to a direct engine, falling back to transparent
+re-prefill with zero failed requests when the transfer channel dies.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from cake_tpu.constrain.guide import guide_for
+from cake_tpu.disagg import (
+    SnapshotMismatch,
+    TransferError,
+    TransferRejected,
+    TransferServer,
+    decode_snapshot,
+    encode_snapshot,
+    peek_xfer_id,
+    send_snapshot,
+)
+from cake_tpu.disagg.snapshot import SnapshotError
+from cake_tpu.gateway.api import start_gateway
+from cake_tpu.gateway.health import Backend, HealthMonitor
+from cake_tpu.gateway.policy import make_policy, pick_decode, pick_prefill
+from cake_tpu.kvpool import PagePool
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.batch_generator import BatchGenerator
+from cake_tpu.serve.api import start_api_server
+from cake_tpu.serve.scheduler import Scheduler
+from cake_tpu.testing.chaos import ChaosProxy, parse_spec
+
+# eos disabled (-1 never sampled): deterministic stream lengths, so every
+# round-trip can compare exact token sequences
+CFG = tiny(max_seq_len=64, eos_token_id=-1)
+GREEDY = dict(temperature=0.0, repeat_penalty=1.1)
+
+
+class _FakeTok:
+    """id -> letter (alnum decodes, the test_serve convention)."""
+
+    def decode(self, ids):
+        return "".join(chr(ord("a") + (i % 26)) for i in ids)
+
+    def encode(self, text):
+        return [ord(c) - ord("a") for c in text]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(11))
+
+
+def _gen(params, cfg=CFG, pool=None, quant=None, tokenizer=None,
+         **settings):
+    kw = {"kv_pool_pages": pool} if pool else {}
+    return BatchGenerator(
+        cfg, params, tokenizer=tokenizer,
+        settings=SamplerSettings(**(settings or GREEDY)),
+        kv_layout="paged", kv_page_size=16, kv_quant=quant, **kw)
+
+
+def _drive(gen, sid, want, max_steps=400):
+    """step() until stream ``sid`` holds ``want`` tokens; returns them."""
+    for _ in range(max_steps):
+        got = _tokens(gen, sid)
+        if got is not None and len(got) >= want \
+                and not gen.pending_admissions():
+            return got[:want]
+        gen.step()
+    raise AssertionError(f"stream {sid} never reached {want} tokens")
+
+
+def _tokens(gen, sid):
+    for s in gen.streams:
+        if s.active and not s.done and s.stream_id == sid:
+            return list(s.generated)
+    return None
+
+
+def _retire_all(gen):
+    for s in list(gen.streams):
+        if s.active and not s.done:
+            gen.finish(s.stream_id)
+
+
+# -- snapshot format (host-only) ---------------------------------------------
+
+
+def _snap_kwargs(**over):
+    pages = [{"k": np.arange(96, dtype=np.float32).reshape(2, 2, 8, 3),
+              "v": np.ones((2, 2, 8, 3), np.float32)}]
+    kw = dict(xfer_id="xfer-1", fingerprint={"layers": 2}, codec="none",
+              stream_id=3, prompt=[1, 2, 3], generated=[9, 8], pos=5,
+              index=5, last_token=8,
+              key=np.array([7, 9], np.uint32),
+              history=np.full(8, -1, np.int32), hist_slot=2,
+              guide_spec=None, guide_state=0, pages=pages)
+    kw.update(over)
+    return kw
+
+
+class TestSnapshotFormat:
+    def test_round_trip_fields(self):
+        data = encode_snapshot(**_snap_kwargs(
+            guide_spec={"type": "regex", "pattern": "ab"}, guide_state=4))
+        s = decode_snapshot(data)
+        assert (s.xfer_id, s.stream_id, s.pos, s.last_token) == \
+            ("xfer-1", 3, 5, 8)
+        assert s.prompt == [1, 2, 3] and s.generated == [9, 8]
+        assert s.guide_spec == {"type": "regex", "pattern": "ab"}
+        assert s.guide_state == 4 and s.hist_slot == 2
+        np.testing.assert_array_equal(
+            s.pages[0]["k"], _snap_kwargs()["pages"][0]["k"])
+        assert peek_xfer_id(data) == "xfer-1"
+
+    def test_bad_magic_version_truncation(self):
+        data = encode_snapshot(**_snap_kwargs())
+        with pytest.raises(SnapshotError, match="magic"):
+            decode_snapshot(b"NOPE" + data[4:])
+        with pytest.raises(SnapshotError, match="version"):
+            decode_snapshot(data[:4] + b"\xff\x7f" + data[6:])
+        with pytest.raises(SnapshotError, match="truncated"):
+            decode_snapshot(data[:10])
+        with pytest.raises(SnapshotError, match="truncated"):
+            decode_snapshot(data[:-5])
+        with pytest.raises(SnapshotError, match="trailing"):
+            decode_snapshot(data + b"JUNK")
+
+    def test_quant_pages_scales_ride_lossless(self):
+        pages = [{"kq": np.arange(24, dtype=np.int8).reshape(2, 1, 4, 3),
+                  "ks": np.linspace(0.1, 1, 8,
+                                    dtype=np.float32).reshape(2, 1, 4),
+                  "vq": np.zeros((2, 1, 4, 3), np.int8),
+                  "vs": np.ones((2, 1, 4), np.float32)}]
+        data = encode_snapshot(**_snap_kwargs(pages=pages, codec="int8"))
+        s = decode_snapshot(data)
+        # int8 payloads pass through; float32 scales are forced onto the
+        # none codec — both sides bit-exact despite codec="int8"
+        np.testing.assert_array_equal(s.pages[0]["kq"], pages[0]["kq"])
+        np.testing.assert_array_equal(s.pages[0]["ks"], pages[0]["ks"])
+
+    def test_quant_scales_survive_bf16_codec(self):
+        # review regression: scales must ride lossless under EVERY codec
+        # — a bf16 cast would round the float32 scales and silently
+        # corrupt the dequantized KV on import
+        scales = np.linspace(0.1, 1, 8, dtype=np.float32).reshape(2, 1, 4)
+        assert not np.array_equal(  # the values a bf16 trip would lose
+            scales, scales.astype("bfloat16").astype(np.float32))
+        pages = [{"kq": np.arange(24, dtype=np.int8).reshape(2, 1, 4, 3),
+                  "ks": scales,
+                  "vq": np.zeros((2, 1, 4, 3), np.int8),
+                  "vs": np.ones((2, 1, 4), np.float32)}]
+        s = decode_snapshot(
+            encode_snapshot(**_snap_kwargs(pages=pages, codec="bf16")))
+        np.testing.assert_array_equal(s.pages[0]["ks"], pages[0]["ks"])
+        np.testing.assert_array_equal(s.pages[0]["kq"], pages[0]["kq"])
+
+
+# -- kvpool transfer pins (the refcount fix) ---------------------------------
+
+
+class TestPagePoolPins:
+    def test_pin_is_a_claim_outside_tables_and_tree(self):
+        p = PagePool(8, 4)
+        a = p.alloc()
+        p.pin(a)
+        assert p.pincount(a) == 1 and p.pinned_count == 1
+        # the stream's claim retires; the pin alone keeps the page live
+        assert not p.unref(a)
+        assert p.refcount(a) == 1 and p.free_count == 6
+        assert p.unpin(a)  # last claim: NOW it frees
+        assert p.pinned_count == 0 and p.free_count == 7
+
+    def test_unpin_unpinned_raises(self):
+        p = PagePool(8, 4)
+        a = p.alloc()
+        with pytest.raises(ValueError, match="unpin"):
+            p.unpin(a)
+
+    def test_sink_never_pins(self):
+        p = PagePool(8, 4)
+        p.pin(0)
+        assert p.pinned_count == 0 and not p.unpin(0)
+
+    def test_stats_and_gauge(self):
+        p = PagePool(8, 4)
+        a = p.alloc()
+        p.pin(a)
+        assert p.stats()["pages_pinned"] == 1
+
+
+# -- routing policy (tier picks) ---------------------------------------------
+
+
+def _probed(addr, role="mixed", queued=0, running=0, slots=4,
+            inflight=0, transfer_port=0):
+    b = Backend(f"pt{addr.rsplit(':', 1)[-1]}", addr)
+    load = {"queued": queued, "running": running, "max_concurrent": slots,
+            "role": role, "kv_transfers_inflight": inflight}
+    if transfer_port:
+        load["transfer_port"] = transfer_port
+    b.probe_ok(load, up_after=1)
+    return b
+
+
+class TestTierPolicy:
+    def test_prober_records_role_and_transfer_addr(self):
+        b = _probed("127.0.0.1:9001", role="decode", transfer_port=7001)
+        assert b.role == "decode"
+        assert b.transfer_addr() == "127.0.0.1:7001"
+        assert _probed("127.0.0.1:9002").transfer_addr() is None
+
+    def test_pick_prefill_least_queue(self):
+        a = _probed("127.0.0.1:9010", role="prefill", queued=5)
+        b = _probed("127.0.0.1:9011", role="prefill", queued=1)
+        assert pick_prefill([a, b]) is b
+
+    def test_pick_prefill_counts_inflight_transfers(self):
+        a = _probed("127.0.0.1:9012", role="prefill", queued=1, inflight=9)
+        b = _probed("127.0.0.1:9013", role="prefill", queued=2)
+        assert pick_prefill([a, b]) is b
+
+    def test_pick_decode_prefix_affinity_stable(self):
+        tier = [_probed(f"127.0.0.1:902{i}", role="decode",
+                        transfer_port=7000 + i) for i in range(3)]
+        key = b"ids:1,2,3"
+        picks = {pick_decode(tier, key=key).name for _ in range(8)}
+        assert len(picks) == 1  # rendezvous: same key -> same replica
+
+    def test_pick_decode_saturated_preferred_falls_back(self):
+        # whichever replica rendezvous prefers for this key, a saturated
+        # one must lose to the idle one (affinity never queues)
+        busy = _probed("127.0.0.1:9030", role="decode", queued=4,
+                       running=4, slots=4, transfer_port=7030)
+        idle = _probed("127.0.0.1:9031", role="decode", transfer_port=7031)
+        now = time.monotonic()
+        assert pick_decode([busy, idle], key=b"ids:9", now=now) is idle
+
+
+# -- engine round trips ------------------------------------------------------
+
+
+def _export_after(gen, sid, n_tokens, codec="none"):
+    _drive(gen, sid, n_tokens)
+    return gen.export_stream(sid, codec=codec)
+
+
+def _import_fresh(params, snap, sid=7, **gen_kw):
+    """New engine with retired seed streams, snapshot attached as
+    ``sid`` — the decode-replica shape (import lands in a pool whose
+    slots have history)."""
+    g = _gen(params, **gen_kw)
+    g.set_prompts([[9, 9], [8, 8]])
+    _retire_all(g)
+    g.import_stream(snap, stream_id=sid)
+    return g
+
+
+class TestRoundTrip:
+    """The acceptance bit: resumed continuation == uninterrupted one."""
+
+    def test_greedy(self, params):
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4], [5, 6, 7]])
+        snap = _export_after(a, 0, 5)
+        ref = _drive(a, 0, 16)
+        b = _import_fresh(params, snap)
+        assert _drive(b, 7, 16) == ref
+
+    def test_sampled(self, params):
+        kw = dict(temperature=0.9, top_p=0.95, repeat_penalty=1.1,
+                  seed=123)
+        a = _gen(params, **kw)
+        a.set_prompts([[1, 2, 3, 4], [5, 6, 7]])
+        snap = _export_after(a, 0, 5)
+        ref = _drive(a, 0, 16)
+        # the raw per-stream key rides the snapshot: bit-identity holds
+        # even though the importer has a different seed and stream id
+        b = _import_fresh(params, snap, **dict(kw, seed=999))
+        assert _drive(b, 7, 16) == ref
+
+    def test_mid_window_multi_page(self, params):
+        a = _gen(params)
+        a.set_prompts([list(range(1, 21)), [5, 6, 7]])  # 20-token prompt
+        # pos = prompt 20 + 2 fed tokens (the 3rd rides as last_token
+        # still unfed): page 2 of 2, mid-page
+        snap = _export_after(a, 0, 3)
+        s = decode_snapshot(snap)
+        assert s.n_pages == 2 and s.pos == 22 and s.last_token is not None
+        ref = _drive(a, 0, 12)
+        b = _import_fresh(params, snap)
+        assert _drive(b, 7, 12) == ref
+
+    def test_constrained_resumes_mid_grammar(self, params):
+        tok = _FakeTok()
+        spec = {"type": "regex", "pattern": "[a-d]{30}"}
+        a = _gen(params, tokenizer=tok)
+        a.set_prompts([[1, 2, 3], [4, 5]],
+                      guides=[guide_for(spec, tok, CFG), None])
+        snap = _export_after(a, 0, 4)
+        parsed = decode_snapshot(snap)
+        assert parsed.guide_spec == spec and parsed.guide_state != 0
+        ref = _drive(a, 0, 12)
+        b = _import_fresh(params, snap, tokenizer=tok)
+        got = _drive(b, 7, 12)
+        assert got == ref
+        assert all(c in "abcd" for c in tok.decode(got))
+
+    def test_int8_pool_int8_codec(self, params):
+        a = _gen(params, quant="int8")
+        a.set_prompts([[1, 2, 3, 4], [5, 6, 7]])
+        snap = _export_after(a, 0, 5, codec="int8")
+        ref = _drive(a, 0, 14)
+        b = _import_fresh(params, snap, quant="int8")
+        assert _drive(b, 7, 14) == ref
+
+    def test_bf16_cache_bf16_codec(self):
+        cfg = tiny(max_seq_len=64, eos_token_id=-1, dtype="bfloat16")
+        params16 = llama.init_params(cfg, jax.random.PRNGKey(11))
+        a = _gen(params16, cfg=cfg)
+        a.set_prompts([[1, 2, 3, 4], [5, 6, 7]])
+        snap = _export_after(a, 0, 5, codec="bf16")
+        ref = _drive(a, 0, 14)
+        b = _gen(params16, cfg=cfg)
+        b.set_prompts([[9, 9], [8, 8]])
+        _retire_all(b)
+        b.import_stream(snap, stream_id=7)
+        assert _drive(b, 7, 14) == ref
+
+    def test_fingerprint_mismatch_refused(self, params):
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4]])
+        snap = _export_after(a, 0, 3)
+        other_cfg = tiny(max_seq_len=32, eos_token_id=-1)
+        b = _gen(llama.init_params(other_cfg, jax.random.PRNGKey(11)),
+                 cfg=other_cfg)
+        b.set_prompts([[1]])
+        _retire_all(b)
+        with pytest.raises(SnapshotMismatch, match="max_seq"):
+            b.import_begin(snap)
+
+    def test_import_idempotent_by_xfer_id(self, params):
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4]])
+        snap = _export_after(a, 0, 3)
+        b = _gen(params)
+        b.set_prompts([[9, 9]])
+        _retire_all(b)
+        m1 = b.import_begin(snap)
+        m2 = b.import_begin(snap)  # duplicate send (retry after lost ACK)
+        assert m1["xfer_id"] == m2["xfer_id"]
+        assert b.imports_pending() == 1
+
+    def test_export_requires_live_stream_and_paged(self, params):
+        g = _gen(params)
+        g.set_prompts([[1, 2, 3]])
+        with pytest.raises(ValueError, match="no live stream"):
+            g.export_stream(99)
+        slot_gen = BatchGenerator(CFG, params,
+                                  settings=SamplerSettings(**GREEDY))
+        slot_gen.set_prompts([[1, 2, 3]])
+        with pytest.raises(ValueError, match="paged"):
+            slot_gen.export_stream(0)
+
+
+# -- pool pressure: FIFO-fair deferral + pinned pages ------------------------
+
+
+class TestPoolPressure:
+    def test_import_into_full_pool_defers_fifo_fair(self, params):
+        a = _gen(params)
+        a.set_prompts([[1] * 40])
+        snap = _export_after(a, 0, 12)  # pos 52: a 4-page snapshot
+
+        # 3 streams x 4 pages fill the 16-page pool (15 usable + sink
+        # leaves 3 free): the import's 4-page landing must wait for a
+        # retirement — deferred, never dropped
+        b = _gen(params, pool=16)
+        b.set_prompts([[1] * 40, [2] * 40, [3] * 40])
+        for sid in (0, 1, 2):
+            _drive(b, sid, 12)  # pos 52: all 4 pages per stream
+        defers0 = b._pagepool._defer_ctr.value
+        b.import_begin(snap)
+        b.import_attach(peek_xfer_id(snap), 7)
+        b.enqueue([5, 6, 7], 9)  # a plain admission queued BEHIND it
+        for _ in range(6):
+            b.step()
+        # head-of-queue import deferred; the arrival behind it must not
+        # jump the line (FIFO-fair) — nothing admitted, nothing dropped
+        assert b.imports_pending() == 1
+        assert b.pending_admissions() == 3
+        assert b._pagepool._defer_ctr.value > defers0
+        ref = _drive(a, 0, 18)
+        b.finish(2)  # retire one stream: 4 pages + a slot free up
+        assert _drive(b, 7, 18) == ref  # import landed + resumed FIRST
+        b.finish(0)  # now a slot frees for the queued prompt behind it
+        assert _drive(b, 9, 2)
+
+    def test_import_stream_foreign_blocked_head_raises(self, params):
+        # review regression: a FOREIGN arrival at the FIFO head that
+        # cannot start (every slot live) used to make import_stream
+        # busy-loop forever — it must raise like admit() does, and the
+        # begun import must be aborted (no pins left behind)
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4], [5, 6]])
+        snap = _export_after(a, 0, 3)
+        b = _gen(params)
+        b.set_prompts([[9, 9], [8, 8]])  # every slot live, none retired
+        b.enqueue([7, 7, 7], 50)  # queued prompt ahead of the attach
+        with pytest.raises(RuntimeError, match="no free slot"):
+            b.import_stream(snap, stream_id=7)
+        assert b.imports_pending() == 0
+
+    def test_evict_storm_cannot_free_pinned_pages(self, params):
+        """Regression for the pin claim kind: pages of a
+        begun-but-unattached import survive alloc/evict storms under
+        pool pressure, and the eventual resume is still bit-identical."""
+        a = _gen(params)
+        a.set_prompts([list(range(1, 21)), [5, 6]])
+        snap = _export_after(a, 0, 4)
+        ref = _drive(a, 0, 12)
+
+        b = _gen(params, pool=16)
+        b.set_prompts([[7, 7, 7], [6, 6]])
+        _retire_all(b)
+        b.import_begin(snap)
+        xid = peek_xfer_id(snap)
+        for _ in range(8):  # land the pages (import tick; no attach yet)
+            b.step()
+            if b._imports[xid]["pages"] is not None:
+                break
+        pinned = list(b._imports[xid]["pages"])
+        assert pinned and all(b._pagepool.pincount(p) == 1
+                              for p in pinned)
+        # storm: admissions + retirements churn every free page and
+        # force prefix-tree eviction, while the transfer stays stalled
+        for i in range(6):
+            b.enqueue([i + 1] * 36, 100 + i)
+            _drive(b, 100 + i, 8)
+            b.finish(100 + i)
+        assert all(b._pagepool.pincount(p) == 1 for p in pinned)
+        for s in b.streams:  # no stream table ever claimed a pinned page
+            if s.active and not s.done:
+                assert not set(pinned) & set(
+                    b._tables[b.streams.index(s)])
+        b.import_attach(xid, 7)
+        assert _drive(b, 7, 12) == ref
+
+    def test_import_abort_releases_pins(self, params):
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4]])
+        snap = _export_after(a, 0, 3)
+        b = _gen(params)
+        b.set_prompts([[9, 9]])
+        _retire_all(b)
+        b.import_begin(snap)
+        xid = peek_xfer_id(snap)
+        for _ in range(8):
+            b.step()
+            if b._imports[xid]["pages"] is not None:
+                break
+        free0 = b._pagepool.free_count
+        assert b.import_abort(xid)
+        assert b._pagepool.free_count > free0
+        assert b._pagepool.pinned_count == 0
+        assert not b.import_abort(xid)  # unknown now
+
+    def test_expire_imports_sweeps_orphans(self, params):
+        a = _gen(params)
+        a.set_prompts([[1, 2, 3, 4]])
+        snap = _export_after(a, 0, 3)
+        b = _gen(params)
+        b.set_prompts([[9, 9]])
+        _retire_all(b)
+        b.import_begin(snap)
+        assert b.expire_imports(ttl_s=3600) == 0
+        assert b.expire_imports(ttl_s=0.0) == 1
+        assert b.imports_pending() == 0
+
+
+# -- the transfer channel ----------------------------------------------------
+
+
+class _StubSched:
+    """submit_import-only stand-in for the TransferServer tests."""
+
+    def __init__(self, fail: str | None = None, timeouts: int = 0):
+        self.fail = fail
+        self.timeouts = timeouts  # raise TimeoutError this many times
+        self.calls = 0
+        self.payloads: list[bytes] = []
+
+    def submit_import(self, payload: bytes) -> dict:
+        self.calls += 1
+        if self.timeouts > 0:
+            self.timeouts -= 1
+            raise TimeoutError("engine thread did not pick up the import")
+        if self.fail:
+            raise ValueError(self.fail)
+        self.payloads.append(bytes(payload))
+        return {"xfer_id": "x"}
+
+
+class TestTransferChannel:
+    def test_ack_path_delivers_payload(self):
+        sched = _StubSched()
+        srv = TransferServer(sched).start()
+        try:
+            send_snapshot("127.0.0.1", srv.port, b"\x01" * 2048,
+                          deadline_s=5.0)
+        finally:
+            srv.stop()
+        assert sched.payloads == [b"\x01" * 2048]
+
+    def test_reject_is_never_retried(self):
+        sched = _StubSched(fail="fingerprint mismatch: nope")
+        srv = TransferServer(sched).start()
+        try:
+            with pytest.raises(TransferRejected, match="fingerprint"):
+                send_snapshot("127.0.0.1", srv.port, b"pay",
+                              deadline_s=5.0)
+        finally:
+            srv.stop()
+        assert sched.calls == 1  # deterministic refusal: exactly one try
+
+    def test_engine_timeout_is_retried_not_rejected(self):
+        # review regression: a busy engine thread (submit_import
+        # TimeoutError) is TRANSIENT — the server must drop the
+        # connection so the sender's retry delivers, never answer the
+        # deterministic XFER_REJECT
+        sched = _StubSched(timeouts=1)
+        srv = TransferServer(sched).start()
+        try:
+            send_snapshot("127.0.0.1", srv.port, b"\x03" * 256,
+                          deadline_s=10.0, ack_timeout_s=2.0)
+        finally:
+            srv.stop()
+        assert sched.calls >= 2
+        assert sched.payloads == [b"\x03" * 256]
+
+    def test_unreachable_exhausts_retry_budget(self):
+        with pytest.raises(TransferError, match="failed after"):
+            send_snapshot("127.0.0.1", 1, b"pay", deadline_s=0.4,
+                          connect_timeout_s=0.2)
+
+    @pytest.mark.parametrize("spec", ["kill@1", "truncate@1",
+                                      "corrupt@1", "stall@1=700"])
+    def test_chaos_faults_recover_by_retry(self, spec):
+        """One faulted connection, then clean: the sender's
+        reconnect-and-resend delivers the payload intact. A resend may
+        hand the receiver a duplicate (``kill`` forwards the frame
+        before closing, so the ACK is what dies) — real receivers dedup
+        by transfer id (`test_import_idempotent_by_xfer_id`); here the
+        stub just records."""
+        sched = _StubSched()
+        srv = TransferServer(sched).start()
+        proxy = ChaosProxy("127.0.0.1", srv.port,
+                           parse_spec(spec)).start()
+        try:
+            send_snapshot("127.0.0.1", proxy.port, b"\x02" * 512,
+                          deadline_s=10.0, ack_timeout_s=2.0)
+        finally:
+            proxy.stop()
+            srv.stop()
+        assert proxy.events, f"fault {spec} never fired"
+        assert sched.payloads and all(p == b"\x02" * 512
+                                      for p in sched.payloads)
+
+
+# -- serve plane: roles over HTTP --------------------------------------------
+
+
+def _serve_stack(params, role, **sched_kw):
+    gen = _gen(params)
+    sched = Scheduler(gen, queue_depth=8, request_timeout_s=60,
+                      role=role, **sched_kw)
+    sched.start(max_concurrent=2, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    return srv, sched
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+class TestServeRoles:
+    def test_role_needs_disagg_engine(self, params):
+        slot_gen = BatchGenerator(CFG, params,
+                                  settings=SamplerSettings(**GREEDY))
+        with pytest.raises(ValueError, match="paged"):
+            Scheduler(slot_gen, role="prefill")
+        with pytest.raises(ValueError, match="role"):
+            Scheduler(_gen(params), role="bogus")
+
+    def test_healthz_advertises_tier_fields(self, params):
+        srv, sched = _serve_stack(params, "decode")
+        ts = TransferServer(sched).start()
+        sched.transfer_port = ts.port
+        try:
+            status, body = _get_json(
+                f"http://127.0.0.1:{srv.port}/healthz")
+            assert status == 200
+            assert body["role"] == "decode"
+            assert body["kv_transfers_inflight"] == 0
+            assert body["transfer_port"] == ts.port
+        finally:
+            ts.stop()
+            srv.close()
+            sched.close()
+
+    def test_resume_replay_clamps_to_max_tokens(self, params):
+        # review regression: a snapshot can carry MORE generated tokens
+        # than the resume request's budget — the replay must clamp at
+        # max_tokens (finish "length"), not re-emit the whole snapshot
+        exp = _gen(params)
+        exp.set_prompts([[1, 2, 3], [4, 5]])
+        ref = _drive(exp, 0, 5)
+        snap = exp.export_stream(0)
+        srv, sched = _serve_stack(params, "decode")
+        ts = TransferServer(sched).start()
+        try:
+            send_snapshot("127.0.0.1", ts.port, snap, deadline_s=10.0)
+            got = _sse_ids(f"http://127.0.0.1:{srv.port}", [1, 2, 3],
+                           max_tokens=3,
+                           _resume={"xfer_id": peek_xfer_id(snap)})
+            assert got == ref[:3]
+        finally:
+            ts.stop()
+            srv.close()
+            sched.close()
+
+    def test_prefill_replica_refuses_plain_requests(self, params):
+        srv, sched = _serve_stack(params, "prefill")
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"prompt_ids": [1, 2, 3],
+                                 "max_tokens": 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            assert "prefill" in json.loads(ei.value.read())["error"]
+        finally:
+            srv.close()
+            sched.close()
+
+
+# -- gateway two-stage routing (end to end) ----------------------------------
+
+
+class _Fleet:
+    """1 prefill + 1 decode replica + gateway, with an optional chaos
+    proxy on the transfer channel (the decode replica advertises the
+    PROXY's port, so every KV snapshot rides through the faults)."""
+
+    def __init__(self, params, faults=None, transfer_deadline_s=10.0):
+        self.pre_srv, self.pre = _serve_stack(
+            params, "prefill", transfer_deadline_s=transfer_deadline_s)
+        self.dec_srv, self.dec = _serve_stack(params, "decode")
+        self.ts = TransferServer(self.dec).start()
+        self.proxy = None
+        port = self.ts.port
+        if faults is not None:
+            self.proxy = ChaosProxy("127.0.0.1", self.ts.port,
+                                    faults).start()
+            port = self.proxy.port
+        self.dec.transfer_port = port
+        self.monitor = HealthMonitor(
+            [Backend(f"dz{next(_SEQ)}",
+                     f"127.0.0.1:{self.pre_srv.port}"),
+             Backend(f"dz{next(_SEQ)}",
+                     f"127.0.0.1:{self.dec_srv.port}")],
+            probe_interval=0.2, up_after=1).start()
+        self.gw = start_gateway(self.monitor, make_policy("p2c"))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if {b.role for b in self.monitor.routable()} >= \
+                    {"prefill", "decode"}:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("tier map never discovered")
+        self.url = f"http://127.0.0.1:{self.gw.port}"
+
+    def close(self):
+        self.gw.close()
+        self.monitor.stop()
+        if self.proxy is not None:
+            self.proxy.stop()
+        self.ts.stop()
+        for srv, sched in ((self.pre_srv, self.pre),
+                           (self.dec_srv, self.dec)):
+            srv.close()
+            sched.close()
+
+
+_SEQ = iter(range(10_000))
+
+
+def _sse_ids(url, prompt_ids, max_tokens=10, **extra):
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps({"prompt_ids": prompt_ids,
+                         "max_tokens": max_tokens,
+                         "stream": True, **extra}).encode(),
+        headers={"Content-Type": "application/json"})
+    ids = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for raw in r:
+            raw = raw.strip()
+            if not raw.startswith(b"data: "):
+                continue
+            data = raw[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            assert "error" not in ev, ev
+            if "token" in ev:
+                ids.append(ev["token"])
+    return ids
+
+
+def _reference(params, prompt_ids, n):
+    g = _gen(params)
+    g.set_prompts([prompt_ids, [5, 6]])
+    return _drive(g, 0, n)
+
+
+class TestGatewayTiered:
+    PROMPT = [3, 1, 4, 1, 5, 9]
+
+    def test_two_stage_route_bit_identical(self, params):
+        ref = _reference(params, self.PROMPT, 10)
+        fleet = _Fleet(params)
+        try:
+            h0 = obs_metrics.counter("disagg.handoffs").value
+            got = _sse_ids(fleet.url, self.PROMPT, max_tokens=10)
+            assert got == ref
+            deadline = time.monotonic() + 5.0
+            while obs_metrics.counter("disagg.handoffs").value <= h0:
+                assert time.monotonic() < deadline, \
+                    "tiered route never engaged (classic fallback?)"
+                time.sleep(0.05)
+        finally:
+            fleet.close()
+
+    def test_chaos_on_transfer_channel_still_bit_identical(self, params):
+        """kill + truncate faults on successive transfer connections:
+        the channel's retry absorbs them, the client stream is still
+        bit-identical, zero failed requests."""
+        ref = _reference(params, self.PROMPT, 10)
+        fleet = _Fleet(params,
+                       faults=parse_spec("kill@1,truncate@1"))
+        try:
+            for _ in range(2):  # two requests, one per scheduled fault
+                assert _sse_ids(fleet.url, self.PROMPT,
+                                max_tokens=10) == ref
+            assert len(fleet.proxy.events) == 2
+        finally:
+            fleet.close()
+
+    def test_dead_transfer_channel_reprefills_transparently(self, params):
+        """Every transfer connect refused: the prefill leg fails its
+        retry budget, the gateway re-prefills on the classic path — the
+        client still gets the full bit-identical stream and no error."""
+        ref = _reference(params, self.PROMPT, 10)
+        fleet = _Fleet(params, faults=parse_spec("refuse=999"),
+                       transfer_deadline_s=1.5)
+        try:
+            r0 = obs_metrics.counter("disagg.reprefills").value
+            got = _sse_ids(fleet.url, self.PROMPT, max_tokens=10)
+            assert got == ref
+            assert obs_metrics.counter("disagg.reprefills").value > r0
+        finally:
+            fleet.close()
+
+    def test_empty_decode_tier_routes_classically(self, params):
+        """1 prefill + 1 mixed: no decode tier, so the classic path
+        carries everything — and never lands on the prefill replica."""
+        ref = _reference(params, self.PROMPT, 8)
+        pre_srv, pre = _serve_stack(params, "prefill")
+        mix_srv, mix = _serve_stack(params, "mixed")
+        monitor = HealthMonitor(
+            [Backend(f"dz{next(_SEQ)}", f"127.0.0.1:{pre_srv.port}"),
+             Backend(f"dz{next(_SEQ)}", f"127.0.0.1:{mix_srv.port}")],
+            probe_interval=0.2, up_after=1).start()
+        gw = start_gateway(monitor, make_policy("p2c"))
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if {b.role for b in monitor.routable()} >= \
+                        {"prefill", "mixed"}:
+                    break
+                time.sleep(0.05)
+            url = f"http://127.0.0.1:{gw.port}"
+            e0 = obs_metrics.counter("disagg.exports").value
+            for _ in range(3):
+                assert _sse_ids(url, self.PROMPT, max_tokens=8) == ref
+            assert obs_metrics.counter("disagg.exports").value == e0
+        finally:
+            gw.close()
+            monitor.stop()
+            for srv, sched in ((pre_srv, pre), (mix_srv, mix)):
+                srv.close()
+                sched.close()
