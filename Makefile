@@ -11,8 +11,9 @@ test:
 
 # lint = syntax + (optional) pyflakes + cakelint, the project-invariant
 # AST checker suite (cake_tpu/analysis): metric-series catalog, engine
-# ownership, _GUARDED_BY lock discipline, jit trace purity, wire/resource
-# safety. Fails on any finding not grandfathered (with a justification)
+# ownership, _GUARDED_BY lock discipline, jit trace purity, wire
+# safety, claim lifecycles (acquire/release pairing), and thread
+# domains. Fails on any finding not grandfathered (with a justification)
 # in analysis-baseline.json. See README "Static analysis".
 lint:
 	$(PY) -m compileall -q cake_tpu tests bench.py __graft_entry__.py

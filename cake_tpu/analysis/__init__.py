@@ -18,9 +18,11 @@ from cake_tpu.analysis.core import (  # noqa: F401
     Module,
     run_checkers,
 )
+from cake_tpu.analysis.claims import ClaimChecker
 from cake_tpu.analysis.engine_ownership import EngineOwnershipChecker
 from cake_tpu.analysis.guarded_by import GuardedByChecker
 from cake_tpu.analysis.metrics_catalog import MetricsCatalogChecker
+from cake_tpu.analysis.thread_domains import ThreadDomainChecker
 from cake_tpu.analysis.trace_purity import TracePurityChecker
 from cake_tpu.analysis.wire_safety import WireSafetyChecker
 
@@ -30,6 +32,8 @@ ALL_CHECKERS = (
     GuardedByChecker,
     TracePurityChecker,
     WireSafetyChecker,
+    ClaimChecker,
+    ThreadDomainChecker,
 )
 
 

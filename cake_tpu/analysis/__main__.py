@@ -64,7 +64,13 @@ def main(argv=None) -> int:
     roots = args.paths or None
     mods, parse_findings = core.load_modules(roots)
     full = core.is_full_scan(roots)
-    findings = core.check_modules(mods, checkers, full, parse_findings)
+    # unused suppressions are judged like stale baseline entries: only
+    # when the run could have re-found what the comment suppresses —
+    # full surface, every checker enabled
+    unused = [] if (full and not args.checkers) else None
+    findings = core.check_modules(mods, checkers, full, parse_findings,
+                                  unused_out=unused)
+    unused = unused or []
 
     if args.write_baseline:
         seeded = baseline_mod.from_findings(findings)
@@ -98,8 +104,9 @@ def main(argv=None) -> int:
             "new": [f.to_dict() for f in new],
             "baselined": [f.to_dict() for f in suppressed],
             "stale_baseline_entries": [e.to_dict() for e in stale],
+            "unused_suppressions": unused,
             "summary": {"new": len(new), "baselined": len(suppressed),
-                        "stale": len(stale)},
+                        "stale": len(stale), "unused": len(unused)},
         }, indent=1))
     else:
         for f in new:
@@ -107,10 +114,16 @@ def main(argv=None) -> int:
         for e in stale:
             print(f"STALE baseline entry (violation fixed — delete it): "
                   f"{e.checker}:{e.path}:{e.key}")
+        for u in unused:
+            ids = "" if u["ids"] is None else f"[{', '.join(u['ids'])}]"
+            print(f"UNUSED suppression (nothing to suppress — delete "
+                  f"it): {u['path']}:{u['line']}: "
+                  f"cakelint: ignore{ids}")
         tail = (f"cakelint: {len(new)} new finding(s), "
                 f"{len(suppressed)} baselined, {len(stale)} stale "
-                "baseline entr(ies)")
-        print(tail if (new or suppressed or stale)
+                f"baseline entr(ies), {len(unused)} unused "
+                "suppression(s)")
+        print(tail if (new or suppressed or stale or unused)
               else "cakelint: clean (0 findings)")
     return 1 if new else 0
 

@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
+import tokenize
 from pathlib import Path
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
@@ -34,6 +36,10 @@ REPO_ROOT = Path(__file__).resolve().parents[2]
 DEFAULT_ROOTS = ("cake_tpu", "examples", "bench.py", "__graft_entry__.py")
 
 _SKIP_DIRS = {"__pycache__", ".git", "native"}
+
+# sentinel for "no suppression comment on this line" (a bare ignore
+# comment parses to None-ids, so None cannot also mean absence)
+_NO_IGNORE = object()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,26 +93,67 @@ class Module:
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=str(path))
         add_parents(self.tree)
+        self._comments: dict[int, str] | None = None
 
     def line(self, lineno: int) -> str:
         if 1 <= lineno <= len(self.lines):
             return self.lines[lineno - 1]
         return ""
 
-    def suppressed(self, finding: Finding) -> bool:
-        """``cakelint: ignore[ID]`` on the finding's line or the line
-        above (the comment-only-line idiom)."""
+    def comment_at(self, lineno: int) -> str:
+        """The REAL comment token on ``lineno`` ('' if none), from one
+        lazy tokenize pass — so a ``#`` inside a string literal can
+        neither suppress nor read as a suppression comment."""
+        if self._comments is None:
+            comments: dict[int, str] = {}
+            try:
+                for tok in tokenize.generate_tokens(
+                        io.StringIO(self.source).readline):
+                    if tok.type == tokenize.COMMENT:
+                        comments[tok.start[0]] = tok.string
+            except tokenize.TokenError:
+                pass  # already ast-parsed; truncated trailer at worst
+            self._comments = comments
+        return self._comments.get(lineno, "")
+
+    def suppression_line(self, finding: Finding) -> int | None:
+        """Line number of the ``cakelint: ignore[ID]`` comment covering
+        this finding (its own line or the line above — the
+        comment-only-line idiom), or None."""
         for ln in (finding.line, finding.line - 1):
-            text = self.line(ln)
-            if "cakelint: ignore" not in text:
+            ids = self.ignore_at(ln)
+            if ids is _NO_IGNORE:
                 continue
-            mark = text.split("cakelint: ignore", 1)[1]
-            if not mark.startswith("["):  # bare ignore: every checker
-                return True
-            ids = [i.strip() for i in mark[1:].split("]", 1)[0].split(",")]
-            if finding.checker in ids:
-                return True
-        return False
+            if ids is None or finding.checker in ids:
+                return ln
+        return None
+
+    def suppressed(self, finding: Finding) -> bool:
+        return self.suppression_line(finding) is not None
+
+    def ignore_at(self, lineno: int):
+        """Parse a suppression comment on ``lineno``: returns the
+        ``_NO_IGNORE`` sentinel when there is none, else the listed
+        checker ids (or None for a bare id-less ignore). The marker must
+        sit inside the line's actual comment token — prose mentions in
+        docstrings or string literals don't suppress."""
+        text = self.comment_at(lineno)
+        if "cakelint: ignore" not in text:
+            return _NO_IGNORE
+        mark = text.split("cakelint: ignore", 1)[1]
+        if not mark.startswith("["):  # bare ignore: every checker
+            return None
+        return [i.strip() for i in mark[1:].split("]", 1)[0].split(",")]
+
+    def ignore_comments(self):
+        """Every suppression comment in the file: ``[(line, ids|None)]``
+        (ids None = bare ignore)."""
+        out = []
+        for ln, text in enumerate(self.lines, start=1):
+            parsed = self.ignore_at(ln)
+            if parsed is not _NO_IGNORE:
+                out.append((ln, parsed))
+        return out
 
 
 class Checker:
@@ -290,11 +337,18 @@ def is_full_scan(roots, repo_root: Path | None = None) -> bool:
     return False
 
 
-def check_modules(mods, checkers, full: bool = True, parse_findings=()):
+def check_modules(mods, checkers, full: bool = True, parse_findings=(),
+                  unused_out: list | None = None):
     """Run ``checkers`` over an already-parsed module list (one walk of
     the tree feeds both the checkers and any caller that needs the
     scanned-path set). ``full=False`` skips cross-file ``finalize``
-    passes. Returns sorted findings with suppressions applied."""
+    passes. Returns sorted findings with suppressions applied.
+
+    With ``unused_out`` (a list), suppression comments that suppressed
+    NOTHING this run are appended as ``{"path", "line", "ids"}`` dicts —
+    the in-source twin of a stale baseline entry. Callers pass it only
+    on full scans with every checker enabled: a subset run cannot tell
+    "nothing to suppress" from "the suppressing checker didn't run"."""
     findings = list(parse_findings)
     by_rel = {m.rel: m for m in mods}
     for checker in checkers:
@@ -303,11 +357,21 @@ def check_modules(mods, checkers, full: bool = True, parse_findings=()):
         if full:
             findings.extend(checker.finalize(mods))
     kept = []
+    hits: set[tuple[str, int]] = set()
     for f in findings:
         mod = by_rel.get(f.path)
-        if mod is not None and mod.suppressed(f):
-            continue
+        if mod is not None:
+            ln = mod.suppression_line(f)
+            if ln is not None:
+                hits.add((f.path, ln))
+                continue
         kept.append(f)
+    if unused_out is not None:
+        for mod in mods:
+            for ln, ids in mod.ignore_comments():
+                if (mod.rel, ln) not in hits:
+                    unused_out.append(
+                        {"path": mod.rel, "line": ln, "ids": ids})
     return sorted(kept, key=Finding.sort_key)
 
 

@@ -1,6 +1,9 @@
-"""CK-WIRE: wire/resource safety — deadlines, leaks, protocol arms.
+"""CK-WIRE: wire-protocol safety — recv deadlines + protocol arms.
 
-Three arms, all encoding lessons this repo already paid for:
+Two arms, both encoding lessons this repo already paid for (the third
+original arm — socket/fd leak escape analysis — migrated into the
+declarative CK-CLAIM framework, :mod:`cake_tpu.analysis.claims`, as its
+``fd`` rule):
 
 1. **recv deadlines** — the seed's ``settimeout(None)`` hole let one
    wedged peer pin a master forever; ISSUE 4 added per-op deadlines.
@@ -10,22 +13,15 @@ Three arms, all encoding lessons this repo already paid for:
    ``socket.recv(n)`` byte reads — positional size arg — are out of
    scope; the framing layer bounds those.)
 
-2. **resource leaks on error paths** — a socket/file acquired outside a
-   ``with`` must be closed where an exception can't skip it. The checker
-   flags an acquisition (``open``, ``socket.socket``,
-   ``create_connection``, ``urlopen``, ``.accept()``, ``wire.connect``)
-   bound to a local name when statements that can raise sit between the
-   acquisition and its release (return/store/close), with no enclosing
-   ``with`` and no ``try`` whose handler or ``finally`` closes it.
-   Immediate hand-off (``self.x = open(...)``, ``return Conn(sock=s)``
-   as the very next statement) is fine — ownership moved before
-   anything could throw.
-
-3. **MsgType arms** — a protocol member with a decode arm but no encode
+2. **protocol arms** — a protocol member with a decode arm but no encode
    arm (or vice versa) is dead weight at best and a skew trap at worst.
-   Cross-module pass: every ``MsgType`` member needs at least one send
-   site (``conn.send(MsgType.X, ...)``) and one dispatch site
-   (``t == MsgType.X`` / ``t in (MsgType.X, ...)``) across the tree.
+   Cross-module pass over BOTH protocol vocabularies in the tree: every
+   ``MsgType`` enum member needs at least one send site
+   (``conn.send(MsgType.X, ...)``) and one dispatch site
+   (``t == MsgType.X`` / ``t in (MsgType.X, ...)``), and so does every
+   frame constant in the declared :data:`FRAME_CONST_GROUPS` families —
+   the disagg transfer channel's ``XFER_*`` ints ride the same wire
+   framing without an enum, and skew hides there just as well.
 """
 
 from __future__ import annotations
@@ -34,46 +30,23 @@ import ast
 
 from cake_tpu.analysis import core
 
-_ACQUIRE_LAST = {"create_connection", "urlopen", "accept"}
-
-# Method names that store their argument in a longer-lived owner —
-# passing a resource to one of these is an ownership hand-off, same as
-# `self.x = var` (a bare helper call like `_set_keepalive(sock)` is NOT:
-# helpers use, owners store).
-_STORE_METHODS = {"append", "add", "put", "insert", "register", "push",
-                  "setdefault"}
-
-
-def _acquisition(call: ast.Call) -> str | None:
-    """Short label if this call acquires a closable resource."""
-    chain = core.attr_chain(call.func)
-    if not chain:
-        return None
-    last = chain[-1]
-    if chain == ["open"]:
-        return "open"
-    if len(chain) >= 2 and chain[-2:] == ["socket", "socket"]:
-        return "socket.socket"
-    if last in _ACQUIRE_LAST and len(chain) >= 2:
-        return last
-    if last == "connect" and any("wire" in p.lower() for p in chain[:-1]):
-        return "wire.connect"
-    return None
+# Frame-constant protocol families: (module rel, constant-name prefix).
+# Members are module-level ALL-CAPS ints; send/dispatch arms are judged
+# tree-wide exactly like MsgType members.
+FRAME_CONST_GROUPS = (
+    ("cake_tpu/disagg/transfer.py", "XFER_"),
+)
 
 
 class WireSafetyChecker(core.Checker):
     id = "CK-WIRE"
     name = "wire-safety"
-    description = ("Connection.recv passes an explicit timeout; sockets/"
-                   "files are exception-safe; every MsgType has encode "
-                   "and decode arms")
+    description = ("Connection.recv passes an explicit timeout; every "
+                   "MsgType member and declared frame constant has "
+                   "encode and decode arms")
 
     # -- arm 1: recv deadlines --------------------------------------------
     def check_module(self, mod: core.Module):
-        yield from self._check_recv(mod)
-        yield from self._check_resources(mod)
-
-    def _check_recv(self, mod):
         for node in ast.walk(mod.tree):
             if not (isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Attribute)
@@ -93,224 +66,12 @@ class WireSafetyChecker(core.Checker):
                 key=f"recv:{recv_of}",
             )
 
-    # -- arm 2: resource leaks --------------------------------------------
-    def _check_resources(self, mod):
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            kind = _acquisition(node)
-            if kind is None:
-                continue
-            stmt = core.statement_of(node)
-            if stmt is None or self._inside_with(node):
-                continue
-            finding = self._classify(mod, node, stmt, kind)
-            if finding is not None:
-                yield finding
-
-    @staticmethod
-    def _inside_with(node) -> bool:
-        """Acquisition used as (or inside) a `with` context expression."""
-        for anc in core.ancestors(node):
-            if isinstance(anc, ast.With):
-                for item in anc.items:
-                    if node in ast.walk(item.context_expr):
-                        return True
-        return False
-
-    def _classify(self, mod, call, stmt, kind):
-        # baseline keys are qualified by the enclosing function so one
-        # grandfathered leak can't silently cover a future same-named
-        # variable elsewhere in the file
-        fn = core.enclosing_function(call)
-        where = getattr(fn, "name", "<module>") if fn is not None \
-            else "<module>"
-        # unbound acquisition: fine when the same expression closes it
-        # (`wire.connect(...).close()`) or stores it in an owner
-        # (`self.pool.append(open(p))`); otherwise it's simply dropped
-        if isinstance(stmt, ast.Expr):
-            p = core.parent(call)
-            if (isinstance(p, ast.Attribute) and p.attr == "close"):
-                return None
-            if isinstance(stmt.value, ast.Call) and core.call_name(
-                    stmt.value) == "close":
-                return None
-            for anc in core.ancestors(call):
-                if (isinstance(anc, ast.Call)
-                        and core.call_name(anc) in _STORE_METHODS):
-                    return None
-            return self.finding(
-                mod, call,
-                f"{kind}(...) result is dropped without close()",
-                hint="bind it and close it, or chain .close()",
-                key=f"res:{kind}:{where}:dropped",
-            )
-        if not isinstance(stmt, ast.Assign):
-            return None  # return open(...) etc.: caller owns it
-        # self.x = open(...) / handles[k] = ... : owner object manages it
-        targets = []
-        for t in stmt.targets:
-            if isinstance(t, ast.Name):
-                targets.append(t.id)
-            elif isinstance(t, ast.Tuple):
-                targets.extend(e.id for e in t.elts
-                               if isinstance(e, ast.Name))
-            else:
-                return None  # attribute/subscript target: ownership moved
-        if not targets:
-            return None
-        var = targets[0]
-        fn = core.enclosing_function(stmt)
-        body_root = fn if fn is not None else mod.tree
-        release = self._first_release(body_root, stmt, var)
-        if release is None:
-            return self.finding(
-                mod, call,
-                f"{kind}(...) bound to '{var}' is never closed, stored, "
-                "or returned in this function",
-                hint=f"close '{var}' in a finally, or use `with`",
-                key=f"res:{kind}:{where}:{var}",
-            )
-        if self._protected(body_root, stmt, var):
-            return None
-        if not self._risky_between(body_root, stmt, release):
-            return None  # released immediately: nothing can raise first
-        return self.finding(
-            mod, call,
-            f"'{var}' ({kind}) can leak: statements between the "
-            f"acquisition (line {stmt.lineno}) and its release (line "
-            f"{release.lineno}) may raise, and no try/finally closes it",
-            hint=f"wrap the in-between work in try/except with "
-                 f"`{var}.close()` on the error path (or move it under a "
-                 "`with`)",
-            key=f"res:{kind}:{where}:{var}",
-        )
-
-    @staticmethod
-    def _hands_off(expr, var) -> bool:
-        """True if ``expr`` passes ownership of ``var`` somewhere — the
-        var appears as a VALUE (bare name, call argument, container
-        element), not merely as the receiver of a method call:
-        ``Connection(sock=sock)`` hands off, ``data = sock.recv(n)`` is
-        just a read and the caller still owns the socket."""
-        for n in ast.walk(expr):
-            if (isinstance(n, ast.Name) and n.id == var
-                    and not isinstance(core.parent(n), ast.Attribute)):
-                return True
-        return False
-
-    @classmethod
-    def _first_release(cls, root, acq_stmt, var):
-        """First post-acquisition release node: return/yield handing the
-        var off, an assignment whose RHS hands it off, or an explicit
-        .close()."""
-        acq_nodes = set(map(id, ast.walk(acq_stmt)))
-        best = None
-        for node in ast.walk(root):
-            line = getattr(node, "lineno", None)
-            if line is None or line < acq_stmt.lineno or id(node) in acq_nodes:
-                continue
-            released = False
-            if isinstance(node, (ast.Return, ast.Yield)) and node.value \
-                    is not None and cls._hands_off(node.value, var):
-                released = True
-            elif isinstance(node, ast.Assign) and cls._hands_off(
-                    node.value, var):
-                released = True
-            elif (isinstance(node, ast.Call)
-                  and isinstance(node.func, ast.Attribute)
-                  and node.func.attr == "close"
-                  and core.attr_chain(node.func.value) == [var]):
-                released = True
-            elif (isinstance(node, ast.Call)
-                  and core.call_name(node) in _STORE_METHODS
-                  and any(cls._hands_off(a, var) for a in node.args)):
-                released = True  # conns.append(var): stored in an owner
-            if released and (best is None or line < best.lineno):
-                best = node
-        return best
-
-    @staticmethod
-    def _next_stmt(stmt):
-        """The statement executed after ``stmt`` on the fallthrough
-        path: its next sibling, lifting through enclosing blocks (a
-        statement that ends a try body continues at the try's
-        successor)."""
-        cur = stmt
-        while cur is not None:
-            p = core.parent(cur)
-            for field in ("body", "orelse", "finalbody"):
-                lst = getattr(p, field, None)
-                if isinstance(lst, list) and cur in lst:
-                    i = lst.index(cur)
-                    if i + 1 < len(lst):
-                        return lst[i + 1]
-                    break
-            cur = p if isinstance(p, ast.stmt) else (
-                core.statement_of(p) if p is not None
-                and not isinstance(p, ast.Module) else None)
-            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return None
-        return None
-
-    @classmethod
-    def _protected(cls, root, acq_stmt, var) -> bool:
-        """A try that actually covers the held-bare region and closes
-        the var in a handler or finally: either it encloses the
-        acquisition, or it is the very next statement after it (nothing
-        can raise in between)."""
-        def closes(nodes) -> bool:
-            for n in nodes:
-                for c in ast.walk(n):
-                    if (isinstance(c, ast.Call)
-                            and isinstance(c.func, ast.Attribute)
-                            and c.func.attr == "close"
-                            and core.attr_chain(c.func.value) == [var]):
-                        return True
-            return False
-
-        def try_closes(t) -> bool:
-            return isinstance(t, ast.Try) and (
-                closes(t.finalbody) or closes(t.handlers))
-
-        for anc in core.ancestors(acq_stmt):
-            if try_closes(anc):
-                return True
-        nxt = cls._next_stmt(acq_stmt)
-        return try_closes(nxt)
-
-    @staticmethod
-    def _risky_between(root, acq_stmt, release) -> bool:
-        """Any call strictly between acquisition and release that can
-        raise while the resource is held bare. Excluded: calls inside
-        the release's own statement (`if cond: var.close()` — the test
-        belongs to the release), and calls inside the handlers/orelse of
-        the try wrapping the acquisition (the resource is unbound on
-        those paths)."""
-        lo = acq_stmt.end_lineno or acq_stmt.lineno
-        release_stmt = core.statement_of(release)
-        excluded = set(map(id, ast.walk(release_stmt))) if release_stmt \
-            is not None else set()
-        if release_stmt is not None:
-            # the guard of a conditional release (`if stop: var.close()`)
-            # is part of the release decision, not held-bare work
-            for anc in core.ancestors(release_stmt):
-                if isinstance(anc, (ast.If, ast.While)):
-                    excluded.update(map(id, ast.walk(anc.test)))
-        for anc in core.ancestors(acq_stmt):
-            if isinstance(anc, ast.Try) and acq_stmt in anc.body:
-                for part in (*anc.handlers, *anc.orelse):
-                    excluded.update(map(id, ast.walk(part)))
-                break
-        for node in ast.walk(root):
-            if isinstance(node, ast.Call) and id(node) not in excluded:
-                line = getattr(node, "lineno", 0)
-                if lo < line < release.lineno:
-                    return True
-        return False
-
-    # -- arm 3: MsgType encode/decode arms --------------------------------
+    # -- arm 2: protocol encode/decode arms --------------------------------
     def finalize(self, mods):
+        yield from self._check_msgtype(mods)
+        yield from self._check_frame_consts(mods)
+
+    def _check_msgtype(self, mods):
         enum_mod, enum_cls = self._find_enum(mods)
         if enum_cls is None:
             return
@@ -338,6 +99,50 @@ class WireSafetyChecker(core.Checker):
                     sends.add(member)
                 elif use == "dispatch":
                     dispatches.add(member)
+        yield from self._missing_arms(enum_mod, enum_cls, "MsgType.",
+                                      members, sends, dispatches,
+                                      key_fmt="MsgType.{member}:{arm}")
+
+    def _check_frame_consts(self, mods):
+        by_rel = {m.rel: m for m in mods}
+        for rel, prefix in FRAME_CONST_GROUPS:
+            mod = by_rel.get(rel)
+            if mod is None:
+                continue  # family module not in this (full) scan surface
+            anchors: dict[str, ast.AST] = {}
+            for stmt in mod.tree.body:
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id.startswith(prefix)
+                        and stmt.targets[0].id.isupper()
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, int)):
+                    anchors[stmt.targets[0].id] = stmt
+            sends: set[str] = set()
+            dispatches: set[str] = set()
+            for m in mods:
+                for node in ast.walk(m.tree):
+                    name = None
+                    if isinstance(node, ast.Name) and node.id in anchors:
+                        name = node.id
+                    elif isinstance(node, ast.Attribute) \
+                            and node.attr in anchors:
+                        name = node.attr  # re-exported: transfer.XFER_ACK
+                    if name is None:
+                        continue
+                    use = self._usage(node)
+                    if use == "send":
+                        sends.add(name)
+                    elif use == "dispatch":
+                        dispatches.add(name)
+            for member, anchor in anchors.items():
+                yield from self._missing_arms(
+                    mod, anchor, "frame constant ", [member],
+                    sends, dispatches, key_fmt="frame:{member}:{arm}")
+
+    def _missing_arms(self, mod, anchor, label, members, sends,
+                      dispatches, key_fmt):
         for member in members:
             missing = [arm for arm, have in (("send", sends),
                                              ("dispatch", dispatches))
@@ -346,12 +151,12 @@ class WireSafetyChecker(core.Checker):
                 verb = ("is never sent (no encode arm)" if arm == "send"
                         else "is never dispatched on (no decode arm)")
                 yield self.finding(
-                    enum_mod, enum_cls,
-                    f"MsgType.{member} {verb} anywhere in the tree",
+                    mod, anchor,
+                    f"{label}{member} {verb} anywhere in the tree",
                     hint="wire both arms, or baseline a deliberate "
                          "one-sided member (e.g. reference-protocol "
                          "compat) with a justification",
-                    key=f"MsgType.{member}:{arm}",
+                    key=key_fmt.format(member=member, arm=arm),
                 )
 
     @staticmethod

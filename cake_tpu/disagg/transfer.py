@@ -35,6 +35,13 @@ from cake_tpu.runtime.retry import RetryPolicy, retry_call
 
 log = logging.getLogger("cake_tpu.disagg.transfer")
 
+# Thread domain (cakelint CK-THREAD): sends run on serve handler
+# threads, receives on the TransferServer's per-connection threads —
+# neither may touch the engine; inbound snapshots cross into the engine
+# domain only through the scheduler's submit_import/abort_import
+# crossing points (its condition-locked import inbox).
+_THREAD_DOMAIN = "transfer"
+
 # frame types, clear of the worker protocol's MsgType range (1..9): the
 # transfer channel is its own listener/port, but distinct ids keep a
 # misrouted frame an obvious error instead of a confusing decode

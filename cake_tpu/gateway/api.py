@@ -44,6 +44,12 @@ from cake_tpu.obs import statusd as _statusd
 
 log = logging.getLogger("cake_tpu.gateway.api")
 
+# Thread domain (cakelint CK-THREAD): module code — the nested Handler
+# class included — runs on the gateway's HTTP handler threads. The
+# gateway never holds engine-domain objects; backends are reached over
+# HTTP and all shared state is "any"-domain (internally locked).
+_THREAD_DOMAIN = "handler"
+
 REQUESTS = obs_metrics.counter("gateway.requests")
 RETRIES = obs_metrics.counter("gateway.retries")
 REJECTED = obs_metrics.counter("gateway.rejected")
@@ -98,6 +104,11 @@ class _Attempt:
 
 class GatewayServer:
     """The routing front door; ``start_gateway`` is the entry point."""
+
+    # cakelint CK-THREAD: the gateway holds no engine-domain state —
+    # in-flight accounting is condition-locked (CK-LOCK below) and
+    # every backend touch goes through the "any"-domain health plane
+    _THREAD_DOMAIN = "any"
 
     # in-flight accounting shared between handler threads and drain()
     _GUARDED_BY = {"_inflight": "_cond", "_draining": "_cond"}

@@ -58,6 +58,10 @@ BREAKER_OPEN = obs_metrics.gauge("gateway.breaker_open")
 class Backend:
     """One serve replica: address, health state, and live load signal."""
 
+    # cakelint CK-THREAD: internally locked, callable from any domain
+    # (handler threads route and report; the prober thread probes)
+    _THREAD_DOMAIN = "any"
+
     # Shared between HTTP handler threads (routing + passive signals) and
     # the monitor's probe thread; every touch goes through the lock
     # (machine-checked by cakelint CK-LOCK).
@@ -262,6 +266,11 @@ class Backend:
 
 class HealthMonitor:
     """Background ``/healthz`` prober over a fixed backend set."""
+
+    # cakelint CK-THREAD: every mutation goes through Backend's lock;
+    # the monitor's own state is an Event + immutable config, so its
+    # surface is callable from handler threads and the prober alike
+    _THREAD_DOMAIN = "any"
 
     def __init__(self, backends: list[Backend], probe_interval: float = 2.0,
                  down_after: int = 2, up_after: int = 2,

@@ -44,6 +44,12 @@ from cake_tpu.models.config import LlamaConfig
 from cake_tpu.ops.kvcache import KVCache, QuantizedKV
 from cake_tpu.parallel.mesh import STAGE, TP, cache_specs
 
+# Thread domain (cakelint CK-THREAD): the compiled-program memo
+# (_POOL_PROGRAMS) and every host-called pool program dispatch are
+# engine-thread work — same single-writer contract as the page tables
+# these programs move rows for.
+_THREAD_DOMAIN = "engine"
+
 
 def pool_specs(kv_quant: str | None = None):
     """PartitionSpec pytree for the pool: layers over stage, kv heads

@@ -43,6 +43,10 @@ class PrefixTree:
     (released at eviction); streams that match take their own references.
     """
 
+    # cakelint CK-THREAD: tree mutations ride the pool's page claims,
+    # so the runtime twin asserts through the shared pool stamp
+    _THREAD_DOMAIN = "engine"
+
     def __init__(self, pool: PagePool):
         self.pool = pool
         self.page_size = pool.page_size

@@ -38,6 +38,7 @@ from __future__ import annotations
 from collections import deque
 
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.runtime import threadcheck
 
 # the reserved garbage-sink page id (gathers beyond the frontier, scatters
 # from retired/dummy rows); never allocated, never attendable
@@ -58,6 +59,14 @@ class PagePool:
     BatchGenerator mutation); publishes the ``kvpool.*`` gauges/counters.
     """
 
+    # Thread domain, machine-checked by cakelint CK-THREAD: page claims
+    # (alloc/ref/unref/pin/unpin) are engine-thread mutations. The
+    # owning BatchGenerator shares its _domain_stamp with the pool, so
+    # the runtime twin (CAKE_THREAD_STRICT=1) asserts the same contract
+    # in execution; a standalone pool's stamp is never stamped and the
+    # checks are vacuous.
+    _THREAD_DOMAIN = "engine"
+
     def __init__(self, num_pages: int, page_size: int):
         if num_pages < 2:
             raise ValueError(f"need >= 2 pages (sink + one), got {num_pages}")
@@ -68,6 +77,8 @@ class PagePool:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         self.num_pages = num_pages
         self.page_size = page_size
+        # replaced by the owning engine's stamp when one adopts the pool
+        self._domain_stamp = threadcheck.DomainStamp("engine")
         self._refs = [0] * num_pages
         self._refs[SINK] = 1  # pinned: the sink is never allocatable
         self._free: deque[int] = deque(range(1, num_pages))
@@ -92,6 +103,7 @@ class PagePool:
         """Take a free page (refcount 1). Raises :class:`PoolExhausted`
         when the free list is empty — callers evict from the prefix tree
         first (``BatchGenerator._alloc_page``)."""
+        self._domain_stamp.check("PagePool.alloc")
         if not self._free:
             raise PoolExhausted(
                 f"kv page pool exhausted ({self.num_pages} pages, "
@@ -103,6 +115,7 @@ class PagePool:
 
     def ref(self, pid: int) -> None:
         """Add a reference (a stream or the prefix tree sharing the page)."""
+        self._domain_stamp.check("PagePool.ref")
         if pid == SINK:
             return
         if self._refs[pid] <= 0:
@@ -115,6 +128,7 @@ class PagePool:
     def unref(self, pid: int) -> bool:
         """Drop one reference; returns True when the page went back to the
         free list."""
+        self._domain_stamp.check("PagePool.unref")
         if pid == SINK:
             return False
         if self._refs[pid] <= 0:
@@ -136,6 +150,7 @@ class PagePool:
         sharing stream can retire, and the page still cannot return to
         the free list (and so can never be reallocated and overwritten)
         until the last pin drops."""
+        self._domain_stamp.check("PagePool.pin")
         if pid == SINK:
             return
         self.ref(pid)
@@ -147,6 +162,7 @@ class PagePool:
     def unpin(self, pid: int) -> bool:
         """Drop one transfer claim; returns True when the page freed
         (the transfer was its last claim)."""
+        self._domain_stamp.check("PagePool.unpin")
         if pid == SINK:
             return False
         if self._pins[pid] <= 0:

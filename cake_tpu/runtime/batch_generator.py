@@ -99,6 +99,7 @@ from cake_tpu.parallel.pipeline import (
     build_sharded_prefill,
 )
 from cake_tpu.runtime.generator import Token, _bucket, encode_prompt
+from cake_tpu.runtime import threadcheck
 from cake_tpu.utils.token_stream import TokenOutputStream
 
 
@@ -141,6 +142,21 @@ class BatchGenerator:
     a multiple of dp with inactive dummy rows). ``block_size > 1`` fuses that
     many decode steps per dispatch, same key schedule.
     """
+
+    # Thread domain, machine-checked by cakelint CK-THREAD (the
+    # declarative generalization of CK-ENGINE's single-writer rule):
+    # every un-listed method runs on the engine-owner thread only —
+    # annotated caller code (serve/gateway handler threads, transfer
+    # receivers) must route through the scheduler's crossing points.
+    # `_encode` is this class's own crossing point: a stateless
+    # tokenizer pass the scheduler's handler-facing encode_prompt uses.
+    # Instances travel as `self.engine` handles, hence the alias. The
+    # runtime twin (CAKE_THREAD_STRICT=1, runtime/threadcheck) asserts
+    # the same contract: the scheduler stamps its engine thread into
+    # _domain_stamp at start and the annotated mutators check it.
+    _THREAD_DOMAIN = "engine"
+    _THREAD_ALIASES = ("engine",)
+    _THREAD_SAFE = ("_encode",)
 
     def __init__(
         self,
@@ -191,6 +207,12 @@ class BatchGenerator:
         # sequence-sharded KV rows inside the cycle loop)
         self.config = config
         self.plan = plan
+        # engine-owner thread stamp (runtime twin of CK-THREAD): the
+        # serve scheduler stamps its engine thread here at start and
+        # clears it on exit; unstamped, every check is vacuous, so
+        # single-threaded drives (bench, examples, tests) run unchanged
+        # even under CAKE_THREAD_STRICT=1
+        self._domain_stamp = threadcheck.DomainStamp("engine")
         self.settings = settings or SamplerSettings()
         sampling.validate_logit_bias(self.settings, config.vocab_size)
         # Per-token top-k logprob reporting (serve `logprobs: N`): the
@@ -813,6 +835,7 @@ class BatchGenerator:
         aligned with ``prompts``; None entries = unconstrained) attach a
         constrain.Guide per stream — its grammar masks every sampling step
         including this call's first token."""
+        self._domain_stamp.check("BatchGenerator.set_prompts")
         if not prompts:
             raise ValueError("empty batch")
         ids_list = [self._encode(p) for p in prompts]
@@ -1014,6 +1037,7 @@ class BatchGenerator:
         compatibility is checked HERE (a serve scheduler turns the
         ValueError into a 400) rather than at attach time on the engine
         thread (where it would read as an engine fault)."""
+        self._domain_stamp.check("BatchGenerator.enqueue")
         self._check_guide_ok(guide)
         self._arrivals.append((self._encode(prompt), stream_id, guide, None))
 
@@ -1061,6 +1085,9 @@ class BatchGenerator:
                 f"{self._ppp} pages/stream + sink: a live batch could "
                 "exhaust the pool mid-decode")
         self._pagepool = PagePool(pages, ps)
+        # the pool shares its engine's domain stamp: page claims are
+        # engine-thread mutations wherever they happen
+        self._pagepool._domain_stamp = self._domain_stamp
         self._prefix_tree = PrefixTree(self._pagepool)
         self._tables = [[] for _ in range(b)]
         self._page_map_dev = None
@@ -1181,17 +1208,29 @@ class BatchGenerator:
         shared: list[int] = []
         if n_full:
             _, staging = self._staged_prefix
-            shared = [self._pagepool.alloc() for _ in range(n_full)]
             ids_vec = np.zeros((self._ppp,), np.int32)
-            ids_vec[:n_full] = shared
-            pool = self._row_scatter(pool, staging, jnp.asarray(ids_vec))
-            if self._prefix_entries > 0:
-                # register for future ADMISSION reuse only when the
-                # prefix cache is enabled (0 disables it, same contract
-                # as the slot store) — the batch itself still shares the
-                # physical pages either way, and without the tree claim
-                # they free when the last sharer retires
-                self._prefix_tree.insert(prefix_ids[: n_full * ps], shared)
+            shared = [self._pagepool.alloc() for _ in range(n_full)]
+            # the pages are held only by this local until the per-stream
+            # tables take their refs below — release them on the error
+            # path (cakelint CK-CLAIM: the scatter dispatch can raise,
+            # and stranded alloc claims would pin pool pages forever)
+            try:
+                ids_vec[:n_full] = shared
+                pool = self._row_scatter(pool, staging,
+                                         jnp.asarray(ids_vec))
+                if self._prefix_entries > 0:
+                    # register for future ADMISSION reuse only when the
+                    # prefix cache is enabled (0 disables it, same
+                    # contract as the slot store) — the batch itself
+                    # still shares the physical pages either way, and
+                    # without the tree claim they free when the last
+                    # sharer retires
+                    self._prefix_tree.insert(prefix_ids[: n_full * ps],
+                                             shared)
+            except BaseException:
+                for pid in shared:
+                    self._pagepool.unref(pid)
+                raise
         self._staged_prefix = None
         ids = np.zeros((b * self._ppp,), np.int32)
         cow = 0
@@ -1279,6 +1318,7 @@ class BatchGenerator:
         int8-quantized pool)."""
         from cake_tpu.disagg import snapshot as _snapshot
 
+        self._domain_stamp.check("BatchGenerator.export_stream")
         self._require_paged("export_stream")
         self._drain_buffered_rows()
         slot = next(
@@ -1362,6 +1402,7 @@ class BatchGenerator:
         replays to its client)."""
         from cake_tpu.disagg import snapshot as _snapshot
 
+        self._domain_stamp.check("BatchGenerator.import_begin")
         self._require_paged("import_begin")
         if not self.streams:
             raise RuntimeError("set_prompts first")
@@ -1458,11 +1499,15 @@ class BatchGenerator:
             self._pagepool.pin(pid)
             self._pagepool.unref(pid)
             pages.append(pid)
+        # the import record owns the pins from HERE (cakelint CK-CLAIM):
+        # if the scatter dispatch below raises, import_abort / the TTL
+        # sweep can still unpin — pins held only by the local would leak
+        # forever
+        rec["pages"] = pages
         ids_vec = np.zeros((self._ppp,), np.int32)
         ids_vec[:need] = pages
         self.cache = self._row_scatter(self.cache, staging,
                                        jnp.asarray(ids_vec))
-        rec["pages"] = pages
         _IMPORTS.inc()
 
     def _import_staging(self, snap) -> object:
@@ -1503,6 +1548,7 @@ class BatchGenerator:
         table (page-table edit — ref then unpin, no cache tensor moves)
         and its sampler/cursor state splices in. Decode then continues
         bit-identically to the exporting engine's next step."""
+        self._domain_stamp.check("BatchGenerator.import_attach")
         self._require_paged("import_attach")
         if xfer_id not in self._imports:
             raise KeyError(f"unknown or expired transfer {xfer_id!r}")
@@ -1559,6 +1605,7 @@ class BatchGenerator:
         """Drop a begun import and release its page pins (resume never
         came — gateway died, TTL expired, client cancelled). Returns
         False when the id is unknown (already attached or aborted)."""
+        self._domain_stamp.check("BatchGenerator.import_abort")
         rec = self._imports.pop(xfer_id, None)
         if rec is None:
             return False
@@ -1576,6 +1623,7 @@ class BatchGenerator:
         """Abort begun-but-unattached imports older than ``ttl_s``; the
         serve scheduler sweeps this so an orphaned transfer cannot pin
         pool pages forever. Returns the number aborted."""
+        self._domain_stamp.check("BatchGenerator.expire_imports")
         if not self._imports:
             return 0
         now = time.monotonic()
@@ -1943,12 +1991,25 @@ class BatchGenerator:
             shared = st.get("shared", [])
             n_shared = len(shared)
             last_page = (len(ids) - 1) // ps
-            new_pages = [self._alloc_page()
-                         for _ in range(last_page + 1 - n_shared)]
             ids_vec = np.zeros((self._ppp,), np.int32)
-            ids_vec[n_shared: last_page + 1] = new_pages
-            self.cache = self._row_scatter(self.cache, st["cache"],
-                                           jnp.asarray(ids_vec))
+            # the fresh pages are held only by this local until the
+            # table install below — release them on the error path
+            # (cakelint CK-CLAIM). The alloc loop itself sits INSIDE
+            # the try: the admission pre-check ran steps ago (chunked
+            # prefill), and an import landing in between can pin pages
+            # past it, so a mid-loop PoolExhausted must release what
+            # this row already took, same as a raising scatter dispatch.
+            new_pages: list[int] = []
+            try:
+                for _ in range(last_page + 1 - n_shared):
+                    new_pages.append(self._alloc_page())
+                ids_vec[n_shared: last_page + 1] = new_pages
+                self.cache = self._row_scatter(self.cache, st["cache"],
+                                               jnp.asarray(ids_vec))
+            except BaseException:
+                for pid in new_pages:
+                    self._pagepool.unref(pid)
+                raise
             self._release_pages(slot)  # idempotent (freed at claim too)
             self._tables[slot] = shared + new_pages
             self._page_map_dev = None
@@ -2041,6 +2102,7 @@ class BatchGenerator:
         (buffered fused-block rows, an in-flight lookahead block, banked
         speculation runs) are discarded at emission like any other
         past-EOS overrun."""
+        self._domain_stamp.check("BatchGenerator.finish")
         for i, s in enumerate(self.streams):
             if s.active and not s.done and s.stream_id == stream_id:
                 s.done = True
@@ -2154,6 +2216,7 @@ class BatchGenerator:
         stream slot (None for finished/dummy streams). A queued arrival
         (``enqueue``) advances by one admission-prefill chunk per call,
         interleaved with the decode dispatches."""
+        self._domain_stamp.check("BatchGenerator.step")
         if not self.streams:
             raise RuntimeError("set_prompts first")
         if not self._emitted_first:
@@ -2528,6 +2591,7 @@ class BatchGenerator:
         tokens are recorded against their streams and counted immediately
         (same `_emit` path as stepping); the Token rows land in the
         pending queue for any consumer still calling step()."""
+        self._domain_stamp.check("BatchGenerator.drain")
         self._drain_buffered_rows()
 
     def _drain_buffered_rows(self) -> None:

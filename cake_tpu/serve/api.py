@@ -50,6 +50,13 @@ from cake_tpu.serve.session import Session, sse_event
 
 log = logging.getLogger("cake_tpu.serve.api")
 
+# Thread domain (cakelint CK-THREAD): everything in this module runs on
+# HTTP handler threads (ThreadingHTTPServer — the nested Handler class
+# inherits this module domain). Calls into engine-domain state must go
+# through the scheduler's declared crossing points (_THREAD_SAFE);
+# handler code never touches the engine directly.
+_THREAD_DOMAIN = "handler"
+
 _SAMPLER_KNOBS = ("temperature", "top_k", "top_p", "seed")
 
 

@@ -43,6 +43,13 @@ class SingleStreamEngine:
     # logprobs are refused at the API layer
     logprobs_k = 0
 
+    # cakelint CK-THREAD: same engine-domain contract as the facade's
+    # subject. `_encode` is the stateless tokenizer crossing point;
+    # `close` runs only after Scheduler.stop has joined the engine
+    # thread (teardown happens-after), so it is a declared crossing too.
+    _THREAD_DOMAIN = "engine"
+    _THREAD_SAFE = ("_encode", "close")
+
     def __init__(self, gen):
         self.gen = gen
         self.config = gen.config

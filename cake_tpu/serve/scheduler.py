@@ -90,7 +90,27 @@ class Scheduler:
         "_import_inbox": "_cond",
         "_imports_meta": "_cond",
         "_xfer_out": "_cond",
+        "_engine_stats": "_cond",
     }
+
+    # Thread domains, machine-checked by cakelint CK-THREAD: the class
+    # is engine-domain (only the engine thread runs its un-listed
+    # methods), and _THREAD_SAFE names the crossing points — the
+    # handler-facing API that hands work across the boundary through the
+    # condition lock, the admission queue, and the import inbox instead
+    # of touching the engine. `start` primes the engine on the caller's
+    # thread happens-before the engine thread exists, so it counts as
+    # engine-domain code. The runtime twin (CAKE_THREAD_STRICT=1,
+    # runtime/threadcheck) stamps the engine thread at _run entry and
+    # asserts membership in the engine's annotated mutators.
+    _THREAD_DOMAIN = "engine"
+    _THREAD_OF = {"start": "engine"}
+    _THREAD_SAFE = (
+        "submit", "cancel", "stop", "close", "encode_prompt",
+        "submit_import", "abort_import", "import_meta",
+        "xfer_out_enter", "xfer_out_exit", "kv_transfers_inflight",
+        "retry_after_s", "stats", "_sync_inflight",
+    )
 
     def __init__(self, engine, queue_depth: int = 64,
                  request_timeout_s: float | None = None,
@@ -134,6 +154,10 @@ class Scheduler:
         self._imports_meta: dict[str, dict] = {}
         self._xfer_out = 0
         self._last_sweep = time.monotonic()
+        # engine-stats snapshot for handler threads: the engine thread
+        # refreshes it every loop pass, so stats()/healthz never walk
+        # live engine state from a foreign thread (cakelint CK-THREAD)
+        self._engine_stats: dict = {}
         # observed-throughput window for the Retry-After estimate
         self._rate_tokens = 0
         self._rate_t0 = time.perf_counter()
@@ -172,6 +196,9 @@ class Scheduler:
             self.engine.warm_admission(warm_prompt_len)
         if warm_constrain and hasattr(self.engine, "warm_constrain"):
             self.engine.warm_constrain()
+        # seed the handler-facing snapshot happens-before the engine
+        # thread exists; from here on only that thread refreshes it
+        self._refresh_engine_stats()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="cake-serve-engine")
         self._thread.start()
@@ -316,13 +343,15 @@ class Scheduler:
                     self._imports_meta.pop(payload, None)
                 self._sync_inflight()
 
-    def _sweep_imports(self) -> None:
+    def _sweep_imports(self) -> bool:
         """Engine thread, ~1/s: expire begun-but-unresumed imports so an
         orphaned transfer (gateway died between ACK and resume) cannot
-        pin pool pages forever."""
+        pin pool pages forever. Returns True when a sweep pass ran (the
+        parked loop refreshes the stats snapshot on that cadence — a
+        sweep can unpin pages with no work pass in sight)."""
         now = time.monotonic()
         if now - self._last_sweep < 1.0:
-            return
+            return False
         self._last_sweep = now
         if hasattr(self.engine, "expire_imports"):
             self.engine.expire_imports(self.import_ttl_s)
@@ -333,6 +362,23 @@ class Scheduler:
                 self._imports_meta.pop(x, None)
         if stale:
             self._sync_inflight()
+        return True
+
+    def _refresh_engine_stats(self, best_effort: bool = False) -> None:
+        """Engine thread: publish the stats snapshot handler threads
+        read (stats()/healthz) — they must never walk live engine state
+        themselves (cakelint CK-THREAD). ``best_effort`` swallows a
+        stats() failure (the fault/shutdown paths refresh so a dead
+        engine doesn't keep advertising its last healthy snapshot, but
+        a faulted engine may not be able to report at all)."""
+        try:
+            snap = self.engine.stats()
+        except Exception:
+            if not best_effort:
+                raise
+            return
+        with self._cond:
+            self._engine_stats = snap
 
     def _fail_lost_attaches(self) -> None:
         """Engine thread: sessions whose resume attach found its import
@@ -365,6 +411,11 @@ class Scheduler:
             queued = len(self._queue)
             running = len(self._by_sid)
             draining = self._draining
+            # the engine block is the ENGINE THREAD's own snapshot
+            # (refreshed every loop pass) — handler threads must not
+            # walk live engine state (cakelint CK-THREAD); one pass of
+            # lag is invisible next to probe intervals
+            engine_stats = dict(self._engine_stats)
         return {
             "queued": queued,
             "running": running,
@@ -376,7 +427,7 @@ class Scheduler:
             "kv_transfers_inflight": self.kv_transfers_inflight(),
             **({"transfer_port": self.transfer_port}
                if self.transfer_port else {}),
-            "engine": self.engine.stats(),
+            "engine": engine_stats,
         }
 
     # -- engine thread --------------------------------------------------------
@@ -385,6 +436,21 @@ class Scheduler:
                     or self.engine.pending_admissions())
 
     def _run(self) -> None:
+        # claim the engine's thread domain for this thread (runtime twin
+        # of cakelint CK-THREAD, runtime/threadcheck): under
+        # CAKE_THREAD_STRICT=1 every annotated engine/pool mutator
+        # asserts it runs here. Cleared on exit — post-join teardown and
+        # drain replays may legitimately drive the engine again.
+        stamp = getattr(self.engine, "_domain_stamp", None)
+        if stamp is not None:
+            stamp.stamp()
+        try:
+            self._run_loop()
+        finally:
+            if stamp is not None:
+                stamp.clear()
+
+    def _run_loop(self) -> None:
         while True:
             with self._cond:
                 self._expire_queued_locked()
@@ -394,8 +460,12 @@ class Scheduler:
                     self._cond.wait(timeout=0.1)
                     self._expire_queued_locked()
                     # imports awaiting resume are not "work" (nothing to
-                    # step), but their TTL must still tick while parked
-                    self._sweep_imports()
+                    # step), but their TTL must still tick while parked —
+                    # and a sweep that runs can unpin pages, so the
+                    # handler-facing stats snapshot refreshes with it
+                    # (the condition's RLock makes the re-acquire safe)
+                    if self._sweep_imports():
+                        self._refresh_engine_stats()
                 if self._stopping or (self._draining
                                       and not self._has_work_locked()):
                     break
@@ -407,6 +477,7 @@ class Scheduler:
                 self._deliver(row)
                 self._retire()
                 self._fail_lost_attaches()
+                self._refresh_engine_stats()
             except Exception as e:  # engine fault: fail every session
                 log.exception("engine thread fault: %s", e)
                 with self._cond:
@@ -417,8 +488,12 @@ class Scheduler:
                     # routing traffic here
                     self._draining = True
                 self._abort_all(f"engine failure: {e}")
+                # don't keep advertising the last HEALTHY snapshot for
+                # a dead engine (stats may itself fail mid-fault)
+                self._refresh_engine_stats(best_effort=True)
                 return
         self._abort_all("server shutting down")
+        self._refresh_engine_stats(best_effort=True)
 
     def _expire_queued_locked(self) -> None:
         """Refuse queued sessions past their arrival deadline (and drop

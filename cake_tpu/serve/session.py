@@ -69,6 +69,11 @@ class Session:
     advanced by the scheduler's engine thread (the only writer of token
     events), drained by the API handler thread via :attr:`events`."""
 
+    # cakelint CK-THREAD: thread-safe by construction — the engine
+    # thread produces (on_token/finish/fail), a handler thread consumes
+    # (events.get); all shared state rides the Queue/Event internals
+    _THREAD_DOMAIN = "any"
+
     def __init__(self, prompt_ids: list[int], max_tokens: int,
                  stream: bool = True, timeout_s: float | None = None,
                  request_id: str | None = None,

@@ -34,6 +34,28 @@ def pytest_configure(config):
     )
 
 
+# The suites whose execution exercises the engine-thread boundary run
+# with the CK-THREAD runtime twin armed (runtime/threadcheck): the
+# scheduler stamps its engine thread and every annotated engine/pool
+# mutator asserts domain membership — so the static thread-domain model
+# (cake_tpu/analysis/thread_domains.py) is validated against real
+# execution, not just the AST.
+_THREAD_STRICT_SUITES = ("test_serve", "test_kvpool", "test_disagg",
+                         "test_gateway", "test_sp_serving")
+
+
+@pytest.fixture(autouse=True)
+def _thread_strict_twin(request):
+    if request.module.__name__.rpartition(".")[2] in _THREAD_STRICT_SUITES:
+        from cake_tpu.runtime import threadcheck
+
+        prev = threadcheck.set_strict(True)
+        yield
+        threadcheck.set_strict(prev)
+    else:
+        yield
+
+
 @pytest.fixture(scope="session")
 def tiny_config():
     from cake_tpu.models.config import tiny

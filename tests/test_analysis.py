@@ -9,15 +9,18 @@ baseline, must be clean with no stale entries.
 
 import json
 import textwrap
+import threading
 
 import pytest
 
 from cake_tpu import analysis
 from cake_tpu.analysis import baseline as baseline_mod
 from cake_tpu.analysis import core
+from cake_tpu.analysis.claims import ClaimChecker
 from cake_tpu.analysis.engine_ownership import EngineOwnershipChecker
 from cake_tpu.analysis.guarded_by import GuardedByChecker
 from cake_tpu.analysis.metrics_catalog import MetricsCatalogChecker
+from cake_tpu.analysis.thread_domains import ThreadDomainChecker
 from cake_tpu.analysis.trace_purity import TracePurityChecker
 from cake_tpu.analysis.wire_safety import WireSafetyChecker
 
@@ -29,6 +32,17 @@ def lint(tmp_path, source, checker, rel="pkg/mod.py"):
     f.parent.mkdir(parents=True, exist_ok=True)
     f.write_text(textwrap.dedent(source))
     return core.run_checkers([checker], roots=[str(f)], repo_root=tmp_path)
+
+
+def lint_full(tmp_path, sources, checker):
+    """Full-repo scan over ``{rel: source}`` fixtures (finalize passes
+    included — what cross-file checkers need)."""
+    for rel, source in sources.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(source))
+    return core.run_checkers([checker], roots=[str(tmp_path)],
+                             repo_root=tmp_path)
 
 
 # -- CK-METRIC: metrics catalog ------------------------------------------
@@ -295,123 +309,6 @@ class TestWireSafety:
         """, WireSafetyChecker())
         assert out == []
 
-    def test_leaky_acquisition_flagged(self, tmp_path):
-        out = lint(tmp_path, """
-            import socket
-            def dial(host, port, Connection):
-                sock = socket.create_connection((host, port))
-                sock.setsockopt(1, 2, 3)   # may raise: sock leaks
-                return Connection(sock=sock)
-        """, WireSafetyChecker())
-        assert len(out) == 1
-        assert out[0].key == "res:create_connection:dial:sock"
-
-    def test_protected_and_immediate_ok(self, tmp_path):
-        out = lint(tmp_path, """
-            import socket
-            def good_with(path):
-                with open(path) as f:
-                    return f.read()
-            def good_immediate(host, Connection):
-                sock = socket.create_connection((host, 1))
-                return Connection(sock=sock)
-            def good_protected(host, Connection):
-                sock = socket.create_connection((host, 1))
-                try:
-                    sock.setsockopt(1, 2, 3)
-                except Exception:
-                    sock.close()
-                    raise
-                return Connection(sock=sock)
-            class Owner:
-                def open(self, path):
-                    self._fh = open(path, "a")  # ownership moved
-        """, WireSafetyChecker())
-        assert out == []
-
-    def test_read_is_not_a_release(self, tmp_path):
-        # `data = sock.recv(n)` is a READ; the caller still owns the
-        # socket, and the raising parse after it must keep the finding
-        out = lint(tmp_path, """
-            import socket
-            def probe(host, parse):
-                s = socket.create_connection((host, 1))
-                data = s.recv(100)
-                return parse(data)   # may raise: s leaks
-        """, WireSafetyChecker())
-        assert len(out) == 1
-        assert out[0].key == "res:create_connection:probe:s"
-
-    def test_late_try_does_not_cover_early_risk(self, tmp_path):
-        # a try/finally that closes the var but starts AFTER a raising
-        # statement does not protect the held-bare region before it
-        out = lint(tmp_path, """
-            import socket
-            def serve(host, risky_setup, use):
-                s = socket.create_connection((host, 1))
-                risky_setup()        # raises -> s leaks
-                try:
-                    use(s)
-                finally:
-                    s.close()
-        """, WireSafetyChecker())
-        assert len(out) == 1
-        assert out[0].key == "res:create_connection:serve:s"
-
-    def test_adjacent_try_protects(self, tmp_path):
-        # ...but the same try as the VERY NEXT statement does protect,
-        # including when the acquisition sits inside its own try (the
-        # chaos-proxy shape)
-        out = lint(tmp_path, """
-            import socket
-            def dial(host, use):
-                s = socket.create_connection((host, 1))
-                try:
-                    use(s)
-                finally:
-                    s.close()
-            def dial_nested(host, setup, consume):
-                try:
-                    s = socket.create_connection((host, 1))
-                except OSError:
-                    return None
-                try:
-                    setup(s)
-                except OSError:
-                    s.close()
-                    raise
-                return consume(s)
-        """, WireSafetyChecker())
-        assert out == []
-
-    def test_store_in_container_is_a_handoff(self, tmp_path):
-        # storing a resource in a longer-lived owner transfers ownership
-        # — both the bound and the unbound spelling
-        out = lint(tmp_path, """
-            import socket
-            def pool_up(hosts, conns):
-                for h in hosts:
-                    c = socket.create_connection((h, 1))
-                    conns.append(c)
-            class Pool:
-                def grow(self, path):
-                    self.files.append(open(path))
-        """, WireSafetyChecker())
-        assert out == []
-
-    def test_guarded_conditional_close_ok(self, tmp_path):
-        # the worker accept-loop idiom: the guard test is part of the
-        # release decision, not held-bare work
-        out = lint(tmp_path, """
-            def loop(listener, stop, handle):
-                conn = listener.accept()
-                if stop.is_set():
-                    conn.close()
-                    return
-                handle(conn)
-        """, WireSafetyChecker())
-        assert out == []
-
     def test_msgtype_missing_arm_flagged(self, tmp_path):
         repo = tmp_path
         (repo / "proto.py").write_text(textwrap.dedent("""
@@ -441,6 +338,541 @@ class TestWireSafety:
             [WireSafetyChecker()],
             roots=["cake_tpu/runtime/protocol.py"])
         assert [f for f in out if f.key.startswith("MsgType.")] == []
+
+    def test_frame_const_missing_arm_flagged(self, tmp_path):
+        # the declared XFER_* family is judged tree-wide like MsgType:
+        # a constant with a send arm but no dispatch arm (or vice versa)
+        # is protocol skew waiting to happen
+        out = lint_full(tmp_path, {
+            "cake_tpu/disagg/transfer.py": """
+                XFER_SNAPSHOT = 32
+                XFER_ACK = 33
+                XFER_REJECT = 34
+                def pump(conn):
+                    conn.send(XFER_SNAPSHOT, b"x")
+                    conn.send(XFER_ACK)
+                    t, _ = conn.recv(timeout=1)
+                    if t == XFER_ACK:
+                        return True
+                    if t == XFER_REJECT:
+                        return False
+            """,
+        }, WireSafetyChecker())
+        assert [f.key for f in out] == ["frame:XFER_SNAPSHOT:dispatch",
+                                        "frame:XFER_REJECT:send"]
+
+    def test_frame_const_both_arms_ok_cross_module(self, tmp_path):
+        # arms may live in different modules (sender here, receiver
+        # there) — and re-exported access (transfer.XFER_ACK) counts
+        out = lint_full(tmp_path, {
+            "cake_tpu/disagg/transfer.py": """
+                XFER_SNAPSHOT = 32
+                def send(conn):
+                    conn.send(XFER_SNAPSHOT, b"x")
+            """,
+            "cake_tpu/disagg/receiver.py": """
+                from cake_tpu.disagg import transfer
+                def handle(t):
+                    return t == transfer.XFER_SNAPSHOT
+            """,
+        }, WireSafetyChecker())
+        assert out == []
+
+
+# -- CK-CLAIM: declared acquire/release pairs ------------------------------
+
+class TestClaims:
+    # the fd rule (migrated from CK-WIRE arm 2): same shapes, same keys
+    def test_leaky_acquisition_flagged(self, tmp_path):
+        out = lint(tmp_path, """
+            import socket
+            def dial(host, port, Connection):
+                sock = socket.create_connection((host, port))
+                sock.setsockopt(1, 2, 3)   # may raise: sock leaks
+                return Connection(sock=sock)
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].checker == "CK-CLAIM"
+        assert out[0].key == "res:create_connection:dial:sock"
+
+    def test_protected_and_immediate_ok(self, tmp_path):
+        out = lint(tmp_path, """
+            import socket
+            def good_with(path):
+                with open(path) as f:
+                    return f.read()
+            def good_immediate(host, Connection):
+                sock = socket.create_connection((host, 1))
+                return Connection(sock=sock)
+            def good_protected(host, Connection):
+                sock = socket.create_connection((host, 1))
+                try:
+                    sock.setsockopt(1, 2, 3)
+                except Exception:
+                    sock.close()
+                    raise
+                return Connection(sock=sock)
+            class Owner:
+                def open(self, path):
+                    self._fh = open(path, "a")  # ownership moved
+        """, ClaimChecker())
+        assert out == []
+
+    def test_read_is_not_a_release(self, tmp_path):
+        # `data = sock.recv(n)` is a READ; the caller still owns the
+        # socket, and the raising parse after it must keep the finding
+        out = lint(tmp_path, """
+            import socket
+            def probe(host, parse):
+                s = socket.create_connection((host, 1))
+                data = s.recv(100)
+                return parse(data)   # may raise: s leaks
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "res:create_connection:probe:s"
+
+    def test_late_try_does_not_cover_early_risk(self, tmp_path):
+        # a try/finally that closes the var but starts AFTER a raising
+        # statement does not protect the held-bare region before it
+        out = lint(tmp_path, """
+            import socket
+            def serve(host, risky_setup, use):
+                s = socket.create_connection((host, 1))
+                risky_setup()        # raises -> s leaks
+                try:
+                    use(s)
+                finally:
+                    s.close()
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "res:create_connection:serve:s"
+
+    def test_adjacent_try_protects(self, tmp_path):
+        # ...but the same try as the VERY NEXT statement does protect,
+        # including when the acquisition sits inside its own try (the
+        # chaos-proxy shape)
+        out = lint(tmp_path, """
+            import socket
+            def dial(host, use):
+                s = socket.create_connection((host, 1))
+                try:
+                    use(s)
+                finally:
+                    s.close()
+            def dial_nested(host, setup, consume):
+                try:
+                    s = socket.create_connection((host, 1))
+                except OSError:
+                    return None
+                try:
+                    setup(s)
+                except OSError:
+                    s.close()
+                    raise
+                return consume(s)
+        """, ClaimChecker())
+        assert out == []
+
+    def test_store_in_container_is_a_handoff(self, tmp_path):
+        # storing a resource in a longer-lived owner transfers ownership
+        # — both the bound and the unbound spelling
+        out = lint(tmp_path, """
+            import socket
+            def pool_up(hosts, conns):
+                for h in hosts:
+                    c = socket.create_connection((h, 1))
+                    conns.append(c)
+            class Pool:
+                def grow(self, path):
+                    self.files.append(open(path))
+        """, ClaimChecker())
+        assert out == []
+
+    def test_guarded_conditional_close_ok(self, tmp_path):
+        # the worker accept-loop idiom: the guard test is part of the
+        # release decision, not held-bare work
+        out = lint(tmp_path, """
+            def loop(listener, stop, handle):
+                conn = listener.accept()
+                if stop.is_set():
+                    conn.close()
+                    return
+                handle(conn)
+        """, ClaimChecker())
+        assert out == []
+
+    def test_second_acquisition_is_risky(self, tmp_path):
+        # a second dial that raises strands the first socket — binding
+        # acquires are never excluded from the held-bare risk set
+        out = lint(tmp_path, """
+            import socket
+            def bridge(h1, h2):
+                a = socket.create_connection((h1, 1))
+                b = socket.create_connection((h2, 1))
+                a.close()
+                b.close()
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "res:create_connection:bridge:a"
+
+    # kvpool page-claim rules
+    def test_pin_handoff_after_dispatch_flagged(self, tmp_path):
+        # THE import-land bug class: pins taken in a loop, collected
+        # into a list, but the hand-off to the owning record sits after
+        # a device dispatch — the day that dispatch raises, the pinned
+        # pages leak forever (nothing ever unpins them)
+        out = lint(tmp_path, """
+            def land(self, rec, staging, need):
+                pages = []
+                for _ in range(need):
+                    pid = self.pool.alloc()
+                    self.pool.pin(pid)
+                    self.pool.unref(pid)
+                    pages.append(pid)
+                self.cache = self.scatter(self.cache, staging)  # raises?
+                rec["pages"] = pages
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "claim:kvpool.pin:pin:land:pages"
+
+    def test_pin_handoff_before_dispatch_ok(self, tmp_path):
+        # the fix shape: the record owns the pins BEFORE anything that
+        # can raise — an abort/TTL sweep can always release them
+        out = lint(tmp_path, """
+            def land(self, rec, staging, need):
+                pages = []
+                for _ in range(need):
+                    pid = self.pool.alloc()
+                    self.pool.pin(pid)
+                    self.pool.unref(pid)
+                    pages.append(pid)
+                rec["pages"] = pages
+                self.cache = self.scatter(self.cache, staging)
+        """, ClaimChecker())
+        assert out == []
+
+    def test_ref_loop_needs_protected_release(self, tmp_path):
+        # refs over an existing table: work between the ref loop and
+        # the unref loop leaks on its exception edge...
+        out = lint(tmp_path, """
+            def attach_bad(self, table, splice):
+                for pid in table:
+                    self.pool.ref(pid)
+                splice()             # may raise: table's refs leak
+                for pid in table:
+                    self.pool.unref(pid)
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "claim:kvpool.ref:ref:attach_bad:table"
+
+    def test_ref_loop_protected_or_handed_off_ok(self, tmp_path):
+        # ...unless a try releases on the error path, or the table is
+        # handed to its owner first
+        out = lint(tmp_path, """
+            def attach_protected(self, table, splice):
+                for pid in table:
+                    self.pool.ref(pid)
+                try:
+                    splice()
+                except Exception:
+                    for pid in table:
+                        self.pool.unref(pid)
+                    raise
+            def attach_handoff(self, table, splice):
+                for pid in table:
+                    self.pool.ref(pid)
+                self.tables.append(table)
+                splice()
+        """, ClaimChecker())
+        assert out == []
+
+    def test_alloc_leak_on_exception_edge(self, tmp_path):
+        # binding style: a fresh page held only by a local while a
+        # raising statement sits before the hand-off
+        out = lint(tmp_path, """
+            def grow(self, splice):
+                pid = self.pool.alloc()
+                splice()               # may raise: pid leaks
+                self.table.append(pid)
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "res:alloc:grow:pid"
+
+    def test_per_iteration_pin_tracked_by_name(self, tmp_path):
+        # a loop pin on a plain name with no collecting list tracks the
+        # NAME within the iteration: balanced-under-finally is clean,
+        # bare work between pin and unpin is a leak on its exception
+        # edge (not "untrackable")
+        out = lint(tmp_path, """
+            def scan_ok(self, streams, work):
+                for s in streams:
+                    pid = s.pid
+                    self.pool.pin(pid)
+                    try:
+                        work(pid)
+                    finally:
+                        self.pool.unpin(pid)
+        """, ClaimChecker())
+        assert out == []
+        out = lint(tmp_path, """
+            def scan_bad(self, streams, work):
+                for s in streams:
+                    pid = s.pid
+                    self.pool.pin(pid)
+                    work(pid)          # may raise: this pin leaks
+                    self.pool.unpin(pid)
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "claim:kvpool.pin:pin:scan_bad:pid"
+        assert "leak" in out[0].message
+
+    def test_untracked_tokens_get_distinct_keys(self, tmp_path):
+        # two different untracked tokens in one function must not share
+        # a baseline key — one grandfathered claim cannot cover the other
+        out = lint(tmp_path, """
+            def hold(self, i, j):
+                self.pool.pin(self.slots[i])
+                self.pool.pin(self.others[j])
+        """, ClaimChecker())
+        assert len(out) == 2
+        assert len({f.key for f in out}) == 2
+        assert all("untracked" in f.key for f in out)
+
+    def test_implementing_module_excluded(self, tmp_path):
+        # kvpool/table.py IS the pair's implementation: `pin` calling
+        # `ref` internally must not read as an unbalanced claim
+        out = lint(tmp_path, """
+            class PagePool:
+                def pin(self, pid):
+                    self.ref(pid)
+                    self._pins[pid] += 1
+        """, ClaimChecker(), rel="cake_tpu/kvpool/table.py")
+        assert out == []
+
+    # disagg transfer-id rule
+    def test_import_begin_dropped_flagged(self, tmp_path):
+        out = lint(tmp_path, """
+            def ingest(self, payload, audit):
+                meta = self.engine.import_begin(payload)
+                audit(meta["xfer_id"])
+        """, ClaimChecker())
+        assert len(out) == 1
+        assert out[0].key == "res:import_begin:ingest:meta"
+
+    def test_import_begin_returned_or_aborted_ok(self, tmp_path):
+        out = lint(tmp_path, """
+            def ingest(self, payload):
+                meta = self.engine.import_begin(payload)
+                return meta
+            def probe(self, payload, validate):
+                meta = self.engine.import_begin(payload)
+                try:
+                    validate(meta)
+                except ValueError:
+                    # releasing through a projection of the claim
+                    # (meta["xfer_id"]) releases the claim
+                    self.engine.import_abort(meta["xfer_id"])
+                    raise
+                return meta
+        """, ClaimChecker())
+        assert out == []
+
+
+# -- CK-THREAD: declared thread domains ------------------------------------
+
+_ENGINE_MOD = """
+    class Engine:
+        _THREAD_DOMAIN = "engine"
+        _THREAD_ALIASES = ("engine",)
+        _THREAD_SAFE = ("_encode",)
+        def step(self): pass
+        def stats(self): pass
+        def _encode(self, p): pass
+
+    class Owner:
+        _THREAD_DOMAIN = "engine"
+        _THREAD_ALIASES = ("owner",)
+        _GUARDED_BY = {"_queue": "_cond"}
+        _THREAD_SAFE = ("submit", "snapshot")
+        _THREAD_OF = {"start": "engine"}
+        def submit(self, sess):
+            with self._cond:
+                self._queue.append(sess)   # inbox hand-off: the crossing
+        def snapshot(self):
+            with self._cond:
+                return dict(self._cached)
+        def start(self):
+            self.engine.step()             # engine by _THREAD_OF: fine
+        def _run(self):
+            self.engine.step()             # engine-domain body: fine
+"""
+
+
+class TestThreadDomains:
+    def test_cross_domain_direct_call_flagged(self, tmp_path):
+        out = lint_full(tmp_path, {
+            "pkg/engine_mod.py": _ENGINE_MOD,
+            "pkg/handlers.py": """
+                _THREAD_DOMAIN = "handler"
+                def handle(owner, prompt):
+                    owner._run()                 # BAD: engine-domain method
+                def handle_safe(owner, sess):
+                    owner.submit(sess)           # declared crossing point
+                def tokenize(engine, p):
+                    return engine._encode(p)     # _THREAD_SAFE method
+            """,
+        }, ThreadDomainChecker())
+        assert len(out) == 1
+        assert out[0].checker == "CK-THREAD"
+        assert out[0].key == "Owner._run:handle"
+        assert "'engine'" in out[0].message and "handler" in out[0].message
+
+    def test_crossing_point_body_checked_as_any(self, tmp_path):
+        # a _THREAD_SAFE method that itself pokes domain state is
+        # exactly the bug the declaration exists to catch — the
+        # live-stats-walk shape this PR fixed in Scheduler.stats
+        out = lint_full(tmp_path, {
+            "pkg/engine_mod.py": _ENGINE_MOD,
+            "pkg/bad_owner.py": """
+                class Front:
+                    _THREAD_DOMAIN = "engine"
+                    _THREAD_SAFE = ("stats",)
+                    def stats(self):
+                        return self.engine.stats()   # BAD: any -> engine
+            """,
+        }, ThreadDomainChecker())
+        assert len(out) == 1
+        assert out[0].key == "Engine.stats:Front.stats"
+
+    def test_guarded_by_lock_is_a_crossing(self, tmp_path):
+        out = lint_full(tmp_path, {
+            "pkg/engine_mod.py": _ENGINE_MOD,
+            "pkg/locked.py": """
+                _THREAD_DOMAIN = "handler"
+                _GUARDED_BY = {"shared": "_table_lock"}
+                def read(owner, _table_lock):
+                    with _table_lock:
+                        return owner._run()   # declared lock: allowed
+            """,
+        }, ThreadDomainChecker())
+        assert out == []
+
+    def test_dunder_and_unannotated_callers_exempt(self, tmp_path):
+        out = lint_full(tmp_path, {
+            "pkg/engine_mod.py": _ENGINE_MOD,
+            "pkg/wrapper.py": """
+                _THREAD_DOMAIN = "handler"
+                class Wrapper:
+                    def __init__(self, engine):
+                        engine.step()   # construction happens-before
+            """,
+            "pkg/script.py": """
+                def main(engine):
+                    engine.step()       # unannotated caller: not checked
+            """,
+        }, ThreadDomainChecker())
+        assert out == []
+
+    def test_constructor_taint_resolves_receivers(self, tmp_path):
+        # `eng = Engine()` binds the handle scope-insensitively — the
+        # CK-ENGINE philosophy — so a later cross-domain call through
+        # that name is caught without alias declarations
+        out = lint_full(tmp_path, {
+            "pkg/engine_mod.py": _ENGINE_MOD,
+            "pkg/boot.py": """
+                _THREAD_DOMAIN = "handler"
+                from pkg.engine_mod import Engine
+                eng = Engine()
+                def tick():
+                    eng.step()
+            """,
+        }, ThreadDomainChecker())
+        assert len(out) == 1
+        assert out[0].key == "Engine.step:tick"
+
+    def test_any_domain_class_imposes_nothing(self, tmp_path):
+        out = lint_full(tmp_path, {
+            "pkg/shared.py": """
+                class Box:
+                    _THREAD_DOMAIN = "any"
+                    def put(self, x): pass
+            """,
+            "pkg/handlers.py": """
+                _THREAD_DOMAIN = "handler"
+                from pkg.shared import Box
+                box = Box()
+                def handle(x):
+                    box.put(x)
+            """,
+        }, ThreadDomainChecker())
+        assert out == []
+
+
+# -- the CK-THREAD runtime twin (CAKE_THREAD_STRICT) -----------------------
+
+class TestThreadStrictTwin:
+    def test_assert_fires_cross_thread_only(self):
+        from cake_tpu.runtime import threadcheck
+
+        stamp = threadcheck.DomainStamp("engine")
+        prev = threadcheck.set_strict(True)
+        try:
+            stamp.check("unstamped-is-vacuous")  # no owner yet: passes
+            stamp.stamp()
+            stamp.check("same-thread-ok")
+            err: list[str] = []
+
+            def other():
+                try:
+                    stamp.check("BatchGenerator.step")
+                except RuntimeError as e:
+                    err.append(str(e))
+
+            t = threading.Thread(target=other)
+            t.start()
+            t.join()
+            assert len(err) == 1
+            assert "BatchGenerator.step" in err[0]
+            assert "engine" in err[0]
+            stamp.clear()  # owner gone: checks are vacuous again
+            t2 = threading.Thread(target=lambda: stamp.check("after-clear"))
+            t2.start()
+            t2.join()
+        finally:
+            threadcheck.set_strict(prev)
+
+    def test_disabled_twin_never_raises(self):
+        from cake_tpu.runtime import threadcheck
+
+        stamp = threadcheck.DomainStamp("engine")
+        prev = threadcheck.set_strict(False)
+        try:
+            stamp.stamp()
+            t = threading.Thread(target=lambda: stamp.check("off"))
+            t.start()
+            t.join()  # no raise: disabled twin is a bool read
+        finally:
+            threadcheck.set_strict(prev)
+
+    def test_pagepool_mutators_guarded(self):
+        # the real wiring: a pool whose stamp is owned by another thread
+        # refuses foreign-thread page claims, message naming the mutator
+        from cake_tpu.kvpool.table import PagePool
+        from cake_tpu.runtime import threadcheck
+
+        pool = PagePool(8, 4)
+        prev = threadcheck.set_strict(True)
+        try:
+            t = threading.Thread(target=pool._domain_stamp.stamp)
+            t.start()
+            t.join()
+            with pytest.raises(RuntimeError, match="PagePool.alloc"):
+                pool.alloc()
+            pool._domain_stamp.clear()
+            pid = pool.alloc()  # ownerless: single-threaded drive works
+            assert pool.refcount(pid) == 1
+        finally:
+            threadcheck.set_strict(prev)
 
 
 # -- framework: baseline, suppression, CLI --------------------------------
@@ -495,6 +927,68 @@ class TestBaseline:
         entries = baseline_mod.from_findings([self._finding()], "why")
         baseline_mod.save(p, entries)
         assert baseline_mod.load(p) == entries
+
+
+class TestUnusedSuppressions:
+    def _scan(self, tmp_path, source, checkers):
+        f = tmp_path / "mod.py"
+        f.write_text(textwrap.dedent(source))
+        mods, pf = core.load_modules([str(f)], repo_root=tmp_path)
+        unused: list = []
+        findings = core.check_modules(mods, checkers, True, pf,
+                                      unused_out=unused)
+        return findings, unused
+
+    def test_unused_vs_used_ignores(self, tmp_path):
+        findings, unused = self._scan(tmp_path, """
+            class Box:
+                _GUARDED_BY = {"_n": "_lock"}
+                def peek(self):
+                    return self._n  # cakelint: ignore[CK-LOCK]
+                def clean(self):
+                    return 1  # cakelint: ignore[CK-LOCK]
+        """, [GuardedByChecker()])
+        assert findings == []
+        # the peek ignore suppressed a live finding; the clean one
+        # suppressed nothing and is reported like a stale baseline entry
+        assert [(u["line"], u["ids"]) for u in unused] == [
+            (7, ["CK-LOCK"])]
+
+    def test_bare_ignore_counts_and_prose_does_not(self, tmp_path):
+        findings, unused = self._scan(tmp_path, '''
+            """Docs may say cakelint: ignore[CK-LOCK] without meaning it."""
+            class Box:
+                _GUARDED_BY = {"_n": "_lock"}
+                def peek(self):
+                    return self._n  # cakelint: ignore
+        ''', [GuardedByChecker()])
+        # the docstring mention is neither a suppression nor "unused";
+        # the bare comment suppresses every checker and counts as used
+        assert findings == [] and unused == []
+
+    def test_string_literal_hash_is_not_a_comment(self, tmp_path):
+        # a '#' inside a string literal must neither suppress a finding
+        # on that line nor read as an (unused) suppression comment —
+        # comment detection is token-based, not substring-based
+        findings, unused = self._scan(tmp_path, '''
+            HINT = "append # cakelint: ignore[CK-LOCK] to the line"
+            class Box:
+                _GUARDED_BY = {"_n": "_lock"}
+                def peek(self):
+                    return self._n, "# cakelint: ignore[CK-LOCK]"
+        ''', [GuardedByChecker()])
+        assert len(findings) == 1  # the peek touch is NOT suppressed
+        assert unused == []        # ...and neither string is "unused"
+
+    def test_subset_runs_cannot_judge(self, tmp_path):
+        # mirror of stale-baseline scoping: a run without the
+        # suppressing checker cannot tell "unused" from "not re-checked"
+        # — the CLI only passes unused_out on full all-checker scans
+        f = tmp_path / "mod.py"
+        f.write_text("X = 1  # cakelint: ignore[CK-LOCK]\n")
+        mods, pf = core.load_modules([str(f)], repo_root=tmp_path)
+        out = core.check_modules(mods, [MetricsCatalogChecker()], True, pf)
+        assert out == []  # no unused_out passed -> nothing judged
 
 
 class TestCli:
@@ -596,13 +1090,19 @@ class TestCatalog:
 class TestSelfRun:
     def test_repo_clean_at_head(self):
         """The tree + committed baseline = zero new findings, zero stale
-        entries. This is exactly what `make lint` enforces in CI."""
-        findings = analysis.run()
+        entries, zero unused suppressions. This is exactly what
+        `make lint` enforces in CI — CK-CLAIM and CK-THREAD included."""
+        mods, parse_findings = core.load_modules()
+        unused: list = []
+        findings = core.check_modules(mods, analysis.default_checkers(),
+                                      True, parse_findings,
+                                      unused_out=unused)
         entries = baseline_mod.load(core.REPO_ROOT /
                                     "analysis-baseline.json")
         new, suppressed, stale = baseline_mod.apply(findings, entries)
         assert new == [], "\n".join(f.render() for f in new)
         assert stale == [], [e.match_key for e in stale]
+        assert unused == []
         # the baseline is not a dumping ground: only the deliberate
         # direct-drive sites and the protocol-compat member live there
         assert {e.checker for e in entries} <= {"CK-ENGINE", "CK-WIRE"}
@@ -610,4 +1110,4 @@ class TestSelfRun:
     def test_every_checker_registered(self):
         ids = {c.id for c in analysis.default_checkers()}
         assert ids == {"CK-METRIC", "CK-ENGINE", "CK-LOCK", "CK-JIT",
-                       "CK-WIRE"}
+                       "CK-WIRE", "CK-CLAIM", "CK-THREAD"}

@@ -1,9 +1,10 @@
-"""The wait-then-measure queues run UNATTENDED in the first healthy chip
-window — a typo'd CAKE_BENCH_* knob would silently measure the wrong row
-with nobody watching. Pin every env var the queue scripts set to the set
-bench.py actually reads, and every tool they invoke to a real module."""
+"""Bench drives run UNATTENDED in the first healthy chip window — a
+typo'd CAKE_BENCH_* knob would silently measure the wrong row with
+nobody watching. The drives live in the Makefile bench targets (the old
+`tools_bench_queue*.sh` scratch queues are gone): pin every env var
+those targets set to the set bench.py actually reads, and every tool
+they invoke to a real module."""
 
-import os
 import re
 from pathlib import Path
 
@@ -16,47 +17,31 @@ def _bench_known_vars() -> set:
                           src))
 
 
-def _queue_scripts():
-    return sorted(_ROOT.glob("tools_bench_queue*.sh"))
-
-
-def test_queue_env_vars_are_recognized_by_bench():
+def test_makefile_env_vars_are_recognized_by_bench():
     known = _bench_known_vars()
     assert "CAKE_BENCH_PRESET" in known  # the extractor itself works
-    for script in _queue_scripts():
-        for var in re.findall(r"(CAKE_BENCH_[A-Z0-9_]+)=",
-                              script.read_text()):
-            assert var in known, (
-                f"{script.name} sets {var}, which bench.py never reads — "
-                "the row would silently measure the wrong thing"
-            )
+    makefile = (_ROOT / "Makefile").read_text()
+    used = re.findall(r"(CAKE_BENCH_[A-Z0-9_]+)=", makefile)
+    assert used, "Makefile no longer drives bench.py?"
+    for var in used:
+        assert var in known, (
+            f"Makefile sets {var}, which bench.py never reads — the row "
+            "would silently measure the wrong thing"
+        )
 
 
-def test_queue_tools_exist():
-    for script in _queue_scripts():
-        for name in re.findall(r"cake_tpu\.tools\.([a-z0-9_]+)",
-                               script.read_text()):
-            assert (_ROOT / "cake_tpu" / "tools" / f"{name}.py").exists(), (
-                f"{script.name} invokes cake_tpu.tools.{name}, which does "
-                "not exist"
-            )
-        # queue5-style indirection: `run_tool NAME ...` resolves to
-        # cake_tpu.tools.NAME at runtime — pin those names too
-        for name in re.findall(r"run_tool ([a-z0-9_]+)",
-                               script.read_text()):
-            assert (_ROOT / "cake_tpu" / "tools" / f"{name}.py").exists(), (
-                f"{script.name} run_tool {name}: cake_tpu/tools/{name}.py "
-                "does not exist"
-            )
+def test_makefile_tools_exist():
+    makefile = (_ROOT / "Makefile").read_text()
+    names = re.findall(r"cake_tpu\.tools\.([a-z0-9_]+)", makefile)
+    assert names, "Makefile no longer invokes any cake_tpu.tools module?"
+    for name in names:
+        assert (_ROOT / "cake_tpu" / "tools" / f"{name}.py").exists(), (
+            f"Makefile invokes cake_tpu.tools.{name}, which does not exist"
+        )
 
 
-def test_queue5_runs_the_record_row_first():
-    """Safest-first ordering: the metric of record must be the first row
-    after a healthy probe (a later row's crashed compile can re-wedge the
-    grant — r3/r4 history)."""
-    script = (_ROOT / "tools_bench_queue5.sh").read_text()
-    rows = re.findall(r"run_row ([^\n]+)", script)
-    assert rows and "CAKE_BENCH_PRESET=8b" in rows[0]
-    tools = re.findall(r"run_tool ([a-z0-9_]+)", script)
-    # the kernel sweeps (which crashed the r4w2 grant) run last
-    assert tools[-3:] == ["int4_sweep", "kernel_check", "flash_sweep"]
+def test_no_scratch_queue_scripts_return():
+    """The wait-then-measure scratch scripts were folded into bench.py +
+    Makefile targets; a returning tools_bench_queue*.sh would dodge the
+    env-var pinning above."""
+    assert sorted(_ROOT.glob("tools_bench_queue*.sh")) == []
