@@ -168,6 +168,14 @@ disagg-smoke:
 	CAKE_BENCH_DISAGG=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
 
+# request-tracing smoke: request-scoped fleet tracing + SLO accounting
+# (cake_tpu/obs/reqtrace) — traceparent honored/minted, spans connected
+# across gateway -> prefill -> transfer -> decode, /v1/requests/<id>
+# timelines, burn-rate gauges moving under tight targets, loadgen
+# goodput gating.
+reqtrace-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_reqtrace.py -q -m 'not slow'
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -178,7 +186,7 @@ disagg-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -197,4 +205,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke perf-smoke deploy clean

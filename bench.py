@@ -23,7 +23,10 @@ Knobs (env):
   CAKE_BENCH_OBS=1   decode tok/s with observability off vs on (tracer +
                      flight recorder) through the generator hot path;
                      emits the overhead percentage (`make perf-smoke`
-                     bounds the disabled-path micro-cost).
+                     bounds the disabled-path micro-cost), plus a second
+                     row repeating the off/on comparison through the
+                     HTTP serve plane where tracing mints per-request
+                     spans (reqtrace) — target within 3% of untraced.
   CAKE_BENCH_SERVE=1 end-to-end HTTP serving: loadgen clients against the
                      --mode serve plane (cake_tpu/serve) over the same
                      engine — aggregate tok/s through the socket plus
@@ -601,7 +604,11 @@ def _run_obs_overhead(config, params, preset, quant, dev, steps) -> int:
     span()/record()/histogram per token. The figure of merit is the
     overhead percentage; the obs satellite contract is that OFF costs an
     attribute check per call site (`make perf-smoke` bounds that
-    micro-cost; this row prices the enabled planes)."""
+    micro-cost; this row prices the enabled planes). A second row does
+    the same off/on comparison through the HTTP serve plane, where the
+    tracer additionally carries the per-request span set (serve.queue →
+    session.emit, cake_tpu/obs/reqtrace); the design target is traced
+    serve tok/s within 3% of untraced."""
     from cake_tpu.obs import flight, trace
     from cake_tpu.ops.sampling import SamplerSettings
     from cake_tpu.runtime.generator import LlamaGenerator
@@ -648,6 +655,63 @@ def _run_obs_overhead(config, params, preset, quant, dev, steps) -> int:
     }, dev, baseline=f"obs_off_{off:.1f}tok/s",
         obs_off_tok_s=round(off, 2), obs_on_tok_s=round(on, 2),
         timed_tokens=n - 2)
+
+    # -- serve leg: the same off/on comparison through the HTTP plane,
+    # where tracing also mints per-request spans (reqtrace) on every
+    # queue/admit/prefill/emit transition rather than per-token records
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    clients = 2
+    max_tokens = max(4, min(steps, config.max_seq_len - 16))
+    gen = BatchGenerator(config, params, settings=settings,
+                         kv_quant=kv_quant)
+    sched = Scheduler(gen, queue_depth=4 * clients)
+    sched.start(max_concurrent=clients, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    url = f"http://127.0.0.1:{srv.port}"
+
+    def serve_run(label: str, seed: int) -> float:
+        # 4 requests/client: a longer window than the SERVE row's 2 —
+        # the figure of merit here is a small DELTA, not the absolute
+        stats = loadgen.run_load(
+            url, 4 * clients, concurrency=clients, max_tokens=max_tokens,
+            prompt_lens=[8], vocab=config.vocab_size - 1, seed=seed)
+        if stats["completed"] != 4 * clients or stats["errors"]:
+            raise RuntimeError(f"serve obs leg ({label}) failed: {stats}")
+        sys.stderr.write(f"serve obs={label}: {stats['tok_s']:.1f} tok/s\n")
+        return stats["tok_s"]
+
+    try:
+        # warm pass: first requests pay decode/admission compiles
+        loadgen.run_load(url, clients, concurrency=clients, max_tokens=4,
+                         prompt_lens=[8], vocab=config.vocab_size - 1,
+                         seed=1)
+        serve_off = serve_run("off", seed=2)
+        trace.tracer().start()
+        flight.recorder().enable()
+        try:
+            serve_on = serve_run("on", seed=3)
+        finally:
+            trace.tracer().stop()
+            flight.recorder().disable()
+            flight.recorder().clear()
+            trace.tracer().clear()
+    finally:
+        srv.close()
+        sched.close()
+    serve_pct = (serve_off / serve_on - 1.0) * 100.0
+    _emit({
+        "metric": f"serve_trace_overhead_pct_{_mtag(preset)}_{wtag}_1chip",
+        "value": round(serve_pct, 2),
+        "unit": "%",
+        "vs_baseline": round(serve_on / serve_off, 4),
+    }, dev, baseline=f"trace_off_{serve_off:.1f}tok/s",
+        serve_off_tok_s=round(serve_off, 2),
+        serve_on_tok_s=round(serve_on, 2),
+        clients=clients, max_tokens=max_tokens)
     return 0
 
 

@@ -344,6 +344,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "the codec is lossless for the cache dtype — "
                         "none always, bf16 on a bf16 cache, int8 on an "
                         "int8-quantized pool")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   dest="slo_ttft_ms", metavar="MS",
+                   help="--mode serve/gateway: per-request time-to-first-"
+                        "token SLO target. Completed requests are judged "
+                        "good/bad against it (slo.good/slo.bad counters, "
+                        "slo.burn_short/slo.burn_long burn-rate gauges on "
+                        "/metrics and /healthz; per-request verdict on "
+                        "GET /v1/requests/<id>)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   dest="slo_tpot_ms", metavar="MS",
+                   help="--mode serve/gateway: per-request mean time-per-"
+                        "output-token SLO target (same accounting as "
+                        "--slo-ttft-ms; a request must meet BOTH set "
+                        "targets to count good)")
     # -- routing gateway (--mode gateway: cake_tpu/gateway) ------------------
     p.add_argument("--backends", default=None, metavar="HOST:PORT,...",
                    help="--mode gateway: comma-separated serve-replica "
@@ -667,7 +681,22 @@ def _serve_flags(args) -> list[str]:
         out.append("--transfer-port")
     if args.transfer_codec != "none":
         out.append("--transfer-codec")
+    if args.slo_ttft_ms is not None:
+        out.append("--slo-ttft-ms")
+    if args.slo_tpot_ms is not None:
+        out.append("--slo-tpot-ms")
     return out
+
+
+def _slo_tracker(args):
+    """SLO accounting shared by serve and gateway (obs/reqtrace): built
+    only when a target is set, so untargeted runs pay nothing."""
+    if args.slo_ttft_ms is None and args.slo_tpot_ms is None:
+        return None
+    from cake_tpu.obs.reqtrace import SloPolicy, SloTracker
+
+    return SloTracker(SloPolicy(ttft_ms=args.slo_ttft_ms,
+                                tpot_ms=args.slo_tpot_ms))
 
 
 def run_http_serve(args) -> int:
@@ -829,7 +858,8 @@ def run_http_serve(args) -> int:
         scheduler = Scheduler(engine, queue_depth=queue_depth,
                               request_timeout_s=request_timeout,
                               role=args.role,
-                              transfer_codec=args.transfer_codec)
+                              transfer_codec=args.transfer_codec,
+                              slo=_slo_tracker(args))
     except ValueError as e:
         sys.exit(f"error: {e}")
     # warm the masked (constrained-decoding) program too when requests
@@ -1008,7 +1038,8 @@ def run_gateway(args) -> int:
                            port=serve_port,
                            prefix_block=args.gateway_prefix_block,
                            read_timeout=request_timeout,
-                           status_fn=gateway_status)
+                           status_fn=gateway_status,
+                           slo=_slo_tracker(args))
     status_httpd = None
     if args.status_port is not None:
         from cake_tpu.obs import statusd

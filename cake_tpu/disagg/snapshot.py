@@ -77,7 +77,7 @@ class Snapshot:
                  pos: int, index: int, last_token: int, key: np.ndarray,
                  history: np.ndarray, hist_slot: int,
                  guide_spec: dict | None, guide_state: int,
-                 pages: list[dict]):
+                 pages: list[dict], trace: dict | None = None):
         self.xfer_id = xfer_id
         self.stream_id = int(stream_id)
         self.fingerprint = fingerprint
@@ -93,6 +93,10 @@ class Snapshot:
         self.guide_spec = guide_spec
         self.guide_state = int(guide_state)
         self.pages = pages
+        # request-scoped trace context riding the frame metadata
+        # (obs/reqtrace: {"id", "parent", "request"}); optional — absent
+        # on snapshots from untraced exporters
+        self.trace = trace
 
     @property
     def n_pages(self) -> int:
@@ -118,7 +122,8 @@ def encode_snapshot(xfer_id: str, fingerprint: dict, codec: str,
                     generated: list[int], pos: int, index: int,
                     last_token: int, key, history, hist_slot: int,
                     guide_spec: dict | None, guide_state: int,
-                    pages: list[dict]) -> bytes:
+                    pages: list[dict],
+                    trace: dict | None = None) -> bytes:
     """Serialize one stream's state + pages (see module docstring)."""
     check_codec(codec)
     keys = _QUANT_KEYS if pages and "kq" in pages[0] else _PLAIN_KEYS
@@ -149,6 +154,10 @@ def encode_snapshot(xfer_id: str, fingerprint: dict, codec: str,
         "blobs": [len(b) for b in blobs],
         "tensors_per_page": len(keys),
     }
+    if trace:
+        # optional key: old decoders ignore it, new ones .get it — no
+        # version bump needed for a metadata-only addition
+        header["trace"] = trace
     hj = json.dumps(header).encode()
     return b"".join([_HEAD.pack(MAGIC, SNAPSHOT_VERSION, len(hj)), hj,
                      *blobs])
@@ -232,4 +241,5 @@ def decode_snapshot(data) -> Snapshot:
         guide_spec=guide["spec"] if guide else None,
         guide_state=guide["state"] if guide else 0,
         pages=pages,
+        trace=header.get("trace"),
     )

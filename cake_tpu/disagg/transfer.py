@@ -25,6 +25,7 @@ costs one duplicate send, never a duplicate import.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -67,34 +68,44 @@ class TransferRejected(TransferError):
 def send_snapshot(host: str, port: int, payload: bytes,
                   deadline_s: float = 15.0, connect_timeout_s: float = 2.0,
                   ack_timeout_s: float = 10.0, rng=None,
-                  sleep=time.sleep) -> None:
+                  sleep=time.sleep, trace=None) -> None:
     """Ship one snapshot and wait for the receiver's verdict.
 
     Retries transport failures (reconnect + resend) with full jitter
     until ``deadline_s`` runs out — raising :class:`TransferError` with
     the last transport error chained — and raises
     :class:`TransferRejected` immediately on an ``XFER_REJECT``.
+    With ``trace`` (an ``obs.reqtrace.ReqTrace``), every attempt —
+    including the failed ones a retry follows — records its own
+    ``disagg.transfer`` span, so a chaos-hit transfer shows its retries.
     """
     t0 = time.perf_counter()
+    n_attempt = [0]
 
     def attempt() -> None:
-        conn = wire.connect(host, port,
-                            timeout_ms=int(connect_timeout_s * 1000))
-        try:
-            conn.send(XFER_SNAPSHOT, payload)
-            # the ACK waits on the receiver's parse only (pool-pressure
-            # deferral happens after the ACK, inside the engine FIFO),
-            # so one generous quiescence deadline covers it
-            mtype, body = conn.recv(timeout=ack_timeout_s)
-        finally:
-            conn.close()
-        if mtype == XFER_ACK:
-            return
-        if mtype == XFER_REJECT:
-            raise TransferRejected(
-                body.decode(errors="replace") or "snapshot rejected")
-        raise wire.WireError(
-            f"unexpected transfer reply frame type {mtype}")
+        n_attempt[0] += 1
+        span = (trace.span("disagg.transfer", attempt=n_attempt[0],
+                           target=f"{host}:{port}")
+                if trace is not None else contextlib.nullcontext())
+        with span:
+            conn = wire.connect(host, port,
+                                timeout_ms=int(connect_timeout_s * 1000))
+            try:
+                conn.send(XFER_SNAPSHOT, payload)
+                # the ACK waits on the receiver's parse only
+                # (pool-pressure deferral happens after the ACK, inside
+                # the engine FIFO), so one generous quiescence deadline
+                # covers it
+                mtype, body = conn.recv(timeout=ack_timeout_s)
+            finally:
+                conn.close()
+            if mtype == XFER_ACK:
+                return
+            if mtype == XFER_REJECT:
+                raise TransferRejected(
+                    body.decode(errors="replace") or "snapshot rejected")
+            raise wire.WireError(
+                f"unexpected transfer reply frame type {mtype}")
 
     policy = RetryPolicy(deadline_s=deadline_s, base_s=0.05, cap_s=1.0)
     try:

@@ -39,8 +39,11 @@ import time
 
 from cake_tpu.gateway import policy as policy_mod
 from cake_tpu.gateway.health import Backend, HealthMonitor
+from cake_tpu.obs import flight as obs_flight
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.obs import statusd as _statusd
+from cake_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("cake_tpu.gateway.api")
 
@@ -77,7 +80,8 @@ class _Attempt:
         self.resp: http.client.HTTPResponse | None = None
         self.t_sent: float | None = None
 
-    def send(self, method: str, path: str, body: bytes | None = None):
+    def send(self, method: str, path: str, body: bytes | None = None,
+             headers: dict | None = None):
         """Connect (short timeout), widen to the stream timeout, send,
         and read the response head. Raises ``OSError`` on any transport
         failure — the retry loop's cue. ``t_sent`` is stamped the moment
@@ -86,11 +90,11 @@ class _Attempt:
         after it is the backend working."""
         self.conn.connect()
         self.conn.sock.settimeout(self.read_timeout)
-        headers = {}
+        hdrs = dict(headers or {})
         if body is not None:
-            headers = {"Content-Type": "application/json",
-                       "Content-Length": str(len(body))}
-        self.conn.request(method, path, body=body, headers=headers)
+            hdrs.update({"Content-Type": "application/json",
+                         "Content-Length": str(len(body))})
+        self.conn.request(method, path, body=body, headers=hdrs)
         self.t_sent = time.perf_counter()
         self.resp = self.conn.getresponse()
         return self.resp
@@ -116,9 +120,14 @@ class GatewayServer:
     def __init__(self, monitor: HealthMonitor, policy,
                  bind: str = "127.0.0.1", port: int = 0,
                  prefix_block: int = 64, connect_timeout: float = 2.0,
-                 read_timeout: float = 300.0, status_fn=None):
+                 read_timeout: float = 300.0, status_fn=None,
+                 slo: obs_reqtrace.SloTracker | None = None):
         self.monitor = monitor
         self.policy = policy
+        # SLO accounting at the front door (--slo-ttft-ms/--slo-tpot-ms):
+        # the gateway judges end-to-end latency AS THE CLIENT SEES IT —
+        # routing, retries, and tiered hops included (obs/reqtrace)
+        self.slo = slo
         # one source of truth for the affinity alignment: a Prefix policy
         # carries its own block, and the key MUST be computed at that
         # block for the policy's hashing to group what it means to group;
@@ -225,6 +234,120 @@ def _make_handler(server: GatewayServer):
                    headers: dict | None = None) -> None:
             self._json(status, {"error": message}, headers)
 
+        # -- request-scoped tracing helpers -------------------------------
+        _ctx: obs_reqtrace.ReqTrace | None = None
+
+        def _trace_headers(self) -> dict:
+            """Outbound traceparent for a backend hop (the live span —
+            gateway.route or gateway.retry — becomes the parent)."""
+            return ({obs_reqtrace.HEADER: self._ctx.header()}
+                    if self._ctx is not None else {})
+
+        def _finish_request(self) -> None:
+            """Close out one proxied request: SLO verdict on the
+            end-to-end latency the client saw, the gateway.request
+            flight record, the request-log entry behind
+            ``GET /v1/requests/<id>``, and — when this process is
+            tracing — the remote tiers' timelines stitched into the
+            local tracer so one ``--trace`` file shows the whole fleet."""
+            ctx, rs = self._ctx, self._rstat
+            if ctx is None:
+                return
+            self._ctx = None
+            ttft_ms = ((rs["t_first"] - rs["t0"]) * 1e3
+                       if rs["t_first"] is not None else None)
+            tpot_ms = None
+            if rs["tokens"] > 1 and rs["t_last"] is not None \
+                    and rs["t_last"] > rs["t_first"]:
+                tpot_ms = ((rs["t_last"] - rs["t_first"]) * 1e3
+                           / (rs["tokens"] - 1))
+            verdict = None
+            if server.slo is not None and rs["ok"]:
+                verdict = server.slo.observe(ttft_ms, tpot_ms)
+                ctx.slo = verdict
+            if obs_trace.tracer().enabled:
+                self._stitch_backends(ctx)
+            obs_reqtrace.request_log().put(ctx)
+            rec = obs_flight.recorder()
+            if rec.enabled:
+                rec.record(kind="gateway.request", trace=ctx.trace_id,
+                           ok=rs["ok"], tokens=rs["tokens"],
+                           ttft_ms=round(ttft_ms, 3)
+                           if ttft_ms is not None else None,
+                           tpot_ms=round(tpot_ms, 3)
+                           if tpot_ms is not None else None,
+                           backends=",".join(
+                               b.name for b in rs["backends"]),
+                           slo_good=verdict["good"] if verdict else None)
+
+        def _stitch_backends(self, ctx) -> None:
+            """Pull each touched backend's span timeline for this trace
+            (its /v1/requests debug endpoint) and land the spans on the
+            local tracer under per-backend tracks — best-effort: a
+            backend without the endpoint, or with the entry evicted,
+            just contributes nothing."""
+            seen = set()
+            for b in self._rstat["backends"]:
+                if b.addr in seen:
+                    continue
+                seen.add(b.addr)
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=server.connect_timeout)
+                try:
+                    conn.request("GET",
+                                 f"/v1/requests/{ctx.trace_id}")
+                    resp = conn.getresponse()
+                    if resp.status != 200:
+                        continue
+                    tl = json.loads(resp.read())
+                except (OSError, ValueError):
+                    continue
+                finally:
+                    conn.close()
+                obs_reqtrace.stitch_timeline(tl, f"{b.name}@{b.addr}")
+
+        def _fleet_timeline(self, key: str) -> dict | None:
+            """One request's fleet-wide span timeline: the gateway's own
+            entry (gateway.route/retry + the client-view SLO verdict)
+            merged with every routable backend's ``/v1/requests`` answer,
+            deduped by span id — so the debug endpoint shows the same
+            connected tree on the gateway as a stitched trace file does.
+            Best-effort per backend; None only when NOBODY knows the id."""
+            merged: dict = {"trace_id": None}
+            seen: set = set()
+            spans: list = []
+
+            def absorb(tl: dict | None) -> None:
+                if not tl:
+                    return
+                merged["trace_id"] = merged["trace_id"] or tl.get(
+                    "trace_id")
+                for k in ("request_id", "slo"):
+                    if tl.get(k) is not None and k not in merged:
+                        merged[k] = tl[k]
+                for s in tl.get("spans") or []:
+                    if s.get("span") not in seen:
+                        seen.add(s.get("span"))
+                        spans.append(s)
+
+            absorb(obs_reqtrace.request_log().get(key))
+            for b in {b.addr: b for b in monitor.routable()}.values():
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=server.connect_timeout)
+                try:
+                    conn.request("GET", f"/v1/requests/{key}")
+                    resp = conn.getresponse()
+                    if resp.status == 200:
+                        absorb(json.loads(resp.read()))
+                except (OSError, ValueError):
+                    pass
+                finally:
+                    conn.close()
+            if not spans:
+                return None
+            merged["spans"] = sorted(spans, key=lambda s: s["t"])
+            return merged
+
         def _relay(self, resp, data: bytes) -> None:
             """One whole (non-streaming) backend response to the client,
             status and relevant headers preserved."""
@@ -247,7 +370,7 @@ def _make_handler(server: GatewayServer):
                 tiers: dict[str, int] = {}
                 for b in ups:
                     tiers[b.role] = tiers.get(b.role, 0) + 1
-                self._json(200 if ok else 503, {
+                body = {
                     "ok": ok,
                     "draining": draining,
                     "backends_up": len(ups),
@@ -256,9 +379,19 @@ def _make_handler(server: GatewayServer):
                     "tiers": tiers,
                     "backends": {b.name: b.state
                                  for b in monitor.backends},
-                })
+                }
+                if server.slo is not None:
+                    body["slo"] = server.slo.snapshot()
+                self._json(200 if ok else 503, body)
             elif path == "/v1/models":
                 self._proxy_get("/v1/models")
+            elif path.startswith("/v1/requests/"):
+                key = path[len("/v1/requests/"):]
+                tl = self._fleet_timeline(key)
+                if tl is None:
+                    self._error(404, f"unknown request {key}")
+                else:
+                    self._json(200, tl)
             elif path in ("/", "/metrics"):
                 body, ctype = _statusd.status_response(server.status_fn,
                                                        path)
@@ -306,10 +439,22 @@ def _make_handler(server: GatewayServer):
                 self._error(503, "gateway is draining")
                 return
             REQUESTS.inc()
+            # request-scoped trace context (obs/reqtrace): honor the
+            # client's traceparent or mint one; every backend hop below
+            # re-propagates it, so the whole fleet shares one trace id
+            ctx = obs_reqtrace.ReqTrace.from_header(
+                self.headers.get(obs_reqtrace.HEADER))
+            self._ctx = ctx
+            self._rstat = {"t0": time.perf_counter(), "t_first": None,
+                           "t_last": None, "tokens": 0, "ok": False,
+                           "backends": []}
             try:
-                self._proxy_completions()
+                with ctx.span("gateway.route",
+                              policy=getattr(server.policy, "name", "?")):
+                    self._proxy_completions()
             finally:
                 server._exit()
+                self._finish_request()
 
         def _proxy_completions(self) -> None:
             try:
@@ -365,11 +510,18 @@ def _make_handler(server: GatewayServer):
                 b = server.policy.choose(cands, key=key, now=now,
                                          first_attempt=not tried)
                 tried.append(b)
+                b.requests.inc()
                 if len(tried) > 1:
                     RETRIES.inc()
                     tried[-2].retries.inc()
-                b.requests.inc()
-                outcome = self._try_backend(b, raw, t0)
+                    # a transparent re-route gets its own span, nested
+                    # under gateway.route — chaos runs read as a retry
+                    # chain in the request timeline
+                    with self._ctx.span("gateway.retry", backend=b.name,
+                                        attempt=len(tried)):
+                        outcome = self._try_backend(b, raw, t0)
+                else:
+                    outcome = self._try_backend(b, raw, t0)
                 if outcome == "done":
                     return
                 if isinstance(outcome, tuple):  # a 429: remember, go on
@@ -434,7 +586,9 @@ def _make_handler(server: GatewayServer):
                            server.read_timeout)
             try:
                 try:
-                    resp = att.send("POST", "/v1/completions", praw)
+                    resp = att.send("POST", "/v1/completions", praw,
+                                    headers=self._trace_headers())
+                    self._rstat["backends"].append(pre)
                     data = resp.read()
                 except OSError as e:
                     log.debug("prefill backend %s failed: %s",
@@ -480,7 +634,9 @@ def _make_handler(server: GatewayServer):
             att = _Attempt(b, server.connect_timeout, server.read_timeout)
             try:
                 try:
-                    resp = att.send("POST", "/v1/completions", raw)
+                    resp = att.send("POST", "/v1/completions", raw,
+                                    headers=self._trace_headers())
+                    self._rstat["backends"].append(b)
                     t_sent = att.t_sent
                 except OSError as e:
                     log.debug("backend %s connect/send failed: %s",
@@ -522,6 +678,9 @@ def _make_handler(server: GatewayServer):
                 if resp.status < 400:
                     ADDED_MS.observe((t_sent - t0) * 1e3)
                     monitor.report_success(b)
+                    rs = self._rstat
+                    rs["t_first"] = rs["t_last"] = time.perf_counter()
+                    rs["ok"] = True
                 try:
                     self._relay(resp, data)
                 except OSError:
@@ -548,6 +707,11 @@ def _make_handler(server: GatewayServer):
                 return None
             ADDED_MS.observe((t_sent - t0) * 1e3)
             monitor.report_success(b)
+            rs = self._rstat
+            rs["t_first"] = rs["t_last"] = time.perf_counter()
+            # counting serialized token events in the raw SSE bytes keeps
+            # the relay zero-parse; good enough for a TPOT estimate
+            rs["tokens"] += first.count(b'"token"')
             try:
                 self.send_response(200)
                 for h in ("Content-Type", "Cache-Control"):
@@ -569,7 +733,12 @@ def _make_handler(server: GatewayServer):
                         b.errors.inc()
                         break
                     if not chunk:
+                        rs["ok"] = True
                         break  # normal close-delimited end of stream
+                    n_tok = chunk.count(b'"token"')
+                    if n_tok:
+                        rs["tokens"] += n_tok
+                        rs["t_last"] = time.perf_counter()
                     self.wfile.write(chunk)
                     self.wfile.flush()
             except OSError as e:

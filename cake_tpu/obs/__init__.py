@@ -31,7 +31,7 @@ from __future__ import annotations
 
 import logging
 
-from cake_tpu.obs import clock, flight, metrics, trace  # noqa: F401
+from cake_tpu.obs import clock, flight, metrics, reqtrace, trace  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     counter,
     gauge,

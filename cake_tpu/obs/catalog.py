@@ -121,6 +121,23 @@ SERIES: dict[str, tuple[str, str]] = {
     "master.tokens_generated": (COUNTER, "tokens emitted by the master"),
     # -- recovery/backoff plane ------------------------------------------
     "recover.backoff_ms": (COUNTER, "total backoff sleep during recovery"),
+    # -- request-scoped tracing (cake_tpu/obs/reqtrace) ------------------
+    "reqtrace.header_errors": (
+        COUNTER, "malformed inbound traceparent headers (fell back to a "
+                 "fresh mint)"),
+    "reqtrace.requests": (
+        COUNTER, "distinct trace ids landed in the per-process request "
+                 "log"),
+    "reqtrace.stitched": (
+        COUNTER, "remote tier timelines merged into the local tracer"),
+    # -- SLO accounting (per-class TTFT/TPOT targets) --------------------
+    "slo.bad": (COUNTER, "requests that missed their TTFT/TPOT targets"),
+    "slo.burn_long": (
+        GAUGE, "long-window (600 s) error-budget burn rate (bad-fraction "
+               "/ budget; >1 = burning faster than the objective allows)"),
+    "slo.burn_short": (
+        GAUGE, "short-window (60 s) error-budget burn rate"),
+    "slo.good": (COUNTER, "requests that met their TTFT/TPOT targets"),
     # -- serving plane (HTTP API + scheduler) ----------------------------
     "serve.admit_chunk_ms": (HISTOGRAM, "admission prefill chunk dispatch"),
     "serve.cancelled": (COUNTER, "requests cancelled (client went away)"),

@@ -1299,7 +1299,8 @@ class BatchGenerator:
                 "engines as pool pages (construct with kv_layout='paged' "
                 "/ --kv-layout paged)")
 
-    def export_stream(self, stream_id: int, codec: str = "none") -> bytes:
+    def export_stream(self, stream_id: int, codec: str = "none",
+                      trace: dict | None = None) -> bytes:
         """Snapshot a LIVE stream's KV pages + sampler/cursor state into
         versioned, self-describing bytes (cake_tpu/disagg/snapshot) —
         the suspend half of session suspend/resume and the payload the
@@ -1315,7 +1316,9 @@ class BatchGenerator:
         each page through the wire activation codec (``--wire-codec``);
         round trips are bit-identical whenever the codec is lossless for
         the cache dtype (none always; bf16 on a bf16 cache; int8 on an
-        int8-quantized pool)."""
+        int8-quantized pool). ``trace`` (an ``obs.reqtrace`` wire dict)
+        rides the snapshot's JSON metadata so the importing tier joins
+        the request's trace."""
         from cake_tpu.disagg import snapshot as _snapshot
 
         self._domain_stamp.check("BatchGenerator.export_stream")
@@ -1381,6 +1384,7 @@ class BatchGenerator:
             guide_spec=guide_spec,
             guide_state=guide.state if guide is not None else 0,
             pages=pages,
+            trace=trace,
         )
         # the original stream id rides along so a same-seed resume can
         # keep the identity (the raw key above is what bit-identity
@@ -1445,6 +1449,11 @@ class BatchGenerator:
             "texts": texts,
             "n_kv": snap.pos,
         }
+        if snap.trace:
+            # the exporter's request-trace context (obs/reqtrace) —
+            # surfaced so the scheduler can land a disagg.import span in
+            # the same causal tree
+            meta["trace"] = snap.trace
         self._imports[snap.xfer_id] = {
             "snap": snap, "pages": None, "detok": detok, "meta": meta,
             "deferred": False, "t": time.monotonic(),

@@ -44,6 +44,7 @@ import json
 import logging
 import threading
 
+from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.obs import statusd as _statusd
 from cake_tpu.serve.scheduler import Draining, QueueFull
 from cake_tpu.serve.session import Session, sse_event
@@ -319,7 +320,21 @@ def _make_handler(server: ApiServer):
                     # a pool out of free pages defers admissions even
                     # when slots look open
                     body["kv_pages_free"] = kv["pages_free"]
+                if st.get("slo"):
+                    # SLO burn state (--slo-ttft-ms/--slo-tpot-ms) rides
+                    # the same probe body dashboards already poll
+                    body["slo"] = st["slo"]
                 self._json(200 if not st["draining"] else 503, body)
+            elif path.startswith("/v1/requests/"):
+                # per-request debug timeline: spans + SLO verdict for a
+                # recent request, by request id or trace id
+                key = path.rsplit("/", 1)[1]
+                tl = obs_reqtrace.request_log().get(key) if key else None
+                if tl is None:
+                    self._error(404, f"no recorded request {key!r} "
+                                     "(evicted, or never served here)")
+                else:
+                    self._json(200, tl)
             elif path == "/v1/models":
                 eng = scheduler.engine
                 self._json(200, {"object": "list", "data": [{
@@ -360,6 +375,12 @@ def _make_handler(server: ApiServer):
             except ValueError as e:
                 self._error(400, str(e))
                 return
+            # request-scoped trace context: honor the client/gateway's
+            # traceparent (or mint one), and judge completed requests
+            # against the replica's SLO targets, if any
+            sess.reqtrace = obs_reqtrace.ReqTrace.from_header(
+                self.headers.get(obs_reqtrace.HEADER))
+            sess.slo = scheduler.slo
             if scheduler.role == "prefill" and sess.handoff is None:
                 # a prefill-tier replica runs bucketed prefill ONLY; a
                 # request without a handoff target would decode here and
@@ -456,11 +477,13 @@ def _make_handler(server: ApiServer):
                                  "re-prefill")
                 return
             payload = ev[1]
+            ctx = sess.reqtrace
             scheduler.xfer_out_enter()
             try:
                 send_snapshot(sess.handoff["host"], sess.handoff["port"],
                               payload,
-                              deadline_s=scheduler.transfer_deadline_s)
+                              deadline_s=scheduler.transfer_deadline_s,
+                              trace=ctx)
             except TransferError as e:
                 # retry budget exhausted or receiver rejected: the pages
                 # are gone with this replica's slot — tell the gateway
@@ -469,6 +492,12 @@ def _make_handler(server: ApiServer):
                 return
             finally:
                 scheduler.xfer_out_exit()
+                if ctx is not None:
+                    # the prefill half of the request ends here; make
+                    # its spans (queue/admit/export/transfer attempts)
+                    # queryable under the request id
+                    ctx.request_id = sess.id
+                    obs_reqtrace.request_log().put(ctx)
             self._json(200, {
                 "handoff": True,
                 "xfer_id": peek_xfer_id(payload),

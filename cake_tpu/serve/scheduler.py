@@ -26,6 +26,7 @@ cancellation, and drain.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import queue
 import threading
@@ -33,6 +34,7 @@ import time
 from collections import deque
 
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.serve import session as _session
 from cake_tpu.serve.session import Session
 
@@ -116,7 +118,8 @@ class Scheduler:
                  request_timeout_s: float | None = None,
                  role: str = "mixed", transfer_codec: str = "none",
                  transfer_deadline_s: float = 15.0,
-                 import_ttl_s: float = 120.0):
+                 import_ttl_s: float = 120.0,
+                 slo: obs_reqtrace.SloTracker | None = None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if role not in ROLES:
@@ -136,6 +139,9 @@ class Scheduler:
         self.transfer_codec = transfer_codec
         self.transfer_deadline_s = transfer_deadline_s
         self.import_ttl_s = import_ttl_s
+        # SLO accounting (--slo-ttft-ms/--slo-tpot-ms): sessions judge
+        # themselves against this tracker at finish (obs/reqtrace)
+        self.slo = slo
         self.transfer_port: int | None = None
         self.max_concurrent = 0  # set by start() (dp may pad the batch up)
         self._queue: deque[Session] = deque()
@@ -325,6 +331,7 @@ class Scheduler:
                     return
                 kind, payload, reply = self._import_inbox.popleft()
             if kind == "begin":
+                t_begin = time.time()
                 try:
                     meta = self.engine.import_begin(payload)
                 except Exception as e:
@@ -335,6 +342,15 @@ class Scheduler:
                     self._imports_meta[meta["xfer_id"]] = dict(
                         meta, t=time.monotonic())
                 self._sync_inflight()
+                ctx = obs_reqtrace.ReqTrace.from_wire(meta.get("trace"))
+                if ctx is not None:
+                    # the snapshot carried its request's trace context:
+                    # land the import as a span parented under the
+                    # prefill tier's export, and make it queryable here
+                    ctx.add_span("disagg.import", t_begin,
+                                 (time.time() - t_begin) * 1e3,
+                                 xfer=meta["xfer_id"])
+                    obs_reqtrace.request_log().put(ctx)
                 if reply is not None:
                     reply.put(("ok", meta))
             else:  # abort
@@ -427,6 +443,8 @@ class Scheduler:
             "kv_transfers_inflight": self.kv_transfers_inflight(),
             **({"transfer_port": self.transfer_port}
                if self.transfer_port else {}),
+            **({"slo": self.slo.snapshot()}
+               if self.slo is not None else {}),
             "engine": engine_stats,
         }
 
@@ -532,28 +550,40 @@ class Scheduler:
                 _session.QUEUE_DEPTH.set(len(self._queue))
                 sid = self._next_sid
                 self._next_sid += 1
+            ctx = sess.reqtrace
+            if ctx is not None:
+                t_now = time.time()
+                ctx.add_span("serve.queue", sess.t_submit_unix,
+                             (t_now - sess.t_submit_unix) * 1e3,
+                             request=sess.id)
+            admit_span = (ctx.span("serve.admit", request=sess.id)
+                          if ctx is not None else contextlib.nullcontext())
             try:
-                if sess.resume_xfer is not None:
-                    # a resumed import: attach the already-landed pages
-                    # to a slot (page-table edit) — the snapshot, not
-                    # the request body, is the source of stream state
-                    self.engine.import_attach(sess.resume_xfer, sid)
-                    with self._cond:
-                        self._imports_meta.pop(sess.resume_xfer, None)
-                    self._sync_inflight()
-                # guide= only when constrained: unconstrained admission
-                # keeps the bare protocol every engine stub speaks
-                elif sess.guide is not None:
-                    self.engine.enqueue(sess.prompt_ids, sid,
-                                        guide=sess.guide)
-                else:
-                    self.engine.enqueue(sess.prompt_ids, sid)
+                with admit_span:
+                    if sess.resume_xfer is not None:
+                        # a resumed import: attach the already-landed
+                        # pages to a slot (page-table edit) — the
+                        # snapshot, not the request body, is the source
+                        # of stream state
+                        self.engine.import_attach(sess.resume_xfer, sid)
+                        with self._cond:
+                            self._imports_meta.pop(sess.resume_xfer, None)
+                        self._sync_inflight()
+                    # guide= only when constrained: unconstrained
+                    # admission keeps the bare protocol every engine
+                    # stub speaks
+                    elif sess.guide is not None:
+                        self.engine.enqueue(sess.prompt_ids, sid,
+                                            guide=sess.guide)
+                    else:
+                        self.engine.enqueue(sess.prompt_ids, sid)
             except KeyError as e:  # unknown/expired transfer
                 sess.fail(409, str(e))
                 continue
             except ValueError as e:  # encode raced the window, etc.
                 sess.fail(400, str(e))
                 continue
+            sess.t_admit_unix = time.time()
             sess.stream_id = sid
             with self._cond:
                 self._by_sid[sid] = sess
@@ -622,9 +652,18 @@ class Scheduler:
                 self._by_sid.pop(sid, None)
             sess.fail(409, "stream completed during prefill; re-prefill")
             return
+        ctx = sess.reqtrace
         try:
-            payload = self.engine.export_stream(
-                sid, codec=self.transfer_codec)
+            if ctx is not None:
+                # inside the span so the snapshot's wire-trace parent is
+                # the export span itself — the decode tier's
+                # disagg.import then hangs under it in the merged tree
+                with ctx.span("disagg.export", request=sess.id):
+                    payload = self.engine.export_stream(
+                        sid, codec=self.transfer_codec, trace=ctx.wire())
+            else:
+                payload = self.engine.export_stream(
+                    sid, codec=self.transfer_codec)
         except Exception as e:
             log.exception("export of stream %d failed", sid)
             self.engine.finish(sid)

@@ -39,6 +39,7 @@ import uuid
 
 from cake_tpu.obs import flight as obs_flight
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import reqtrace as obs_reqtrace
 
 # Process-global serving instruments (get-or-create: the scheduler and the
 # API handler share these series without import-order coupling).
@@ -116,6 +117,14 @@ class Session:
         self._t_last: float | None = None
         self.ttft_ms: float | None = None
         self._tpot_sum_ms = 0.0
+        # request-scoped trace context + SLO tracker (set by the API
+        # layer; None for directly-constructed sessions — every hook
+        # below is guarded, so bare Sessions keep working)
+        self.reqtrace: obs_reqtrace.ReqTrace | None = None
+        self.slo: obs_reqtrace.SloTracker | None = None
+        self.t_submit_unix = time.time()
+        self.t_admit_unix: float | None = None
+        self._t_first_unix: float | None = None
 
     # -- engine-thread side ---------------------------------------------------
     def on_token(self, tok_id: int, text: str | None,
@@ -130,6 +139,18 @@ class Session:
         if self._t_last is None:
             self.ttft_ms = (now - self.t_submit) * 1e3
             TTFT_MS.observe(self.ttft_ms)
+            self._t_first_unix = time.time()
+            ctx = self.reqtrace
+            if ctx is not None:
+                if self.t_admit_unix is not None:
+                    # admission -> first token: the prefill (+ queued
+                    # decode) leg, as one request-attributed span
+                    ctx.add_span("engine.prefill", self.t_admit_unix,
+                                 (self._t_first_unix
+                                  - self.t_admit_unix) * 1e3,
+                                 request=self.id)
+                ctx.event("decode.first_token", request=self.id,
+                          ttft_ms=round(self.ttft_ms, 3))
         else:
             gap_ms = (now - self._t_last) * 1e3
             self._tpot_sum_ms += gap_ms
@@ -242,6 +263,22 @@ class Session:
             # cancelled/timed-out requests land in their own counters;
             # completed means the request actually got its tokens
             COMPLETED.inc()
+        verdict = None
+        if self.slo is not None and reason in _COMPLETED_REASONS:
+            # SLO is judged on requests that got their output; rejects
+            # and cancels have their own counters and no latency story
+            verdict = self.slo.observe(self.ttft_ms, self.tpot_ms)
+        ctx = self.reqtrace
+        if ctx is not None:
+            ctx.request_id = self.id
+            if verdict is not None:
+                ctx.slo = verdict
+            if self._t_first_unix is not None and self.generated:
+                ctx.add_span("session.emit", self._t_first_unix,
+                             (time.time() - self._t_first_unix) * 1e3,
+                             request=self.id, reason=reason,
+                             tokens=len(self.generated))
+            obs_reqtrace.request_log().put(ctx)
         rec = obs_flight.recorder()
         if rec.enabled:
             rec.record(kind="serve.request", request=self.id,
@@ -251,12 +288,24 @@ class Session:
                        if self.ttft_ms is not None else None,
                        tpot_ms=round(self.tpot_ms, 3)
                        if self.tpot_ms is not None else None,
-                       reason=reason)
+                       reason=reason,
+                       trace=ctx.trace_id if ctx is not None else None,
+                       slo_good=verdict["good"] if verdict else None)
+            if ctx is not None:
+                # the per-request JSON timeline, one flight line per
+                # request (totals() skips the non-numeric spans field)
+                rec.record(kind="reqtrace.timeline", request=self.id,
+                           trace=ctx.trace_id, spans=ctx.spans())
         self.events.put(("done", reason, self.usage(), tail_text))
 
     def fail(self, status: int, message: str) -> None:
         """Reject/abort the session with an HTTP-statused error event."""
         self.finish_reason = "error"
+        ctx = self.reqtrace
+        if ctx is not None:
+            ctx.request_id = self.id
+            ctx.event("session.error", request=self.id, status=status)
+            obs_reqtrace.request_log().put(ctx)
         self.events.put(("error", status, message))
 
     def handoff_ready(self, payload: bytes) -> None:

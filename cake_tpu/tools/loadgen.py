@@ -179,7 +179,9 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
              prompts: list[str] | None = None, stream: bool = True,
              timeout: float = 300.0, workload: str = "text",
              retry_429: bool = False,
-             disconnect_every: int | None = None) -> dict:
+             disconnect_every: int | None = None,
+             slo_ttft_ms: float | None = None,
+             slo_tpot_ms: float | None = None) -> dict:
     """Run the load; returns aggregate stats (also the in-process entry
     the bench row and tests use). ``workload="json"`` attaches the
     schema constraint to every request and json-validates every
@@ -196,7 +198,13 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     ``retry_429`` makes a 429 response honor its ``Retry-After`` and
     resubmit (bounded) instead of counting a hard rejection — the
     honest open-loop behavior against a saturated server or gateway (a
-    real client backs off; it does not give up)."""
+    real client backs off; it does not give up). ``slo_ttft_ms``/
+    ``slo_tpot_ms`` (ISSUE 16) judge every completed request against
+    per-request latency targets (TPOT as the mean inter-token gap) and
+    add an ``slo`` block with **goodput** — the fraction of completed
+    requests meeting BOTH set targets — next to the percentile view:
+    percentiles say how slow the tail was, goodput says how many users
+    got what the SLO promised."""
     if workload not in ("text", "json", "churn", "mixed-prefill"):
         raise ValueError(f"workload must be 'text', 'json', 'churn' or "
                          f"'mixed-prefill', got {workload!r}")
@@ -317,6 +325,32 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
                   "p95": round(_percentile(xs, 0.95) * 1e3, 1),
                   "n": len(xs)}
         for ln, xs in sorted(by_len.items())}
+    slo = None
+    if slo_ttft_ms is not None or slo_tpot_ms is not None:
+        good = 0
+        for r in done:
+            ok = True
+            if slo_ttft_ms is not None:
+                ok &= (r.get("ttft_s") is not None
+                       and r["ttft_s"] * 1e3 <= slo_ttft_ms)
+            if slo_tpot_ms is not None and r.get("gaps_s"):
+                tpot = sum(r["gaps_s"]) / len(r["gaps_s"]) * 1e3
+                ok &= tpot <= slo_tpot_ms
+            if ok:
+                good += 1
+            else:
+                r["slo_bad"] = True
+        slo = {
+            **({"ttft_target_ms": slo_ttft_ms}
+               if slo_ttft_ms is not None else {}),
+            **({"tpot_target_ms": slo_tpot_ms}
+               if slo_tpot_ms is not None else {}),
+            "good": good,
+            # goodput = fraction of ATTEMPTED requests that completed
+            # AND met every set target: a 429/error miss is an SLO miss,
+            # not a statistical exclusion
+            "goodput": round(good / n, 4) if n else 0.0,
+        }
     return {
         "requests": n,
         "completed": len(done),
@@ -339,6 +373,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         },
         **({"ttft_ms_by_prompt_len": ttft_by_len}
            if len(ttft_by_len) > 1 else {}),
+        **({"slo": slo} if slo is not None else {}),
         "results": results,
     }
 
@@ -491,11 +526,28 @@ def main(argv=None) -> int:
                         "must match) — 'prefill,decode' spawns the "
                         "minimal tiered fleet and the gateway's "
                         "two-stage route engages by itself")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   dest="slo_ttft_ms", metavar="MS",
+                   help="per-request TTFT target: the report gains an "
+                        "slo block with goodput (fraction of requests "
+                        "completing AND meeting every set target)")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   dest="slo_tpot_ms", metavar="MS",
+                   help="per-request mean TPOT target (judged with "
+                        "--slo-ttft-ms: a request must meet both)")
+    p.add_argument("--slo-goodput-min", type=float, default=None,
+                   dest="slo_goodput_min", metavar="FRAC",
+                   help="CI gate: exit nonzero when goodput falls below "
+                        "this fraction (needs an --slo-* target)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--timeout", type=float, default=300.0)
     args = p.parse_args(argv)
     if args.spawn_backends is not None and args.spawn_backends < 1:
         p.error("--spawn-backends must be >= 1")
+    if args.slo_goodput_min is not None and (args.slo_ttft_ms is None
+                                             and args.slo_tpot_ms is None):
+        p.error("--slo-goodput-min needs --slo-ttft-ms and/or "
+                "--slo-tpot-ms (there is no goodput without a target)")
     if args.url is None and args.spawn_backends is None:
         p.error("a server url is required (or --spawn-backends N)")
     roles = None
@@ -521,6 +573,7 @@ def main(argv=None) -> int:
             stream=not args.no_stream, timeout=args.timeout,
             workload=args.workload, retry_429=args.retry_429,
             disconnect_every=args.disconnect_every,
+            slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
         )
     finally:
         if cleanup is not None:
@@ -528,6 +581,12 @@ def main(argv=None) -> int:
     stats = dict(stats)
     stats.pop("results")
     print(json.dumps(stats, indent=1))
+    if (args.slo_goodput_min is not None
+            and stats.get("slo", {}).get("goodput", 0.0)
+            < args.slo_goodput_min):
+        print(f"SLO gate failed: goodput {stats['slo']['goodput']} < "
+              f"{args.slo_goodput_min}", file=sys.stderr)
+        return 1
     return 0 if stats["errors"] == 0 and stats["json_invalid"] == 0 else 1
 
 

@@ -718,13 +718,14 @@ class _Fleet:
 _SEQ = iter(range(10_000))
 
 
-def _sse_ids(url, prompt_ids, max_tokens=10, **extra):
+def _sse_ids(url, prompt_ids, max_tokens=10, headers=None, **extra):
     req = urllib.request.Request(
         url + "/v1/completions",
         data=json.dumps({"prompt_ids": prompt_ids,
                          "max_tokens": max_tokens,
                          "stream": True, **extra}).encode(),
-        headers={"Content-Type": "application/json"})
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
     ids = []
     with urllib.request.urlopen(req, timeout=120) as r:
         for raw in r:
@@ -793,6 +794,73 @@ class TestGatewayTiered:
             assert got == ref
             assert obs_metrics.counter("disagg.reprefills").value > r0
         finally:
+            fleet.close()
+
+    def test_chaos_tiered_run_yields_one_connected_trace(self, params):
+        """ISSUE 16 acceptance: a traced tiered request through gateway
+        -> prefill -> (chaos-faulted) transfer -> decode reads back as
+        ONE connected trace — the client's traceparent id on every span,
+        the killed transfer attempt recorded as a failed-attempt span
+        next to the retry that landed, and the import parented under the
+        prefill tier's export via the snapshot's wire metadata."""
+        import os
+
+        from cake_tpu.obs import reqtrace
+        from cake_tpu.obs import trace as obs_trace
+
+        ref = _reference(params, self.PROMPT, 8)
+        fleet = _Fleet(params, faults=parse_spec("kill@1"))
+        tid = os.urandom(16).hex()
+        root = os.urandom(8).hex()
+        obs_trace.tracer().start(max_events=100_000)
+        try:
+            got = _sse_ids(
+                fleet.url, self.PROMPT, max_tokens=8,
+                headers={reqtrace.HEADER: f"00-{tid}-{root}-01"})
+            assert got == ref
+            assert fleet.proxy.events, "transfer fault never fired"
+            want = {"gateway.route", "serve.queue", "serve.admit",
+                    "disagg.export", "disagg.transfer", "disagg.import",
+                    "session.emit"}
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                tl = reqtrace.request_log().get(tid)
+                if tl is not None and want <= {s["name"]
+                                               for s in tl["spans"]}:
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(
+                    f"merged timeline never covered {want}; last: "
+                    f"{tl and sorted({s['name'] for s in tl['spans']})}")
+            # one connected tree: every parent is a recorded span or
+            # the client's own root span
+            ids = {s["span"] for s in tl["spans"]}
+            for s in tl["spans"]:
+                p = s.get("parent")
+                assert p is None or p in ids or p == root, \
+                    f"span {s['name']} parented to unknown {p}"
+            # the killed first attempt AND the retry that landed, both
+            # present, failure annotated
+            xfers = [s for s in tl["spans"]
+                     if s["name"] == "disagg.transfer"]
+            assert len(xfers) >= 2
+            assert any("error" in s.get("args", {}) for s in xfers)
+            assert any("error" not in s.get("args", {}) for s in xfers)
+            # the decode tier's import hangs under the prefill export
+            exp = next(s for s in tl["spans"]
+                       if s["name"] == "disagg.export")
+            imp = next(s for s in tl["spans"]
+                       if s["name"] == "disagg.import")
+            assert imp["parent"] == exp["span"]
+            # and the tracer mirrors the same trace id end to end
+            doc = obs_trace.tracer().to_chrome_trace()
+            traced = {e["name"] for e in doc["traceEvents"]
+                      if e.get("args", {}).get("trace") == tid}
+            assert want <= traced
+        finally:
+            obs_trace.tracer().stop()
+            obs_trace.tracer().clear()
             fleet.close()
 
     def test_empty_decode_tier_routes_classically(self, params):

@@ -485,6 +485,111 @@ def test_5xx_before_first_byte_retries_transparently(stub_gateway):
         good.close()
 
 
+def test_pre_first_byte_retry_span_parented_under_route(stub_gateway):
+    """ISSUE 16: a transparent pre-first-byte retry leaves its own
+    gateway.retry span nested under gateway.route, on the SAME trace id
+    the client sent — and the gateway emits its flight record + mirrors
+    spans into the tracer (the --trace/--flight-log artifacts on --mode
+    gateway)."""
+    import os
+
+    from cake_tpu.obs import flight as obs_flight
+    from cake_tpu.obs import reqtrace
+    from cake_tpu.obs import trace as obs_trace
+
+    bad, good = _StubBackend("error500"), _StubBackend("ok")
+    tid = os.urandom(16).hex()
+    root = os.urandom(8).hex()
+    obs_trace.tracer().start(max_events=100_000)
+    obs_flight.recorder().enable()
+    obs_flight.recorder().clear()
+    try:
+        gw, mon = stub_gateway([bad.addr, good.addr],
+                               policy="round_robin", down_after=3,
+                               probe_interval=30.0)
+        req = urllib.request.Request(
+            _url(gw) + "/v1/completions",
+            data=json.dumps({"prompt_ids": [1], "max_tokens": 4,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json",
+                     reqtrace.HEADER: f"00-{tid}-{root}-01"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            r.read()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            tl = reqtrace.request_log().get(tid)
+            if tl is not None and {"gateway.route", "gateway.retry"} <= \
+                    {s["name"] for s in tl["spans"]}:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"no retry-bearing timeline for {tid}: "
+                f"{tl and [s['name'] for s in tl['spans']]}")
+        route = next(s for s in tl["spans"]
+                     if s["name"] == "gateway.route")
+        retry = next(s for s in tl["spans"]
+                     if s["name"] == "gateway.retry")
+        assert retry["parent"] == route["span"]
+        assert route["parent"] == root  # the client's own span chains up
+        # the artifacts a gateway-mode run flushes: flight record + trace
+        recs = [r for r in obs_flight.recorder().records()
+                if r.get("kind") == "gateway.request"
+                and r.get("trace") == tid]
+        assert recs and recs[0]["ok"] and recs[0]["tokens"] == 4
+        assert recs[0]["ttft_ms"] > 0
+        doc = obs_trace.tracer().to_chrome_trace()
+        traced = {e["name"] for e in doc["traceEvents"]
+                  if e.get("args", {}).get("trace") == tid}
+        assert {"gateway.route", "gateway.retry"} <= traced
+        # the gateway serves the same timeline on its own debug
+        # endpoint (merged with whatever the backends know — stubs
+        # know nothing, best-effort); unknown ids still 404
+        served = _get(_url(gw) + f"/v1/requests/{tid}")
+        assert {"gateway.route", "gateway.retry"} <= \
+            {s["name"] for s in served["spans"]}
+        assert served["trace_id"] == tid
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(_url(gw) + "/v1/requests/" + "0" * 32)
+        assert exc.value.code == 404
+    finally:
+        obs_trace.tracer().stop()
+        obs_trace.tracer().clear()
+        obs_flight.recorder().close()
+        bad.close()
+        good.close()
+
+
+def test_gateway_slo_judges_client_view(stub_gateway):
+    """The gateway's --slo-ttft-ms/--slo-tpot-ms accounting: verdicts
+    land on /healthz (burn block) and in the request timeline."""
+    from cake_tpu.obs import reqtrace
+
+    ok = _StubBackend("ok")
+    mon = _monitor([ok.addr], probe_interval=30.0)
+    mon.start()
+    slo = reqtrace.SloTracker(
+        reqtrace.SloPolicy(ttft_ms=60_000.0, tpot_ms=60_000.0))
+    gw = start_gateway(mon, make_policy("round_robin"),
+                       connect_timeout=1.0, read_timeout=60.0, slo=slo)
+    try:
+        g0 = slo._good.value
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 3})
+        assert out["usage"]["completion_tokens"] == 3
+        deadline = time.monotonic() + 5.0
+        while slo._good.value <= g0:
+            assert time.monotonic() < deadline, "no SLO verdict landed"
+            time.sleep(0.05)
+        health = _get(_url(gw) + "/healthz")
+        assert health["slo"]["window_n"] >= 1
+        assert health["slo"]["burn_short"] == 0.0
+        assert health["slo"]["ttft_target_ms"] == 60_000.0
+    finally:
+        gw.close()
+        mon.stop()
+        ok.close()
+
+
 def test_429_propagates_only_when_every_backend_saturated(stub_gateway):
     from cake_tpu.gateway import api as gw_api
 

@@ -1,0 +1,439 @@
+"""Request-scoped fleet tracing + SLO accounting (cake_tpu/obs/reqtrace).
+
+`make reqtrace-smoke` acceptance: traceparent headers are honored (and
+malformed ones safely re-minted), spans nest/parent correctly across
+threads and processes, the RequestLog merges a request's tier halves
+into one timeline behind ``GET /v1/requests/<id>``, SLO verdicts and
+burn-rate gauges move with traffic, a traced serve replica emits the
+full span set for a real streamed request (mirrored into the Perfetto
+tracer), and loadgen's goodput gate judges the same targets end to end.
+"""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import reqtrace
+from cake_tpu.obs import trace as obs_trace
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.batch_generator import BatchGenerator
+from cake_tpu.serve.api import start_api_server
+from cake_tpu.serve.scheduler import Scheduler
+from cake_tpu.tools.loadgen import run_load
+
+CFG = tiny(max_seq_len=64, eos_token_id=-1)
+GREEDY = dict(temperature=0.0, repeat_penalty=1.1)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(5))
+
+
+def _serve_stack(params, slo=None):
+    gen = BatchGenerator(CFG, params,
+                         settings=SamplerSettings(**GREEDY))
+    sched = Scheduler(gen, queue_depth=8, request_timeout_s=60, slo=slo)
+    sched.start(max_concurrent=2, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    return srv, sched
+
+
+def _mint_header():
+    """A client-side traceparent with a known trace id + root span."""
+    tid = os.urandom(16).hex()
+    root = os.urandom(8).hex()
+    return tid, root, f"00-{tid}-{root}-01"
+
+
+def _stream_ids(url, prompt_ids, max_tokens=6, headers=None):
+    req = urllib.request.Request(
+        url + "/v1/completions",
+        data=json.dumps({"prompt_ids": prompt_ids,
+                         "max_tokens": max_tokens,
+                         "stream": True}).encode(),
+        headers=dict({"Content-Type": "application/json"}, **(headers or {})))
+    ids = []
+    with urllib.request.urlopen(req, timeout=60) as r:
+        for raw in r:
+            raw = raw.strip()
+            if not raw.startswith(b"data: "):
+                continue
+            data = raw[len(b"data: "):]
+            if data == b"[DONE]":
+                break
+            ev = json.loads(data)
+            assert "error" not in ev, ev
+            if "token" in ev:
+                ids.append(ev["token"])
+    return ids
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _poll_timeline(key, want_names, deadline_s=10.0):
+    """The request log fills asynchronously (gateway finish, engine-side
+    finish); poll until the entry covers ``want_names``."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        tl = reqtrace.request_log().get(key)
+        if tl is not None and want_names <= {s["name"]
+                                             for s in tl["spans"]}:
+            return tl
+        time.sleep(0.05)
+    raise AssertionError(
+        f"timeline for {key!r} never covered {want_names}; "
+        f"last: {tl and [s['name'] for s in tl['spans']]}")
+
+
+def _assert_connected(tl, roots=()):
+    """Every span's parent is another span in the same timeline or one
+    of the known inbound roots — the one-connected-trace property."""
+    ids = {s["span"] for s in tl["spans"]}
+    for s in tl["spans"]:
+        parent = s.get("parent")
+        assert parent is None or parent in ids or parent in roots, \
+            f"span {s['name']} parented to unknown {parent}"
+
+
+# -- header parsing / minting ------------------------------------------------
+
+
+class TestHeader:
+    def test_mint_is_unique_and_wellformed(self):
+        a, b = reqtrace.ReqTrace.mint(), reqtrace.ReqTrace.mint()
+        assert a.trace_id != b.trace_id
+        assert len(a.trace_id) == 32 and int(a.trace_id, 16)
+        assert a.parent_id is None
+
+    def test_honors_wellformed_header(self):
+        tid, root, header = _mint_header()
+        ctx = reqtrace.ReqTrace.from_header(header)
+        assert ctx.trace_id == tid and ctx.parent_id == root
+
+    @pytest.mark.parametrize("bad", [
+        "junk", "00-zz-11-01", "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+        "00-" + "g" * 32 + "-" + "1" * 16 + "-01",
+    ])
+    def test_malformed_counts_error_and_mints(self, bad):
+        e0 = obs_metrics.counter("reqtrace.header_errors").value
+        ctx = reqtrace.ReqTrace.from_header(bad)
+        assert len(ctx.trace_id) == 32 and ctx.parent_id is None
+        assert obs_metrics.counter("reqtrace.header_errors").value == e0 + 1
+
+    def test_missing_header_mints_without_error(self):
+        e0 = obs_metrics.counter("reqtrace.header_errors").value
+        assert reqtrace.ReqTrace.from_header(None).trace_id
+        assert obs_metrics.counter("reqtrace.header_errors").value == e0
+
+    def test_outbound_header_roundtrips(self):
+        ctx = reqtrace.ReqTrace.mint()
+        sid = ctx.add_span("x", time.time(), 1.0)
+        hop = reqtrace.ReqTrace.from_header(ctx.header())
+        assert hop.trace_id == ctx.trace_id and hop.parent_id == sid
+
+
+# -- span recording ----------------------------------------------------------
+
+
+class TestSpans:
+    def test_nested_spans_parent_to_enclosing(self):
+        tid, root, header = _mint_header()
+        ctx = reqtrace.ReqTrace.from_header(header)
+        with ctx.span("outer"):
+            with ctx.span("inner"):
+                pass
+        inner, outer = ctx.spans()
+        assert inner["parent"] == outer["span"]
+        assert outer["parent"] == root
+        assert inner["ms"] >= 0 and inner["pid"] == os.getpid()
+
+    def test_failed_span_records_error_arg(self):
+        ctx = reqtrace.ReqTrace.mint()
+        with pytest.raises(RuntimeError):
+            with ctx.span("doomed", attempt=1):
+                raise RuntimeError("boom")
+        (s,) = ctx.spans()
+        assert s["args"] == {"attempt": 1, "error": "RuntimeError"}
+
+    def test_event_is_zero_duration(self):
+        ctx = reqtrace.ReqTrace.mint()
+        ctx.event("tick", k=1)
+        (s,) = ctx.spans()
+        assert s["ms"] == 0.0 and s["args"]["k"] == 1
+
+    def test_span_cap_bounds_memory(self):
+        ctx = reqtrace.ReqTrace.mint()
+        for i in range(reqtrace.MAX_SPANS + 16):
+            ctx.add_span("s", time.time(), 0.0)
+        assert len(ctx.spans()) == reqtrace.MAX_SPANS
+
+    def test_wire_roundtrip(self):
+        ctx = reqtrace.ReqTrace.mint()
+        ctx.request_id = "req-1"
+        sid = ctx.add_span("export", time.time(), 2.0)
+        hop = reqtrace.ReqTrace.from_wire(ctx.wire())
+        assert hop.trace_id == ctx.trace_id
+        assert hop.parent_id == sid and hop.request_id == "req-1"
+        assert reqtrace.ReqTrace.from_wire(None) is None
+        assert reqtrace.ReqTrace.from_wire({}) is None
+
+    def test_spans_mirror_into_tracer(self):
+        obs_trace.tracer().start(max_events=10_000)
+        try:
+            ctx = reqtrace.ReqTrace.mint()
+            with ctx.span("mirrored", leg=1):
+                pass
+            doc = obs_trace.tracer().to_chrome_trace()
+        finally:
+            obs_trace.tracer().stop()
+            obs_trace.tracer().clear()
+        evs = [e for e in doc["traceEvents"]
+               if e.get("name") == "mirrored"]
+        assert evs and evs[0]["args"]["trace"] == ctx.trace_id
+        assert evs[0]["args"]["span"] == ctx.spans()[0]["span"]
+
+
+# -- the request log ---------------------------------------------------------
+
+
+class TestRequestLog:
+    def test_merges_tier_halves_by_trace_id(self):
+        rlog = reqtrace.RequestLog(cap=8)
+        tid = os.urandom(16).hex()
+        pre = reqtrace.ReqTrace(tid)
+        pre.add_span("disagg.export", time.time() - 1.0, 3.0)
+        rlog.put(pre)
+        dec = reqtrace.ReqTrace(tid)
+        dec.request_id = "req-9"
+        dec.add_span("disagg.import", time.time(), 2.0)
+        rlog.put(dec)
+        rlog.put(pre)  # duplicate put: spans must not double
+        tl = rlog.get(tid)
+        assert [s["name"] for s in tl["spans"]] == \
+            ["disagg.export", "disagg.import"]  # sorted by start time
+        assert tl["request_id"] == "req-9"
+        assert rlog.get("req-9")["trace_id"] == tid  # alias
+        assert len(rlog) == 1
+
+    def test_unknown_key_is_none(self):
+        assert reqtrace.RequestLog(cap=2).get("nope") is None
+
+    def test_bounded_eviction(self):
+        rlog = reqtrace.RequestLog(cap=2)
+        ctxs = [reqtrace.ReqTrace(os.urandom(16).hex()) for _ in range(3)]
+        for c in ctxs:
+            c.event("x")
+            rlog.put(c)
+        assert len(rlog) == 2
+        assert rlog.get(ctxs[0].trace_id) is None
+        assert rlog.get(ctxs[2].trace_id) is not None
+
+
+# -- cross-tier stitching ----------------------------------------------------
+
+
+class TestStitch:
+    def test_foreign_spans_land_own_pid_spans_skipped(self):
+        tid = os.urandom(16).hex()
+        tl = {"trace_id": tid, "spans": [
+            {"name": "remote.leg", "span": "aa" * 8, "t": time.time(),
+             "ms": 2.0, "pid": os.getpid() + 99_999},
+            {"name": "local.leg", "span": "bb" * 8, "t": time.time(),
+             "ms": 1.0, "pid": os.getpid()},
+        ]}
+        obs_trace.tracer().start(max_events=10_000)
+        try:
+            assert reqtrace.stitch_timeline(tl, "b0@127.0.0.1:1") == 1
+            doc = obs_trace.tracer().to_chrome_trace()
+        finally:
+            obs_trace.tracer().stop()
+            obs_trace.tracer().clear()
+        names = [e["name"] for e in doc["traceEvents"]]
+        assert "remote.leg" in names and "local.leg" not in names
+        # the source became its own named process track
+        procs = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("name") == "process_name"]
+        assert "b0@127.0.0.1:1" in procs
+
+    def test_disabled_tracer_stitches_nothing(self):
+        tl = {"trace_id": "t", "spans": [
+            {"name": "x", "span": "cc" * 8, "t": time.time(), "ms": 1.0,
+             "pid": os.getpid() + 1}]}
+        assert reqtrace.stitch_timeline(tl, "src") == 0
+
+
+# -- SLO policy + burn accounting --------------------------------------------
+
+
+class TestSlo:
+    def test_verdict_judges_set_halves_only(self):
+        pol = reqtrace.SloPolicy(ttft_ms=100.0)
+        assert pol.verdict(50.0, 999.0)["good"]   # tpot untargeted
+        assert not pol.verdict(150.0, None)["good"]
+        both = reqtrace.SloPolicy(ttft_ms=100.0, tpot_ms=10.0)
+        v = both.verdict(50.0, 20.0)
+        assert not v["good"] and v["ttft_ok"] and not v["tpot_ok"]
+        # a missing measurement passes its half (no TPOT on a 1-token
+        # reply is not a miss)
+        assert both.verdict(50.0, None)["good"]
+        assert not reqtrace.SloPolicy().enabled and both.enabled
+
+    def test_tracker_counts_and_burn(self):
+        g0 = obs_metrics.counter("slo.good").value
+        b0 = obs_metrics.counter("slo.bad").value
+        t = reqtrace.SloTracker(
+            reqtrace.SloPolicy(ttft_ms=100.0, objective=0.5))
+        assert t.observe(10.0, None)["good"]
+        assert not t.observe(500.0, None)["good"]
+        assert obs_metrics.counter("slo.good").value == g0 + 1
+        assert obs_metrics.counter("slo.bad").value == b0 + 1
+        snap = t.snapshot()
+        # 1 bad of 2 in-window at a 0.5 error budget: burning exactly
+        # at the allowed rate
+        assert snap["window_n"] == 2 and snap["window_bad"] == 1
+        assert snap["burn_short"] == pytest.approx(1.0)
+        assert snap["burn_long"] == pytest.approx(1.0)
+        assert snap["ttft_target_ms"] == 100.0
+
+    def test_burn_zero_when_empty_or_all_good(self):
+        t = reqtrace.SloTracker(reqtrace.SloPolicy(tpot_ms=50.0))
+        assert t.snapshot()["burn_short"] == 0.0
+        t.observe(None, 10.0)
+        assert t.snapshot()["burn_short"] == 0.0
+
+
+# -- serve end to end --------------------------------------------------------
+
+
+SERVE_SPANS = {"serve.queue", "serve.admit", "engine.prefill",
+               "decode.first_token", "session.emit"}
+
+
+class TestServeTracing:
+    def test_traced_request_full_span_set(self, params):
+        slo = reqtrace.SloTracker(
+            reqtrace.SloPolicy(ttft_ms=60_000.0, tpot_ms=60_000.0))
+        srv, sched = _serve_stack(params, slo=slo)
+        obs_trace.tracer().start(max_events=100_000)
+        tid, root, header = _mint_header()
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            ids = _stream_ids(url, [1, 2, 3], max_tokens=6,
+                              headers={reqtrace.HEADER: header})
+            assert len(ids) == 6
+            tl = _poll_timeline(tid, SERVE_SPANS)
+            assert tl["trace_id"] == tid
+            _assert_connected(tl, roots={root})
+            # the SLO verdict rode the timeline; targets were loose
+            assert tl["slo"]["good"] and tl["slo"]["ttft_ok"]
+            # ... and the same timeline answers by request id
+            assert tl["request_id"]
+            _, by_req = _get_json(
+                f"{url}/v1/requests/{tl['request_id']}")
+            assert by_req["trace_id"] == tid
+            # /healthz carries the burn block
+            _, health = _get_json(f"{url}/healthz")
+            assert health["slo"]["window_n"] >= 1
+            assert health["slo"]["burn_short"] == 0.0
+            # every reqtrace span mirrored into the Perfetto export
+            doc = obs_trace.tracer().to_chrome_trace()
+            traced = {e["name"] for e in doc["traceEvents"]
+                      if e.get("args", {}).get("trace") == tid}
+            assert SERVE_SPANS <= traced
+            for e in doc["traceEvents"]:
+                if e.get("ph") == "X":
+                    assert {"name", "ts", "dur", "pid",
+                            "tid"} <= set(e)
+        finally:
+            obs_trace.tracer().stop()
+            obs_trace.tracer().clear()
+            srv.close()
+            sched.close()
+
+    def test_unknown_request_404s(self, params):
+        srv, sched = _serve_stack(params)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/requests/nope")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+            sched.close()
+
+    def test_tight_targets_burn_the_budget(self, params):
+        b0 = obs_metrics.counter("slo.bad").value
+        slo = reqtrace.SloTracker(reqtrace.SloPolicy(ttft_ms=0.001))
+        srv, sched = _serve_stack(params, slo=slo)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            for _ in range(2):
+                _stream_ids(url, [4, 5], max_tokens=3)
+            assert obs_metrics.counter("slo.bad").value >= b0 + 2
+            _, health = _get_json(f"{url}/healthz")
+            assert health["slo"]["burn_short"] > 1.0
+            assert health["slo"]["window_bad"] >= 2
+        finally:
+            srv.close()
+            sched.close()
+
+
+# -- loadgen goodput ---------------------------------------------------------
+
+
+class TestLoadgenGoodput:
+    def test_goodput_judges_targets(self, params):
+        srv, sched = _serve_stack(params)
+        try:
+            url = f"http://127.0.0.1:{srv.port}"
+            loose = run_load(url, 4, concurrency=2, max_tokens=4,
+                             slo_ttft_ms=60_000.0, slo_tpot_ms=60_000.0)
+            assert loose["completed"] == 4
+            assert loose["slo"]["goodput"] == 1.0
+            assert loose["slo"]["good"] == 4
+            tight = run_load(url, 4, concurrency=2, max_tokens=4,
+                             slo_ttft_ms=0.0001)
+            assert tight["slo"]["goodput"] == 0.0
+            plain = run_load(url, 2, concurrency=2, max_tokens=4)
+            assert "slo" not in plain
+        finally:
+            srv.close()
+            sched.close()
+
+    def test_cli_goodput_gate_needs_target(self, capsys):
+        from cake_tpu.tools.loadgen import main
+        with pytest.raises(SystemExit):
+            main(["http://127.0.0.1:1", "--slo-goodput-min", "0.9"])
+
+
+# -- cli wiring --------------------------------------------------------------
+
+
+class TestCliWiring:
+    def test_slo_flags_build_tracker_and_gate_modes(self):
+        from cake_tpu.cli import _serve_flags, _slo_tracker, build_parser
+        p = build_parser()
+        args = p.parse_args(["--model", "m", "--mode", "serve",
+                             "--slo-ttft-ms", "120",
+                             "--slo-tpot-ms", "15"])
+        assert {"--slo-ttft-ms", "--slo-tpot-ms"} <= set(
+            _serve_flags(args))
+        t = _slo_tracker(args)
+        assert t.policy.ttft_ms == 120.0 and t.policy.tpot_ms == 15.0
+        bare = p.parse_args(["--model", "m", "--mode", "serve"])
+        assert _slo_tracker(bare) is None
+        assert "--slo-ttft-ms" not in _serve_flags(bare)
