@@ -176,6 +176,20 @@ disagg-smoke:
 reqtrace-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_reqtrace.py -q -m 'not slow'
 
+# profiling smoke: the engine profiling plane (cake_tpu/obs/prof) —
+# prof-on vs prof-off bit-identical streams, the retrace sentinel
+# flagging a steady-state shape change (warn + CAKE_PROF_STRICT raise),
+# /debug/prof live on a serve replica, prof.* spans nested under
+# request spans in one trace file, and the benchdiff gate semantics.
+prof-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_prof.py -q -m 'not slow'
+
+# bench regression gate: newest bench_results.jsonl row per metric vs
+# the best prior run (tools/benchdiff) — nonzero exit past the
+# thresholds, so a perf regression fails CI the way a lint finding does.
+bench-diff:
+	$(PY) -m cake_tpu.tools.benchdiff
+
 # perf smoke (CPU, tier-1 `not slow` cases): the obs disabled-path
 # micro-bench and the wire-codec loopback — incl. the bf16 >=1.9x
 # bytes-per-decode-token acceptance — plus the obs on/off overhead row
@@ -186,11 +200,12 @@ reqtrace-smoke:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
 	  JAX_PLATFORMS=cpu $(PY) bench.py
+	$(PY) -m cake_tpu.tools.benchdiff
 
 # Deploy plane (reference Makefile:29-39 sync targets): push code +
 # per-worker bundles to every host in TOPOLOGY and optionally start
@@ -205,4 +220,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke bench-diff perf-smoke deploy clean

@@ -232,6 +232,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "p50/p99, RTT and clock offset (ping-estimated), "
                         "byte/op counters, straggler flags, plus the "
                         "master's own per-segment stats")
+    p.add_argument("--prof-sample", type=int, default=None,
+                   dest="prof_sample", metavar="N",
+                   help="engine profiling plane (cake_tpu/obs/prof): stamp "
+                        "a full per-phase step breakdown every Nth engine "
+                        "step (default 64 via CAKE_PROF_SAMPLE; 0 disables "
+                        "sampling entirely, 1 stamps every step). The "
+                        "report is served live at GET /debug/prof and "
+                        "folded into --trace timelines as prof.* spans")
     p.add_argument("--top", action="store_true",
                    help="master+topology runs: live ANSI cluster panel on "
                         "stderr while generating (per-worker p50/p99, RTT, "
@@ -1456,6 +1464,10 @@ def main(argv=None) -> int:
         # --profile already captures an XLA trace; passing spans through as
         # TraceAnnotations lines the two timelines up in one Perfetto view
         obs.tracer().start(xla_annotations=bool(args.profile))
+    if args.prof_sample is not None:
+        from cake_tpu.obs import prof as _prof
+
+        _prof.profiler().set_sample(args.prof_sample)
     if args.flight_log:
         try:
             obs.flight.recorder().enable(path=args.flight_log)

@@ -348,6 +348,48 @@ def _make_handler(server: GatewayServer):
             merged["spans"] = sorted(spans, key=lambda s: s["t"])
             return merged
 
+        def _fleet_prof(self) -> dict:
+            """The fleet's engine-profiling view: every routable
+            backend's ``/debug/prof`` body keyed by backend name, plus a
+            ``fleet`` rollup (compile/retrace sums and per-phase merged
+            count/p99 — the numbers a capacity question actually needs).
+            Best-effort per backend, same contract as the timeline
+            endpoint: an older replica without the route contributes
+            nothing."""
+            from cake_tpu.obs import prof as obs_prof
+
+            backends: dict = {}
+            fleet: dict = {"compiles": 0, "retraces": 0, "phases": {}}
+
+            def absorb(name: str, rep: dict | None) -> None:
+                if not isinstance(rep, dict):
+                    return
+                backends[name] = rep
+                fleet["compiles"] += int(rep.get("compiles") or 0)
+                fleet["retraces"] += int(rep.get("retraces") or 0)
+                for ph, snap in (rep.get("phases") or {}).items():
+                    agg = fleet["phases"].setdefault(
+                        ph, {"count": 0, "p99_max_ms": 0.0})
+                    agg["count"] += int(snap.get("count") or 0)
+                    agg["p99_max_ms"] = max(agg["p99_max_ms"],
+                                            float(snap.get("p99") or 0.0))
+
+            absorb("gateway", obs_prof.report())
+            for b in {b.addr: b for b in monitor.routable()}.values():
+                conn = http.client.HTTPConnection(
+                    b.host, b.port, timeout=server.connect_timeout)
+                try:
+                    conn.request("GET", "/debug/prof")
+                    resp = conn.getresponse()
+                    if resp.status == 200:
+                        absorb(f"{b.name}@{b.addr}",
+                               json.loads(resp.read()))
+                except (OSError, ValueError):
+                    pass
+                finally:
+                    conn.close()
+            return {"backends": backends, "fleet": fleet}
+
         def _relay(self, resp, data: bytes) -> None:
             """One whole (non-streaming) backend response to the client,
             status and relevant headers preserved."""
@@ -392,6 +434,10 @@ def _make_handler(server: GatewayServer):
                     self._error(404, f"unknown request {key}")
                 else:
                     self._json(200, tl)
+            elif path == "/debug/prof":
+                # fleet-merged engine profiling plane (the per-replica
+                # body lives on each backend's own /debug/prof)
+                self._json(200, self._fleet_prof())
             elif path in ("/", "/metrics"):
                 body, ctype = _statusd.status_response(server.status_fn,
                                                        path)

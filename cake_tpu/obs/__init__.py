@@ -11,6 +11,9 @@ when off, threaded through every layer of the runtime:
 - :mod:`cake_tpu.obs.flight` — bounded ring of per-token records
   (per-segment ms, wire bytes, serialize/sample ms, recoveries),
   appendable to JSONL.
+- :mod:`cake_tpu.obs.prof` — sampled engine-step phase breakdown,
+  runtime retrace sentinel (steady-state decode recompiles), and
+  device/host/kvpool memory watermarks (``GET /debug/prof``).
 
 Cluster scope (the cross-process tier on top of the three planes):
 
@@ -31,7 +34,7 @@ from __future__ import annotations
 
 import logging
 
-from cake_tpu.obs import clock, flight, metrics, reqtrace, trace  # noqa: F401
+from cake_tpu.obs import clock, flight, metrics, prof, reqtrace, trace  # noqa: F401
 from cake_tpu.obs.metrics import (  # noqa: F401
     counter,
     gauge,

@@ -94,6 +94,32 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "requests landed on their prefix-preferred replica"),
     "gateway.saturated": (
         COUNTER, "429s propagated because every UP backend was saturated"),
+    # -- engine profiling plane (cake_tpu/obs/prof) ----------------------
+    "prof.compiles": (
+        COUNTER, "XLA backend compiles observed process-wide "
+                 "(jax.monitoring duration events)"),
+    "prof.mem_device_bytes": (
+        GAUGE, "device memory live bytes (backends exposing "
+               "memory_stats; absent elsewhere)"),
+    "prof.mem_device_peak_bytes": (
+        GAUGE, "device memory high-water mark in bytes"),
+    "prof.mem_host_peak_bytes": (
+        GAUGE, "host process peak RSS (VmHWM)"),
+    "prof.mem_host_rss_bytes": (
+        GAUGE, "host process resident set size (VmRSS)"),
+    "prof.retraces": (
+        COUNTER, "steady-state decode-phase compiles — retrace findings "
+                 "(warn; raise under CAKE_PROF_STRICT=1)"),
+    "prof.sampled_steps": (
+        COUNTER, "engine steps that recorded a sampled phase breakdown"),
+    # -- speculative decoding acceptance (runtime/speculative) -----------
+    "spec.accept_rate_ema": (
+        GAUGE, "EMA of accepted-proposal fraction per round — the "
+               "adaptive-spec_k control signal"),
+    "spec.accepted": (
+        COUNTER, "draft proposals accepted by verification rounds"),
+    "spec.proposed": (
+        COUNTER, "draft tokens proposed to verification rounds"),
     # -- paged KV pool (cake_tpu/kvpool) ---------------------------------
     "kvpool.admit_defers": (
         COUNTER, "admissions deferred waiting for free pages"),
@@ -193,6 +219,10 @@ DYNAMIC: dict[str, tuple[str, str]] = {
         GAUGE, "per-segment first-call compile+prefill"),
     "cluster.*.*": (
         GAUGE, "per-worker merged health/traffic fields (ClusterScraper)"),
+    "prof.phase_ms.*": (
+        HISTOGRAM, "per-phase wall ms inside sampled engine steps "
+                   "(admit/pages/guide/dispatch/sync/emit/idle_park and "
+                   "the spec_* phases — obs/prof.PHASES)"),
 }
 
 

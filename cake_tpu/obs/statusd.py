@@ -33,9 +33,18 @@ def status_response(status_fn, path: str) -> tuple[bytes, str]:
     ``metrics``). The ONE place the bytes are built — every server that
     exposes the surface (``start_status_server`` here, ``serve.api``'s
     mounted routes) calls this, so their output stays byte-identical."""
-    if path.rstrip("/") == "/metrics":
+    path = path.rstrip("/")
+    if path == "/metrics":
         return (_metrics.registry().to_prometheus().encode(),
                 "text/plain; version=0.0.4")
+    if path == "/debug/prof":
+        # engine profiling plane (obs/prof): phase percentiles, compile/
+        # retrace counts, memory watermarks — same body on every surface
+        # that mounts this handler (worker statusd, serve API port)
+        from cake_tpu.obs import prof as _prof
+
+        return (json.dumps(_prof.report(), indent=1).encode(),
+                "application/json")
     return json.dumps(status_fn(), indent=1).encode(), "application/json"
 
 

@@ -60,6 +60,51 @@ def render(report: dict) -> str:
         )
     if report.get("stragglers"):
         lines.append("stragglers: " + ", ".join(report["stragglers"]))
+    # a report carrying serving-plane data (loopback fleets, co-located
+    # replicas) gets the replica panel appended under the cluster table
+    if report.get("replica"):
+        lines.append("")
+        lines.append(render_replica(report["replica"], report.get("prof")))
+    return "\n".join(lines)
+
+
+_PHASE_HDR = (f"{'PHASE':<14} {'count':>8} {'mean ms':>9} {'p50 ms':>9} "
+              f"{'p99 ms':>9}")
+
+
+def render_replica(status: dict, prof: dict | None = None) -> str:
+    """One serve replica's panel: queue depth / SLO burn header, kvpool
+    page line, and the engine phase breakdown (``/debug/prof`` body) —
+    the step-loop time budget at a glance."""
+    slo = status.get("slo") or {}
+    lines = [
+        "serve — "
+        f"queued {status.get('queued', 0)}"
+        f"/{status.get('queue_depth', '-')} "
+        f"running {status.get('running', 0)} "
+        f"tok/s {status.get('observed_tok_s') or '-'} "
+        f"slo burn {_fmt(slo.get('burn_short'))}"
+        f"/{_fmt(slo.get('burn_long'))}"
+    ]
+    prof = prof or {}
+    kv = (prof.get("memory") or {}).get("kvpool") or {}
+    if kv:
+        lines.append(
+            f"kvpool — free {kv.get('pages_free', '-')} "
+            f"shared {kv.get('pages_shared', '-')} "
+            f"pinned {kv.get('pages_pinned', '-')}")
+    phases = prof.get("phases") or {}
+    if phases:
+        lines.append(_PHASE_HDR)
+        for name, h in phases.items():
+            lines.append(
+                f"{name:<14} {h.get('count', 0):>8} "
+                f"{_fmt(h.get('mean')):>9} {_fmt(h.get('p50')):>9} "
+                f"{_fmt(h.get('p99')):>9}")
+    if prof.get("retraces"):
+        lines.append(f"RETRACES: {prof['retraces']} "
+                     f"(compiles {prof.get('compiles')}) — steady-state "
+                     "decode recompiled; see /debug/prof findings")
     return "\n".join(lines)
 
 

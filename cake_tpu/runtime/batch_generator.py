@@ -84,6 +84,7 @@ from cake_tpu.kvpool import pool as kvpool_pool
 from cake_tpu.models.config import LlamaConfig
 from cake_tpu.obs import flight as obs_flight
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import prof as obs_prof
 from cake_tpu.obs.trace import span
 from cake_tpu.ops import quant, sampling
 from cake_tpu.ops.sampling import SamplerSettings
@@ -516,6 +517,11 @@ class BatchGenerator:
         self._emitted_ctr = obs_metrics.Counter("serve.tokens_emitted")
         obs_metrics.registry().publish(
             self._dispatch_hist, self._admit_hist, self._emitted_ctr)
+        # engine profiling plane (obs/prof): sampled step-phase stamps +
+        # the runtime retrace sentinel watching this engine's dispatches
+        self._prof = obs_prof.profiler()
+        self._sentinel = obs_prof.sentinel()
+        self._sentinel.install()
 
     @property
     def _prefill_offset(self):
@@ -780,16 +786,19 @@ class BatchGenerator:
         g = self._guides.get(slot)
         if g is None:
             return
-        if s.done:
-            self._drop_guide(slot)
-            return
-        if not g.advance(tok_id) or g.dead_end:
-            from cake_tpu.constrain.guide import DEAD_ENDS
+        # "guide" nests inside "emit" — sub-phase attribution, not
+        # additional step time (obs/prof module doc)
+        with self._prof.phase("guide"):
+            if s.done:
+                self._drop_guide(slot)
+                return
+            if not g.advance(tok_id) or g.dead_end:
+                from cake_tpu.constrain.guide import DEAD_ENDS
 
-            s.done = True
-            s.end_reason = "constraint"
-            self._drop_guide(slot)
-            DEAD_ENDS.inc()
+                s.done = True
+                s.end_reason = "constraint"
+                self._drop_guide(slot)
+                DEAD_ENDS.inc()
 
     def warm_constrain(self) -> None:
         """Compile the masked decode program against the live batch
@@ -1178,11 +1187,13 @@ class BatchGenerator:
         tiny [B, W] scatter-id vector is genuinely per-dispatch."""
         if not self._paged:
             return ()
-        self._ensure_pages(size)
-        if self._page_map_dev is None:
-            self._page_map_dev = jnp.asarray(self._page_map_np())
-        return (self._page_map_dev,
-                jnp.asarray(self._scatter_ids_np(size)))
+        # "pages" nests inside "dispatch" (host prep on the dispatch path)
+        with self._prof.phase("pages"):
+            self._ensure_pages(size)
+            if self._page_map_dev is None:
+                self._page_map_dev = jnp.asarray(self._page_map_np())
+            return (self._page_map_dev,
+                    jnp.asarray(self._scatter_ids_np(size)))
 
     def _paged_args_warm(self, size: int) -> tuple:
         """Warm-path variant: current page map, all-sink write-back (the
@@ -2184,32 +2195,35 @@ class BatchGenerator:
         the one host-side step per token the no-retrace design needs."""
         lpv, lpi = lp if lp is not None else (None, None)
         out: list[Token | None] = []
-        for i, s in enumerate(self.streams):
-            if not s.active or s.done or (skip is not None and skip[i]):
-                out.append(None)
-                continue
-            tok_id = int(row[i])
-            s.generated.append(tok_id)
-            window_full = len(s.prompt) + len(s.generated) >= self.max_seq
-            is_eos = tok_id in self._eos_ids
-            s.done = is_eos or window_full
-            if s.done:
-                s.end_reason = "eos" if is_eos else "length"
-            self._advance_guide(i, s, tok_id)
-            if s.done and self._paged:
-                # EOS/window/constraint retirement frees the pages here —
-                # the slot is admissible the moment the row is emitted
-                self._release_pages(i)
-            # the EOS id is an end marker, not text: detokenizing it would
-            # append its (toy tokenizers: arbitrary) surface form
-            text = (s.detok.next_token(tok_id)
-                    if s.detok is not None and not is_eos else None)
-            lp_i = None
-            if lpv is not None:
-                lp_i = [(int(lpi[i, j]), float(lpv[i, j]))
-                        for j in range(lpi.shape[1])]
-            out.append(Token(id=tok_id, text=text, is_end_of_stream=s.done,
-                             logprobs=lp_i))
+        with self._prof.phase("emit"):
+            for i, s in enumerate(self.streams):
+                if not s.active or s.done or (skip is not None and skip[i]):
+                    out.append(None)
+                    continue
+                tok_id = int(row[i])
+                s.generated.append(tok_id)
+                window_full = (len(s.prompt) + len(s.generated)
+                               >= self.max_seq)
+                is_eos = tok_id in self._eos_ids
+                s.done = is_eos or window_full
+                if s.done:
+                    s.end_reason = "eos" if is_eos else "length"
+                self._advance_guide(i, s, tok_id)
+                if s.done and self._paged:
+                    # EOS/window/constraint retirement frees the pages
+                    # here — the slot is admissible the moment the row
+                    # is emitted
+                    self._release_pages(i)
+                # the EOS id is an end marker, not text: detokenizing it
+                # would append its (toy tokenizers: arbitrary) surface form
+                text = (s.detok.next_token(tok_id)
+                        if s.detok is not None and not is_eos else None)
+                lp_i = None
+                if lpv is not None:
+                    lp_i = [(int(lpi[i, j]), float(lpv[i, j]))
+                            for j in range(lpi.shape[1])]
+                out.append(Token(id=tok_id, text=text,
+                                 is_end_of_stream=s.done, logprobs=lp_i))
         emitted = sum(1 for t in out if t is not None)
         self._n_emitted += emitted
         self._emitted_ctr.inc(emitted)
@@ -2228,20 +2242,30 @@ class BatchGenerator:
         self._domain_stamp.check("BatchGenerator.step")
         if not self.streams:
             raise RuntimeError("set_prompts first")
-        if not self._emitted_first:
-            self._emitted_first = True
-            # skip streams that already recorded tokens — a stream admit()ed
-            # into a dummy slot before the first step() had its first token
-            # returned by admit(), and must not be double-recorded here
-            return self._emit(
-                self._host(self._last_tokens),
-                skip=[bool(s.generated) for s in self.streams],
-                lp=self._first_lp,
-            )
-        self._admission_tick()
-        if self._pending_rows:
-            return self._pending_rows.pop(0)
-        return self._step_decode()
+        prof = self._prof
+        prof.step_begin("batch")
+        try:
+            if not self._emitted_first:
+                self._emitted_first = True
+                # skip streams that already recorded tokens — a stream
+                # admit()ed into a dummy slot before the first step() had
+                # its first token returned by admit(), and must not be
+                # double-recorded here
+                return self._emit(
+                    self._host(self._last_tokens),
+                    skip=[bool(s.generated) for s in self.streams],
+                    lp=self._first_lp,
+                )
+            if self._staging is not None or self._arrivals:
+                # stamp only real admission work, or an idle batch would
+                # flood the admit histogram with ~0 ms no-op ticks
+                with prof.phase("admit"):
+                    self._admission_tick()
+            if self._pending_rows:
+                return self._pending_rows.pop(0)
+            return self._step_decode()
+        finally:
+            prof.step_end()
 
     def _spec_emit_or_round(self):
         """Drain the per-stream accepted-token banks one row per call;
@@ -2287,10 +2311,12 @@ class BatchGenerator:
         b = len(self.streams)
         k = self._spec_k
         props = np.full((b, k), -1, np.int32)
-        for i in live:
-            s = self.streams[i]
-            pr = ngram_propose(s.prompt + s.generated, self._spec_ngram, k)
-            props[i, : len(pr)] = pr
+        with self._prof.phase("spec_propose"):
+            for i in live:
+                s = self.streams[i]
+                pr = ngram_propose(s.prompt + s.generated,
+                                   self._spec_ngram, k)
+                props[i, : len(pr)] = pr
         if self.settings.greedy and (props < 0).all():
             return None
         self._spec_round(live, props)
@@ -2303,25 +2329,30 @@ class BatchGenerator:
         fed[:, 0] = self._host(self._last_tokens)
         fed[:, 1:] = np.maximum(props, 0)  # -1 pads embed as 0; never match
         t0 = time.perf_counter()
-        logits, self.cache = self._pick_verify()(
-            self.params, jnp.asarray(fed), self.cache,
-            jnp.asarray(self._pos),
-        )
-        if self.settings.greedy:
-            toks, count, self._history, self._hist_slot = self._accept_rows(
-                logits, jnp.asarray(props), self._history, self._hist_slot)
-        else:
-            # per-row round keys in their own fold domain (0x5bec), keyed
-            # by the row's position — unique per round, disjoint from the
-            # plain per-token-index sampling schedule
-            rkeys = jax.vmap(lambda kk, p: jax.random.fold_in(
-                jax.random.fold_in(kk, 0x5BEC), p))(
-                    self._keys, jnp.asarray(self._pos))
-            toks, count, self._history, self._hist_slot = self._accept_rows(
-                logits, jnp.asarray(props), self._history, self._hist_slot,
-                round_keys=rkeys)
-        toks = self._host(toks)
-        count = self._host(count)
+        with self._prof.phase("spec_verify"), self._sentinel.decode_phase():
+            logits, self.cache = self._pick_verify()(
+                self.params, jnp.asarray(fed), self.cache,
+                jnp.asarray(self._pos),
+            )
+        with self._prof.phase("spec_accept"), self._sentinel.decode_phase():
+            if self.settings.greedy:
+                (toks, count, self._history,
+                 self._hist_slot) = self._accept_rows(
+                    logits, jnp.asarray(props), self._history,
+                    self._hist_slot)
+            else:
+                # per-row round keys in their own fold domain (0x5bec),
+                # keyed by the row's position — unique per round, disjoint
+                # from the plain per-token-index sampling schedule
+                rkeys = jax.vmap(lambda kk, p: jax.random.fold_in(
+                    jax.random.fold_in(kk, 0x5BEC), p))(
+                        self._keys, jnp.asarray(self._pos))
+                (toks, count, self._history,
+                 self._hist_slot) = self._accept_rows(
+                    logits, jnp.asarray(props), self._history,
+                    self._hist_slot, round_keys=rkeys)
+            toks = self._host(toks)
+            count = self._host(count)
         self._n_decode_dispatches += 1
         self._n_spec_dispatches += 1
         self._busy_s += time.perf_counter() - t0
@@ -2330,6 +2361,11 @@ class BatchGenerator:
         # non-live rows advance exactly one slot (parity with the plain
         # path's clamped discarded writes); live rows bank their run
         n = np.where(live_mask, np.maximum(count, 1), 1)
+        from cake_tpu.runtime import speculative as _spec_obs
+
+        _spec_obs.record_acceptance(
+            int((props[live] >= 0).sum()),
+            int(sum(max(0, int(n[i]) - 1) for i in live)))
         for i in live:
             self._spec_bank[i] = toks[i, : n[i]].tolist()
         self._pos = np.asarray(self._pos) + n
@@ -2444,29 +2480,41 @@ class BatchGenerator:
         last = self._last_tokens
         verify = self._pick_verify()
         toks_rounds, n_rounds = [], []
-        for _ in range(self._spec_rounds):
-            props, fed = self._spec_propose(ctx, pos, last)
-            logits, self.cache = verify(self.params, fed, self.cache, pos)
-            (toks, n, ctx, pos, self._history, self._hist_slot, done,
-             last) = self._spec_update(
-                logits, props, ctx, pos, self._history, self._hist_slot,
-                done, last, self._keys)
-            toks_rounds.append(toks)
-            n_rounds.append(n)
+        with self._prof.phase("spec_verify"), self._sentinel.decode_phase():
+            for _ in range(self._spec_rounds):
+                props, fed = self._spec_propose(ctx, pos, last)
+                logits, self.cache = verify(
+                    self.params, fed, self.cache, pos)
+                (toks, n, ctx, pos, self._history, self._hist_slot, done,
+                 last) = self._spec_update(
+                    logits, props, ctx, pos, self._history, self._hist_slot,
+                    done, last, self._keys)
+                toks_rounds.append(toks)
+                n_rounds.append(n)
         # one combined fetch — two sequential _host calls would pay a
         # second tunnel round trip, the very latency the chain amortizes
         # (cross-process dp still takes the allgather path per array)
-        try:
-            toks_all, n_all = jax.device_get(
-                (jnp.stack(toks_rounds), jnp.stack(n_rounds))
-            )  # [R, B, K+1], [R, B]
-        except RuntimeError:
-            toks_all = self._host(jnp.stack(toks_rounds))
-            n_all = self._host(jnp.stack(n_rounds))
+        with self._prof.phase("spec_accept"):
+            try:
+                toks_all, n_all = jax.device_get(
+                    (jnp.stack(toks_rounds), jnp.stack(n_rounds))
+                )  # [R, B, K+1], [R, B]
+            except RuntimeError:
+                toks_all = self._host(jnp.stack(toks_rounds))
+                n_all = self._host(jnp.stack(n_rounds))
         self._n_decode_dispatches += self._spec_rounds
         self._n_spec_dispatches += self._spec_rounds
         self._n_spec_chains += 1
         self._busy_s += time.perf_counter() - t0
+        from cake_tpu.runtime import speculative as _spec_obs
+
+        # device proposer — actual per-row proposal lengths never reach the
+        # host, so proposed is the K×rows×rounds upper bound (accept_rate is
+        # a lower bound on the chain path, exact on the per-round path)
+        _spec_obs.record_acceptance(
+            self._spec_k * len(live) * n_all.shape[0],
+            int(sum(max(0, int(n_all[r, i]) - 1)
+                    for r in range(n_all.shape[0]) for i in live)))
         for i in live:
             self._spec_bank[i] = [
                 int(t)
@@ -2631,7 +2679,8 @@ class BatchGenerator:
         top-k logprob rows when enabled) return UN-fetched so the caller
         chooses when to pay the host round-trip (the lookahead path
         dispatches the next block first)."""
-        with span("decode.dispatch", steps=size, batch=len(self.streams)):
+        with span("decode.dispatch", steps=size, batch=len(self.streams)), \
+                self._prof.phase("dispatch"), self._sentinel.decode_phase():
             out = self._block_prog(size)(
                 self.params, self._last_tokens, self.cache,
                 jnp.asarray(self._pos), self._keys, self._history,
@@ -2710,9 +2759,10 @@ class BatchGenerator:
                 )
                 if nsize > 1:
                     self._inflight = self._dispatch_block(nsize) + (nsize,)
-            rows = self._host(toks)  # [steps, B]
-            lp_h = ((self._host(lpv), self._host(lpi))
-                    if lpv is not None else None)
+            with self._prof.phase("sync"):
+                rows = self._host(toks)  # [steps, B]
+                lp_h = ((self._host(lpv), self._host(lpi))
+                        if lpv is not None else None)
             dt = time.perf_counter() - t0
             self._busy_s += dt
             # per-token ms so the series is comparable across block sizes
@@ -2739,17 +2789,19 @@ class BatchGenerator:
                 jnp.asarray(self._pos), self._keys, self._history,
                 self._hist_slot, jnp.asarray(self._index),
             )
-            if constrained:
-                # gather-and-mask runs inside this compiled program; the
-                # per-slot row vector is the only per-step upload
-                out = self._decode_single_masked(
-                    *args, self._mask_table,
-                    jnp.asarray(self._mask_rows_np()),
-                    *self._paged_args(1),
-                )
-            else:
-                out = self._pick_decode(block=False)(
-                    *args, *self._paged_args(1))
+            with self._prof.phase("dispatch"), \
+                    self._sentinel.decode_phase():
+                if constrained:
+                    # gather-and-mask runs inside this compiled program;
+                    # the per-slot row vector is the only per-step upload
+                    out = self._decode_single_masked(
+                        *args, self._mask_table,
+                        jnp.asarray(self._mask_rows_np()),
+                        *self._paged_args(1),
+                    )
+                else:
+                    out = self._pick_decode(block=False)(
+                        *args, *self._paged_args(1))
             if self.logprobs_k:
                 (tok, self.cache, self._history, self._hist_slot,
                  lpv_d, lpi_d) = out
@@ -2757,9 +2809,10 @@ class BatchGenerator:
                 tok, self.cache, self._history, self._hist_slot = out
                 lpv_d = lpi_d = None
             # sync: dispatch is async, busy_s needs compute
-            row = self._host(tok)
-            lp_h = ((self._host(lpv_d), self._host(lpi_d))
-                    if lpv_d is not None else None)
+            with self._prof.phase("sync"):
+                row = self._host(tok)
+                lp_h = ((self._host(lpv_d), self._host(lpi_d))
+                        if lpv_d is not None else None)
         self._n_decode_dispatches += 1
         dt = time.perf_counter() - t0
         self._busy_s += dt

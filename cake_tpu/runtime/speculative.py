@@ -27,6 +27,7 @@ different but identically-distributed stream than plain decode).
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 from functools import partial
 
@@ -36,6 +37,7 @@ import numpy as np
 
 from cake_tpu.models import llama
 from cake_tpu.models.config import LlamaConfig
+from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.ops import quant, sampling
 from cake_tpu.ops.kvcache import KVCache
 from cake_tpu.ops.norms import rms_norm
@@ -43,6 +45,33 @@ from cake_tpu.ops.rope import rope_tables
 from cake_tpu.ops.sampling import SamplerSettings
 from cake_tpu.runtime.generator import LlamaGenerator
 from cake_tpu.runtime.mesh_generator import MeshGenerator
+
+# process-wide acceptance accounting: every speculative path (the host
+# per-round loop, the fused chain, the single-stream mixin) reports its
+# proposal/acceptance totals here, so one pair of counters and one EMA
+# gauge describe speculation quality regardless of which engine ran it.
+_ACCEPT_EMA_ALPHA = 0.2
+_accept_lock = threading.Lock()
+_accept_ema: float | None = None
+
+
+def record_acceptance(proposed: int, accepted: int) -> None:
+    """Fold one dispatch's speculation outcome into the process counters:
+    ``spec.proposed`` / ``spec.accepted`` plus the ``spec.accept_rate_ema``
+    gauge (EMA over dispatches, not tokens — a smoothed answer to "is
+    speculation paying for itself right now"). No-op when nothing was
+    proposed, so pure-fallback steps don't drag the EMA toward zero."""
+    global _accept_ema
+    if proposed <= 0:
+        return
+    obs_metrics.counter("spec.proposed").inc(int(proposed))
+    obs_metrics.counter("spec.accepted").inc(int(accepted))
+    rate = min(1.0, max(0.0, accepted / proposed))
+    with _accept_lock:
+        _accept_ema = (rate if _accept_ema is None else
+                       _ACCEPT_EMA_ALPHA * rate
+                       + (1.0 - _ACCEPT_EMA_ALPHA) * _accept_ema)
+        obs_metrics.gauge("spec.accept_rate_ema").set(_accept_ema)
 
 
 def ngram_propose(context: list[int], n_max: int, k: int) -> list[int]:
@@ -485,6 +514,11 @@ class SpeculativeMixin:
         self.dispatches += 1
         self.rounds += int((counts_np > 0).sum())
         self.emitted += len(emitted)
+        # device proposer: per-round proposal lengths stay on device, so
+        # proposed is the K-per-live-round upper bound (see batch chain)
+        record_acceptance(
+            self.spec_k * int((counts_np > 0).sum()),
+            int(np.maximum(counts_np - 1, 0).sum()))
         self._pos += len(emitted)
         self._ctx_synced_pos = self._pos
         self._block_buf = deque(emitted[1:])
@@ -543,6 +577,7 @@ class SpeculativeMixin:
         self.dispatches += 1
         self.rounds += 1
         self.emitted += n
+        record_acceptance(len(proposal), n - 1)
         # cache holds KV for the fed tokens at pos..pos+K; the accepted
         # region pos..pos+n-1 is [last, g_0..g_{n-2}] — correct by the
         # match condition. The next round feeds g_{n-1} at pos+n.

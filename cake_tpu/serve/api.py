@@ -344,9 +344,10 @@ def _make_handler(server: ApiServer):
                     "max_concurrent": scheduler.max_concurrent,
                     "tokenizer": eng.tokenizer is not None,
                 }]})
-            elif path in ("/", "/metrics"):
+            elif path in ("/", "/metrics", "/debug/prof"):
                 # byte-identical with a standalone statusd page: both
-                # build through obs.statusd.status_response
+                # build through obs.statusd.status_response (which also
+                # serves the engine profiling report at /debug/prof)
                 body, ctype = _statusd.status_response(server.status_fn,
                                                        path)
                 self.send_response(200)

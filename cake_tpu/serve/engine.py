@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import time
 
+from cake_tpu.obs import prof as obs_prof
 from cake_tpu.runtime.generator import Token, encode_prompt
 from cake_tpu.utils.token_stream import TokenOutputStream
 
@@ -65,6 +66,11 @@ class SingleStreamEngine:
         self._index = 0
         self._n_emitted = 0
         self._t_start = time.perf_counter()
+        # engine profiling plane (obs/prof) — same phase names as the
+        # batched engine so /debug/prof reads identically on either path
+        self._prof = obs_prof.profiler()
+        self._sentinel = obs_prof.sentinel()
+        self._sentinel.install()
 
     # -- BatchGenerator API subset -------------------------------------------
     @property
@@ -106,31 +112,43 @@ class SingleStreamEngine:
         the slot is free (its prefill runs inside the wrapped generator's
         ``set_prompt``/first ``next_token``, which also resets the
         generator's KV state — retirement IS the KV free here too)."""
-        s = self.streams[0]
-        if s.done and self._arrivals:
-            ids, sid, guide = self._arrivals.pop(0)
-            self.gen.set_prompt(ids)
-            self.gen.set_guide(guide)
-            s = _Slot(stream_id=sid, prompt=ids, detok=self.gen.stream)
-            self.streams[0] = s
-            self._index = 0
-        if s.done:
-            return [None]
-        tok = self.gen.next_token(self._index)
-        self._index += 1
-        s.generated.append(tok.id)
-        window_full = len(s.prompt) + len(s.generated) >= self.max_seq
-        s.done = tok.is_end_of_stream or window_full
-        if s.done:
-            if getattr(self.gen, "guide_dead", False):
-                s.end_reason = "constraint"
-            elif tok.id in self._eos_ids:
-                s.end_reason = "eos"
-            else:
-                s.end_reason = "length"
-        self._n_emitted += 1
-        return [Token(id=tok.id, text=tok.text,
-                      is_end_of_stream=s.done)]
+        prof = self._prof
+        prof.step_begin("single")
+        try:
+            s = self.streams[0]
+            if s.done and self._arrivals:
+                with prof.phase("admit"):
+                    ids, sid, guide = self._arrivals.pop(0)
+                    self.gen.set_prompt(ids)
+                    self.gen.set_guide(guide)
+                    s = _Slot(stream_id=sid, prompt=ids,
+                              detok=self.gen.stream)
+                    self.streams[0] = s
+                    self._index = 0
+            if s.done:
+                return [None]
+            # next_token dispatches AND syncs (the wrapped generators fetch
+            # the token host-side) — one phase prices the whole round trip
+            with prof.phase("dispatch"), self._sentinel.decode_phase():
+                tok = self.gen.next_token(self._index)
+            with prof.phase("emit"):
+                self._index += 1
+                s.generated.append(tok.id)
+                window_full = (len(s.prompt) + len(s.generated)
+                               >= self.max_seq)
+                s.done = tok.is_end_of_stream or window_full
+                if s.done:
+                    if getattr(self.gen, "guide_dead", False):
+                        s.end_reason = "constraint"
+                    elif tok.id in self._eos_ids:
+                        s.end_reason = "eos"
+                    else:
+                        s.end_reason = "length"
+                self._n_emitted += 1
+                return [Token(id=tok.id, text=tok.text,
+                              is_end_of_stream=s.done)]
+        finally:
+            prof.step_end()
 
     def drain(self) -> None:
         pass  # single-step path: nothing buffered device-side

@@ -28,12 +28,14 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import queue
 import threading
 import time
 from collections import deque
 
 from cake_tpu.obs import metrics as obs_metrics
+from cake_tpu.obs import prof as obs_prof
 from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.serve import session as _session
 from cake_tpu.serve.session import Session
@@ -469,13 +471,23 @@ class Scheduler:
                 stamp.clear()
 
     def _run_loop(self) -> None:
+        # retrace-sentinel warmup budget: after this many engine passes the
+        # compile set is assumed stable, and further decode-phase compiles
+        # are retrace findings (obs/prof). Explicitly tunable — chained
+        # block-size buckets legitimately compile late on some deployments.
+        warm_steps = int(os.environ.get("CAKE_PROF_WARM_STEPS", "32"))
+        steps = 0
         while True:
             with self._cond:
                 self._expire_queued_locked()
                 while not self._stopping and not self._has_work_locked():
                     if self._draining:
                         break  # drained dry: park
+                    t_park = time.perf_counter()
                     self._cond.wait(timeout=0.1)
+                    obs_prof.profiler().observe_ms(
+                        "idle_park",
+                        (time.perf_counter() - t_park) * 1e3)
                     self._expire_queued_locked()
                     # imports awaiting resume are not "work" (nothing to
                     # step), but their TTL must still tick while parked —
@@ -492,6 +504,9 @@ class Scheduler:
                 self._sweep_imports()
                 self._admit()
                 row = self.engine.step()
+                steps += 1
+                if steps == warm_steps:
+                    obs_prof.sentinel().mark_steady()
                 self._deliver(row)
                 self._retire()
                 self._fail_lost_attaches()

@@ -83,3 +83,28 @@ def test_disabled_registry_instruments_are_noops():
 
     per_call = _best_per_call(loop)
     assert per_call < 10e-6, f"null instrument cost {per_call * 1e6:.2f}us"
+
+
+def test_unsampled_prof_step_is_near_zero():
+    """The step-phase profiler between samples: `step_begin` pays one
+    integer increment, each `phase()` site one attribute check returning
+    the shared null context, `step_end` one attribute read — the whole
+    unsampled step must stay in the same near-zero class as a disabled
+    span (the <= 3% obs budget rides on this)."""
+    from cake_tpu.obs import prof
+
+    p = prof.StepProfiler(sample_every=10_000_000)
+
+    def loop(n):
+        for _ in range(n):
+            p.step_begin()
+            with p.phase("dispatch"):
+                pass
+            with p.phase("sync"):
+                pass
+            with p.phase("emit"):
+                pass
+            p.step_end()
+
+    per_call = _best_per_call(loop)
+    assert per_call < 10e-6, f"unsampled prof step {per_call * 1e6:.2f}us"

@@ -1,0 +1,325 @@
+"""The engine profiling plane (cake_tpu/obs/prof).
+
+`make prof-smoke` acceptance: profiling never changes the stream (prof-on
+vs prof-off streams bit-identical), a sampled step records the per-phase
+breakdown plus the recent-step ring, the retrace sentinel counts backend
+compiles and flags exactly the steady-state decode-phase ones (warn by
+default, raise under CAKE_PROF_STRICT=1), /debug/prof answers live on a
+serve replica, a --trace run nests prof.* phase spans under the request
+spans in one timeline, and the benchdiff gate exits nonzero exactly on a
+regressed ledger.
+"""
+
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from cake_tpu.obs import prof
+from cake_tpu.ops.sampling import SamplerSettings
+from cake_tpu.runtime.batch_generator import BatchGenerator
+from cake_tpu.serve.api import start_api_server
+from cake_tpu.serve.scheduler import Scheduler
+
+# eos disabled (-1 never sampled): stream lengths are deterministic
+CFG = tiny(max_seq_len=64, eos_token_id=-1)
+GREEDY = dict(temperature=0.0, repeat_penalty=1.1)
+
+
+class _FakeTok:
+    def decode(self, ids):
+        return "".join(chr(ord("a") + (i % 26)) for i in ids)
+
+    def encode(self, text):
+        return [ord(c) - ord("a") for c in text]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(CFG, jax.random.PRNGKey(7))
+
+
+@pytest.fixture
+def prof_env():
+    """Save/restore the process-singleton profiler + sentinel around each
+    test (sampling stride is a global knob; findings/steady are global
+    state the next suite must not inherit)."""
+    p, s = prof.profiler(), prof.sentinel()
+    prev = p.sample_every
+    yield
+    p.set_sample(prev)
+    p.reset()
+    s.reset()
+
+
+def _collect(gen, prompt, sid, steps):
+    # prime like the scheduler does: a live batch of retired slots, so
+    # enqueue rides the continuous-admission path
+    gen.set_prompts([[0], [0]])
+    for s in gen.streams:
+        s.done = True
+    gen.enqueue(prompt, sid)
+    out = []
+    for _ in range(steps):
+        for t in gen.step():
+            if t is not None:
+                out.append(t.id)
+    return out
+
+
+# -- step-phase profiler ------------------------------------------------------
+
+def test_prof_on_off_streams_bit_identical(params, prof_env):
+    """Sampling every step must not perturb the emitted stream — the
+    profiler reads clocks, it never touches engine state."""
+    prompt = [3, 1, 4, 1, 5, 9]
+
+    prof.profiler().set_sample(0)
+    g_off = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                           settings=SamplerSettings(**GREEDY))
+    ids_off = _collect(g_off, prompt, sid=1, steps=20)
+
+    prof.profiler().set_sample(1)
+    g_on = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                          settings=SamplerSettings(**GREEDY))
+    ids_on = _collect(g_on, prompt, sid=1, steps=20)
+
+    assert ids_off and ids_off == ids_on
+
+
+def test_sampled_step_records_phases_and_ring(params, prof_env):
+    prof.profiler().reset()
+    prof.profiler().set_sample(1)
+    gen = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                         settings=SamplerSettings(**GREEDY))
+    _collect(gen, [2, 7, 1, 8], sid=1, steps=12)
+
+    rep = prof.report()
+    assert rep["sample_every"] == 1
+    assert rep["sampled_steps"] >= 12
+    # the decode hot path stamps these on every sampled pass
+    for name in ("dispatch", "sync", "emit"):
+        assert rep["phases"][name]["count"] > 0, name
+    # admission ran at least once (the enqueue's prefill chunks)
+    assert rep["phases"]["admit"]["count"] > 0
+    ring = rep["recent_steps"]
+    assert ring and all(
+        r["engine"] == "batch" and "total_ms" in r for r in ring)
+    assert any(r["phases"] for r in ring)
+    # memory arm: host watermarks always resolve on Linux
+    assert rep["memory"]["host"]["rss_bytes"] > 0
+
+
+def test_disabled_profiler_records_nothing(params, prof_env):
+    prof.profiler().reset()
+    prof.profiler().set_sample(0)
+    gen = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                         settings=SamplerSettings(**GREEDY))
+    _collect(gen, [2, 7, 1, 8], sid=1, steps=8)
+    rep = prof.report()
+    assert rep["sampled_steps"] == 0
+    assert rep["recent_steps"] == []
+
+
+# -- retrace sentinel ---------------------------------------------------------
+
+def test_retrace_sentinel_flags_steady_decode_compile(prof_env):
+    sent = prof.sentinel()
+    sent.install()
+    sent.reset()
+    f = jax.jit(lambda x: x * 2 + 1)
+    a4, a8, a16 = jnp.zeros((4,)), jnp.zeros((8,)), jnp.zeros((16,))
+
+    # warmup compile inside the decode phase: counted, not a finding
+    with sent.decode_phase():
+        f(a4)
+    assert sent.compiles.value >= 1
+    assert sent.retraces.value == 0
+
+    sent.mark_steady()
+    # steady compile OUTSIDE a decode dispatch (a new prompt-bucket
+    # prefill, say) is legitimate — still not a finding
+    f(a8)
+    assert sent.retraces.value == 0
+
+    # steady + decode-phase + new shape = the retrace finding
+    with sent.decode_phase():
+        f(a16)
+    assert sent.retraces.value == 1
+    findings = sent.findings()
+    assert len(findings) == 1
+    assert findings[0]["compile_ms"] > 0
+
+    # the cache-hit path must not re-flag: same shape again, no compile
+    with sent.decode_phase():
+        f(a16)
+    assert sent.retraces.value == 1
+
+
+def test_retrace_sentinel_strict_raises(prof_env, monkeypatch):
+    sent = prof.sentinel()
+    sent.install()
+    sent.reset()
+    g = jax.jit(lambda x: x - 3)
+    b4, b8 = jnp.zeros((4,)), jnp.zeros((8,))
+    with sent.decode_phase():
+        g(b4)
+    sent.mark_steady()
+    monkeypatch.setenv("CAKE_PROF_STRICT", "1")
+    with pytest.raises(prof.RetraceError):
+        with sent.decode_phase():
+            g(b8)
+    assert sent.retraces.value == 1
+
+
+# -- live /debug/prof ---------------------------------------------------------
+
+def test_debug_prof_served_live(params, prof_env):
+    prof.profiler().set_sample(1)
+    gen = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                         settings=SamplerSettings(**GREEDY))
+    sched = Scheduler(gen, queue_depth=4, request_timeout_s=120)
+    sched.start(max_concurrent=2)
+    srv = start_api_server(sched)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        req = urllib.request.Request(
+            url + "/v1/completions",
+            data=json.dumps({"prompt": "abcd", "max_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            r.read()
+        with urllib.request.urlopen(url + "/debug/prof", timeout=30) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            rep = json.loads(r.read())
+    finally:
+        srv.close()
+        sched.close()
+    for key in ("phases", "recent_steps", "compiles", "retraces",
+                "memory", "sample_every"):
+        assert key in rep, key
+    assert rep["phases"]["dispatch"]["count"] > 0
+    assert rep["compiles"] >= 0
+
+
+# -- trace nesting ------------------------------------------------------------
+
+def test_phase_spans_nest_under_request_spans(params, prof_env):
+    """One --trace timeline carries BOTH the reqtrace request spans and
+    the prof.* phase spans, with the phases inside the request window."""
+    from cake_tpu.obs import trace as obs_trace
+
+    prof.profiler().set_sample(1)
+    tr = obs_trace.tracer()
+    tr.start()
+    try:
+        gen = BatchGenerator(CFG, params, tokenizer=_FakeTok(),
+                             settings=SamplerSettings(**GREEDY))
+        sched = Scheduler(gen, queue_depth=4, request_timeout_s=120)
+        sched.start(max_concurrent=2)
+        srv = start_api_server(sched)
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps(
+                    {"prompt": "abcd", "max_tokens": 10}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200
+                r.read()
+        finally:
+            srv.close()
+            sched.close()
+    finally:
+        tr.stop()
+    doc = tr.to_chrome_trace()
+    tr.clear()
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    prof_evs = [e for e in evs if e["name"].startswith("prof.")]
+    req_evs = [e for e in evs
+               if e["name"] in ("serve.queue", "engine.prefill",
+                                "session.emit")]
+    assert prof_evs, "no prof.* phase spans in the trace"
+    assert req_evs, "no request spans in the trace"
+    lo = min(e["ts"] for e in req_evs)
+    hi = max(e["ts"] + e.get("dur", 0) for e in req_evs)
+    inside = [e for e in prof_evs if lo <= e["ts"] <= hi]
+    assert inside, "no phase span inside the request window"
+
+
+# -- benchdiff gate -----------------------------------------------------------
+
+def _ledger(tmp_path, rows, name="ledger.jsonl"):
+    p = tmp_path / name
+    p.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(p)
+
+
+def _row(metric, value, unit, **extra):
+    return {"metric": metric, "value": value, "unit": unit,
+            "device": "cpu", "stamp": "2026-08-07T00:00:00Z", **extra}
+
+
+def test_benchdiff_passes_steady_ledger(tmp_path, capsys):
+    from cake_tpu.tools import benchdiff
+
+    led = _ledger(tmp_path, [
+        _row("decode_tok", 100.0, "tokens/s"),
+        _row("decode_tok", 104.0, "tokens/s"),
+        _row("ttft_ms", 12.0, "ms"),
+        _row("ttft_ms", 11.0, "ms"),
+        _row("obs_pct", 1.5, "%"),
+        _row("obs_pct", 2.0, "%"),
+    ])
+    rc = benchdiff.main(["--ledger", led,
+                         "--baseline", str(tmp_path / "nope.json")])
+    assert rc == 0
+    assert "REGRESSED" not in capsys.readouterr().out
+
+
+def test_benchdiff_fails_on_regression(tmp_path, capsys):
+    from cake_tpu.tools import benchdiff
+
+    led = _ledger(tmp_path, [
+        _row("decode_tok", 100.0, "tokens/s"),
+        _row("decode_tok", 10.0, "tokens/s"),  # -90%: past any gate
+    ])
+    rc = benchdiff.main(["--ledger", led,
+                         "--baseline", str(tmp_path / "nope.json")])
+    assert rc == 1
+    assert "REGRESSED" in capsys.readouterr().out
+
+
+def test_benchdiff_overhead_rows_gate_on_points(tmp_path):
+    from cake_tpu.tools import benchdiff
+
+    # a 4% overhead leg is inside the default 10-point budget — even
+    # though a lucky -4% leg sits in the history (a min-of-history gate
+    # would call this +8pp and start creeping toward red)
+    led = _ledger(tmp_path, [
+        _row("obs_pct", -4.0, "%"), _row("obs_pct", 4.0, "%"),
+    ])
+    assert benchdiff.main(["--ledger", led]) == 0
+    # ...11.5% overhead busts the budget regardless of history
+    led = _ledger(tmp_path, [
+        _row("obs_pct", -4.0, "%"), _row("obs_pct", 11.5, "%"),
+    ], name="bad.jsonl")
+    assert benchdiff.main(["--ledger", led]) == 1
+
+
+def test_benchdiff_ignores_cross_device_history(tmp_path):
+    from cake_tpu.tools import benchdiff
+
+    # a tpu row's 10x number must not gate the cpu smoke that follows
+    rows = [
+        dict(_row("decode_tok", 5000.0, "tokens/s"), device="TPU v5e"),
+        _row("decode_tok", 100.0, "tokens/s"),
+        _row("decode_tok", 95.0, "tokens/s"),
+    ]
+    assert benchdiff.main(["--ledger", _ledger(tmp_path, rows)]) == 0
