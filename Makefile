@@ -184,6 +184,20 @@ reqtrace-smoke:
 prof-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_prof.py -q -m 'not slow'
 
+# fleet-elasticity smoke (ISSUE 19): dynamic membership + rolling
+# restarts — self-registration leases (idempotent under a 100-thread
+# registration storm), explicit deregister-before-503 (zero 503s reach
+# a client during a SIGTERM drain), admission shedding/queueing by
+# request class under fleet saturation, rolling-restart drains whose
+# in-flight streams migrate to a sibling bit-identically, gateway
+# restart with empty --backends re-forming the fleet from heartbeats,
+# and the control-plane chaos matrix (storm / flap / stale deregister /
+# restart) green under a fixed seed — then the live-resize demo:
+# loadgen --spawn-backends 2 --resize-to 4 and back under Poisson load
+# with zero failed requests.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m 'not slow'
+
 # bench regression gate: newest bench_results.jsonl row per metric vs
 # the best prior run (tools/benchdiff) — nonzero exit past the
 # thresholds, so a perf regression fails CI the way a lint finding does.
@@ -200,7 +214,7 @@ bench-diff:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -220,4 +234,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke bench-diff perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke bench-diff perf-smoke deploy clean

@@ -125,6 +125,22 @@ CLAIM_RULES = (
         hint="attach or abort the transfer, or store its meta for the "
              "resume handler",
     ),
+    ClaimRule(
+        rule="gateway.lease",
+        style="binding",
+        patterns=("._lease_acquire",),
+        release_funcs=("_lease_release",),
+        hint="append the member to the fleet list (the monitor owns its "
+             "lease from there) or release it before anything can raise",
+    ),
+    ClaimRule(
+        rule="gateway.admit",
+        style="binding",
+        patterns=("._admit_enter",),
+        release_funcs=("_admit_exit",),
+        hint="release the admission-queue slot in a finally — a leaked "
+             "slot shrinks the queue for every later request",
+    ),
 )
 
 # Calls that are never "risky statements" between an acquisition and

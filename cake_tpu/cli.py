@@ -372,7 +372,39 @@ def build_parser() -> argparse.ArgumentParser:
                         "addresses the gateway routes across (each runs "
                         "--mode serve; the gateway health-checks their "
                         "/healthz and proxies /v1/completions, /v1/models "
-                        "to the fleet)")
+                        "to the fleet). These are STATIC SEED members; "
+                        "replicas started with --register-with join "
+                        "dynamically, so an empty --backends is fine")
+    p.add_argument("--register-with", default=None, dest="register_with",
+                   metavar="URL",
+                   help="--mode serve: announce this replica to a gateway "
+                        "(POST <URL>/v1/fleet/register) and heartbeat-"
+                        "renew the membership lease at the cadence the "
+                        "gateway asks for; SIGTERM deregisters FIRST, so "
+                        "the gateway stops routing here before the drain "
+                        "starts answering 503")
+    p.add_argument("--lease-ttl", type=float, default=10.0,
+                   dest="lease_ttl", metavar="S",
+                   help="--mode gateway: registration lease TTL for "
+                        "dynamically registered replicas (default 10). A "
+                        "missed renewal demotes through the probe "
+                        "hysteresis — never an instant delete — and only "
+                        "a long-expired, non-UP member is garbage-"
+                        "collected")
+    p.add_argument("--admit-wait", type=float, default=0.5,
+                   dest="admit_wait", metavar="S",
+                   help="--mode gateway: when EVERY routable backend is "
+                        "saturated, how long an interactive request may "
+                        "queue at the front door for a slot to free "
+                        "before being shed with a fleet-derived "
+                        "Retry-After (default 0.5; 0 = always shed; "
+                        "batch-class requests never queue)")
+    p.add_argument("--admit-queue", type=int, default=32,
+                   dest="admit_queue", metavar="N",
+                   help="--mode gateway: how many saturated-fleet "
+                        "requests may queue at once (default 32; past "
+                        "that, shed immediately — a bounded queue, not "
+                        "buffer bloat)")
     p.add_argument("--route-policy", choices=["p2c", "round_robin",
                                               "prefix"],
                    default="p2c", dest="route_policy",
@@ -689,6 +721,8 @@ def _serve_flags(args) -> list[str]:
         out.append("--transfer-port")
     if args.transfer_codec != "none":
         out.append("--transfer-codec")
+    if args.register_with is not None:
+        out.append("--register-with")
     if args.slo_ttft_ms is not None:
         out.append("--slo-ttft-ms")
     if args.slo_tpot_ms is not None:
@@ -900,9 +934,25 @@ def run_http_serve(args) -> int:
             "metrics": obs_metrics.registry().snapshot(),
         }
 
+    # graceful drain: SIGTERM/SIGINT — or a gateway-driven
+    # POST /v1/fleet/drain (rolling restart) — stop admission, in-flight
+    # streams finish or migrate, artifacts flush
+    stop = threading.Event()
+
     server = start_api_server(scheduler, status_fn=serve_status,
                               bind=serve_bind, port=serve_port,
-                              model_id=Path(args.model).name or "cake-tpu")
+                              model_id=Path(args.model).name or "cake-tpu",
+                              on_drain=stop.set)
+    registrar = None
+    if args.register_with:
+        from cake_tpu.serve.register import Registrar
+
+        registrar = Registrar(
+            args.register_with, f"{serve_bind}:{server.port}",
+            role=args.role,
+            transfer_port=xfer_server.port if xfer_server else 0).start()
+        log.info("registering with gateway %s as %s:%d",
+                 args.register_with, serve_bind, server.port)
     status_httpd = None
     if args.status_port is not None:
         # optional standalone status page (byte-identical surface; the API
@@ -918,12 +968,6 @@ def run_http_serve(args) -> int:
              server.port, scheduler.max_concurrent, queue_depth,
              request_timeout)
 
-    # graceful drain: SIGTERM/SIGINT stop admission, in-flight streams
-    # finish, artifacts flush (the obs handlers/atexit installed in main()
-    # cover --metrics-out/--flight-log; flush_artifacts is also called
-    # explicitly below so a plain serve run still lands them)
-    stop = threading.Event()
-
     def _on_signal(signum, frame):
         log.info("signal %d: draining (no new admissions; in-flight "
                  "streams finish)", signum)
@@ -934,6 +978,12 @@ def run_http_serve(args) -> int:
     try:
         stop.wait()
     finally:
+        # deregister BEFORE the drain starts answering 503s: the
+        # gateway pins this member DRAINING immediately, so the probe
+        # race window (up to one --probe-interval) can't route a
+        # request into the exit
+        if registrar is not None:
+            registrar.deregister()
         server.drain(timeout_s=request_timeout)
         if xfer_server is not None:
             xfer_server.stop()
@@ -958,6 +1008,12 @@ def _gateway_flags(args) -> list[str]:
         out.append("--probe-interval")
     if args.gateway_prefix_block != 64:
         out.append("--gateway-prefix-block")
+    if args.lease_ttl != 10.0:
+        out.append("--lease-ttl")
+    if args.admit_wait != 0.5:
+        out.append("--admit-wait")
+    if args.admit_queue != 32:
+        out.append("--admit-queue")
     return out
 
 
@@ -976,10 +1032,6 @@ def run_gateway(args) -> int:
     from cake_tpu.gateway.policy import make_policy
     from cake_tpu.obs import metrics as obs_metrics
 
-    if not args.backends:
-        sys.exit("error: --mode gateway requires --backends "
-                 "HOST:PORT[,HOST:PORT...] (the serve replicas to route "
-                 "across)")
     if args.model:
         sys.exit("error: --model belongs to the serving/generation modes; "
                  "a gateway holds no model — point --backends at --mode "
@@ -1008,6 +1060,7 @@ def run_gateway(args) -> int:
         ("--role", args.role != "mixed"),
         ("--transfer-port", args.transfer_port is not None),
         ("--transfer-codec", args.transfer_codec != "none"),
+        ("--register-with", args.register_with is not None),
     ) if on]
     if engine_flags:
         sys.exit(f"error: {'/'.join(engine_flags)} configure a serve "
@@ -1019,16 +1072,25 @@ def run_gateway(args) -> int:
         sys.exit("error: --gateway-prefix-block must be >= 1")
     if args.request_timeout is not None and args.request_timeout <= 0:
         sys.exit("error: --request-timeout must exceed 0")
+    if args.lease_ttl <= 0:
+        sys.exit("error: --lease-ttl must exceed 0")
+    if args.admit_wait < 0:
+        sys.exit("error: --admit-wait must be >= 0")
+    if args.admit_queue < 1:
+        sys.exit("error: --admit-queue must be >= 1")
 
     serve_port = args.serve_port if args.serve_port is not None else 8080
     serve_bind = args.serve_bind or "127.0.0.1"
     request_timeout = (args.request_timeout
                        if args.request_timeout is not None else 300.0)
     try:
-        backends = parse_backends(args.backends)
+        backends = parse_backends(args.backends) if args.backends else []
     except ValueError as e:
         sys.exit(f"error: {e}")
-    monitor = HealthMonitor(backends, probe_interval=args.probe_interval)
+    # an empty --backends is a valid start state: the fleet forms (or
+    # RE-forms, after a gateway restart) from replica self-registrations
+    monitor = HealthMonitor(backends, probe_interval=args.probe_interval,
+                            lease_ttl_s=args.lease_ttl, allow_empty=True)
     policy = make_policy(args.route_policy,
                          prefix_block=args.gateway_prefix_block)
     monitor.start()
@@ -1047,7 +1109,9 @@ def run_gateway(args) -> int:
                            prefix_block=args.gateway_prefix_block,
                            read_timeout=request_timeout,
                            status_fn=gateway_status,
-                           slo=_slo_tracker(args))
+                           slo=_slo_tracker(args),
+                           admit_wait_s=args.admit_wait,
+                           admit_queue=args.admit_queue)
     status_httpd = None
     if args.status_port is not None:
         from cake_tpu.obs import statusd

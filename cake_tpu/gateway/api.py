@@ -22,6 +22,14 @@ proxies the serving API across a fleet of ``--mode serve`` replicas:
 - ``GET /`` + ``GET /metrics`` — the shared ``obs/statusd`` status
   surface: fleet state JSON and the process registry (all ``gateway.*``
   series) in Prometheus text.
+- ``POST /v1/fleet/register`` / ``/v1/fleet/deregister`` — the dynamic
+  membership plane (ISSUE 19): serve replicas self-announce and lease
+  their membership (``health.HealthMonitor.register``), and the SIGTERM
+  drain path deregisters explicitly before any 503 is served.
+- ``POST /v1/fleet/drain/<backend>`` — operator-initiated rolling
+  restart: pin the backend DRAINING, pick a sibling, relay the drain
+  order; the replica migrates its in-flight streams to the sibling over
+  the KV-transfer plane and exits clean.
 
 Graceful drain mirrors serve: ``drain()`` (the SIGTERM path) stops
 admitting (503), waits for in-flight proxied requests — streams included
@@ -58,6 +66,11 @@ RETRIES = obs_metrics.counter("gateway.retries")
 REJECTED = obs_metrics.counter("gateway.rejected")
 SATURATED = obs_metrics.counter("gateway.saturated")
 ADDED_MS = obs_metrics.histogram("gateway.added_ms")
+# fleet-saturation admission control (ISSUE 19): requests shed at the
+# door when every routable backend refused, and requests that rode the
+# bounded admission queue instead of eating an instant 429
+SHED = obs_metrics.counter("gateway.shed")
+QUEUED_ADMISSIONS = obs_metrics.counter("gateway.queued_admissions")
 # disagg two-stage routing (cake_tpu/disagg): tiered routes that went
 # prefill -> transfer -> decode resume end-to-end, and fallbacks that
 # re-prefilled the request on the classic path after a tiered-path
@@ -106,6 +119,15 @@ class _Attempt:
             pass
 
 
+class _FleetHTTPServer(http.server.ThreadingHTTPServer):
+    # The front door takes whole-fleet thundering herds by design: a
+    # registration storm (every replica re-announcing after a gateway
+    # restart) plus client retries all connect at once. The stdlib's
+    # 5-connection listen backlog resets the overflow before a handler
+    # thread ever sees it.
+    request_queue_size = 128
+
+
 class GatewayServer:
     """The routing front door; ``start_gateway`` is the entry point."""
 
@@ -121,9 +143,16 @@ class GatewayServer:
                  bind: str = "127.0.0.1", port: int = 0,
                  prefix_block: int = 64, connect_timeout: float = 2.0,
                  read_timeout: float = 300.0, status_fn=None,
-                 slo: obs_reqtrace.SloTracker | None = None):
+                 slo: obs_reqtrace.SloTracker | None = None,
+                 admit_wait_s: float = 0.5, admit_queue: int = 32):
         self.monitor = monitor
         self.policy = policy
+        # admission control under fleet saturation: how long an
+        # interactive request may wait for a slot to free (0 = always
+        # shed), and how many may wait at once (past that, shed even
+        # interactive traffic — a bounded queue, not a buffer bloat)
+        self.admit_wait_s = max(0.0, admit_wait_s)
+        self._admit_sem = threading.Semaphore(max(1, admit_queue))
         # SLO accounting at the front door (--slo-ttft-ms/--slo-tpot-ms):
         # the gateway judges end-to-end latency AS THE CLIENT SEES IT —
         # routing, retries, and tiered hops included (obs/reqtrace)
@@ -147,7 +176,7 @@ class GatewayServer:
                         "metrics": obs_metrics.registry().snapshot()}
         self.status_fn = status_fn
         handler = _make_handler(self)
-        self.httpd = http.server.ThreadingHTTPServer((bind, port), handler)
+        self.httpd = _FleetHTTPServer((bind, port), handler)
         self.port = self.httpd.server_address[1]
         self.bind = bind
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -174,6 +203,18 @@ class GatewayServer:
     def is_draining(self) -> bool:
         with self._cond:
             return self._draining
+
+    # -- admission queue (CK-CLAIM gateway.admit: enter pairs with exit) ------
+    def _admit_enter(self):
+        """Claim one bounded admission-queue slot; the token (or None
+        when the queue is full) MUST go back through :meth:`_admit_exit`
+        in a finally."""
+        return self._admit_sem if self._admit_sem.acquire(
+            blocking=False) else None
+
+    def _admit_exit(self, token) -> None:
+        if token is not None:
+            token.release()
 
     def drain(self, timeout_s: float = 30.0) -> None:
         """SIGTERM path: stop admitting (503), let in-flight proxied
@@ -412,6 +453,7 @@ def _make_handler(server: GatewayServer):
                 tiers: dict[str, int] = {}
                 for b in ups:
                     tiers[b.role] = tiers.get(b.role, 0) + 1
+                now = time.monotonic()
                 body = {
                     "ok": ok,
                     "draining": draining,
@@ -419,7 +461,10 @@ def _make_handler(server: GatewayServer):
                     # the tier map: two-stage routing engages while both
                     # "prefill" and "decode" are nonzero here
                     "tiers": tiers,
-                    "backends": {b.name: b.state
+                    # per-backend row: state + membership staleness
+                    # (registered_via, probe age, lease expiry) so --top
+                    # and operators read fleet health at a glance
+                    "backends": {b.name: b.health_entry(now)
                                  for b in monitor.backends},
                 }
                 if server.slo is not None:
@@ -473,9 +518,19 @@ def _make_handler(server: GatewayServer):
                 self._relay(resp, data)
                 return
 
-        # -- POST: routed completions -------------------------------------
+        # -- POST: routed completions + the fleet membership plane --------
         def do_POST(self):  # noqa: N802 (stdlib casing)
-            if self.path.rstrip("/") != "/v1/completions":
+            path = self.path.rstrip("/")
+            if path == "/v1/fleet/register":
+                self._fleet_register()
+                return
+            if path == "/v1/fleet/deregister":
+                self._fleet_deregister()
+                return
+            if path.startswith("/v1/fleet/drain/"):
+                self._fleet_drain(path[len("/v1/fleet/drain/"):])
+                return
+            if path != "/v1/completions":
                 self._error(404, f"no route for POST {self.path}")
                 return
             if not server._enter():
@@ -501,6 +556,150 @@ def _make_handler(server: GatewayServer):
             finally:
                 server._exit()
                 self._finish_request()
+
+        # -- fleet membership endpoints (ISSUE 19) ------------------------
+        def _read_json(self) -> dict | None:
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, OSError):
+                return None
+            return body if isinstance(body, dict) else None
+
+        def _fleet_register(self) -> None:
+            """A serve replica announcing itself: create-or-renew its
+            membership lease (idempotent — a registration storm updates
+            one entry in place). The answer tells the replica its lease
+            TTL and the heartbeat cadence that keeps it alive."""
+            body = self._read_json()
+            if body is None:
+                self._error(400, "register wants a JSON object body")
+                return
+            addr = body.get("addr")
+            if not isinstance(addr, str) or not addr:
+                # a replica that only knows its port: pair it with the
+                # peer address this registration arrived from
+                port = body.get("port")
+                addr = (f"{self.client_address[0]}:{port}"
+                        if port else None)
+            if not addr:
+                self._error(400,
+                            "register wants addr (host:port) or port")
+                return
+            try:
+                b = monitor.register(
+                    addr, role=body.get("role"),
+                    transfer_port=int(body.get("transfer_port", 0) or 0))
+            except ValueError as e:
+                self._error(400, str(e))
+                return
+            self._json(200, {
+                "ok": True, "name": b.name, "state": b.state,
+                "lease_ttl_s": monitor.lease_ttl_s,
+                # renew comfortably inside the TTL: one lost beat plus
+                # jitter must not expire the lease
+                "heartbeat_s": round(max(0.2,
+                                         monitor.lease_ttl_s / 3), 3),
+            })
+
+        def _fleet_deregister(self) -> None:
+            """Explicit leave (the replica's SIGTERM sends this BEFORE
+            its /healthz starts answering 503): pin the member DRAINING
+            so not one request routes into the exit. Idempotent — a
+            stale or repeated deregister is a harmless no-op."""
+            body = self._read_json()
+            key = (body or {}).get("addr") or (body or {}).get("name")
+            if not isinstance(key, str) or not key:
+                self._error(400, "deregister wants addr or name")
+                return
+            b = monitor.deregister(key)
+            self._json(200, {"ok": True, "known": b is not None,
+                             **({"name": b.name} if b else {})})
+
+        def _fleet_drain(self, key: str) -> None:
+            """Operator-initiated rolling restart of one backend: pin it
+            DRAINING here first (new sessions re-home immediately), pick
+            a migration sibling from the same tier, then relay the drain
+            order — the replica migrates its in-flight decode streams to
+            the sibling over the KV-transfer plane and exits clean."""
+            b = monitor.lookup(key)
+            if b is None:
+                self._error(404, f"unknown backend {key!r}")
+                return
+            monitor.deregister(b.addr)
+            sibs = [x for x in monitor.routable()
+                    if x.addr != b.addr and x.role != "prefill"
+                    and x.transfer_addr()]
+            sib = next((x for x in sibs if x.role == b.role),
+                       sibs[0] if sibs else None)
+            payload: dict = {}
+            if sib is not None:
+                payload["migrate_to"] = {"addr": sib.addr,
+                                         "transfer": sib.transfer_addr()}
+            att = _Attempt(b, server.connect_timeout,
+                           server.read_timeout)
+            try:
+                try:
+                    resp = att.send("POST", "/v1/fleet/drain",
+                                    json.dumps(payload).encode())
+                    reply = json.loads(resp.read() or b"{}")
+                    status = resp.status
+                except (OSError, ValueError) as e:
+                    self._json(502, {"ok": False, "backend": b.name,
+                                     "error": f"drain relay failed: {e}"})
+                    return
+            finally:
+                att.close()
+            self._json(status if status < 500 else 502,
+                       {"ok": status == 200, "backend": b.name,
+                        "addr": b.addr,
+                        "migrate_to": payload.get("migrate_to"),
+                        "replica": reply})
+
+        def _admit_wait(self, raw: bytes, t0: float) -> bool:
+            """The fleet is saturated: hold this request in the bounded
+            admission queue until a backend frees up (True — re-route
+            it) or the budget runs out (False — shed). The budget is
+            ``admit_wait_s`` capped by the request's own deadline
+            headroom; batch-class requests (``"class": "batch"`` in the
+            body) never queue — they are the load to shed first."""
+            budget = server.admit_wait_s
+            if budget <= 0:
+                return False
+            try:
+                body = json.loads(raw or b"{}")
+            except ValueError:
+                body = None
+            if not isinstance(body, dict):
+                body = {}
+            if str(body.get("class", "interactive")) == "batch":
+                return False
+            timeout_s = body.get("timeout_s")
+            if isinstance(timeout_s, (int, float)) and timeout_s > 0:
+                budget = min(budget, max(
+                    0.0, timeout_s - (time.perf_counter() - t0)))
+            if budget <= 0:
+                return False
+            tok = None
+            try:
+                tok = server._admit_enter()
+                if tok is None:
+                    return False  # queue itself is full: shed
+                QUEUED_ADMISSIONS.inc()
+                with self._ctx.span("gateway.admit_queue"):
+                    deadline = time.monotonic() + budget
+                    while time.monotonic() < deadline:
+                        if server.is_draining():
+                            return False
+                        now = time.monotonic()
+                        if any(not x.saturated(now)
+                               for x in monitor.routable()
+                               if x.role != "prefill"):
+                            return True
+                        time.sleep(0.05)
+                    return False
+            finally:
+                server._admit_exit(tok)
 
         def _proxy_completions(self) -> None:
             try:
@@ -533,6 +732,7 @@ def _make_handler(server: GatewayServer):
                 return
             tried: list = []
             last_429: tuple | None = None
+            queued = False
             while True:
                 now = time.monotonic()
                 # prefill-tier replicas refuse plain completions by
@@ -541,17 +741,27 @@ def _make_handler(server: GatewayServer):
                 cands = [b for b in monitor.routable()
                          if b not in tried and b.role != "prefill"]
                 if not cands:
-                    if last_429 is not None:
-                        # every routable backend is saturated: only now
-                        # does the client see the backpressure
-                        SATURATED.inc()
-                        resp_data, retry_after = last_429
-                        self._send_raw(429, resp_data,
-                                       {"Retry-After": retry_after}
-                                       if retry_after else None)
-                    else:
+                    if last_429 is None:
                         REJECTED.inc()
                         self._error(503, "no backend available")
+                        return
+                    # every routable backend is saturated: admission
+                    # control decides — one bounded, deadline-aware
+                    # wait in the admission queue (interactive class),
+                    # then shed with a Retry-After derived from
+                    # fleet-wide tok/s instead of relaying whichever
+                    # 429 happened to come last
+                    if not queued and self._admit_wait(raw, t0):
+                        queued = True
+                        tried, last_429 = [], None
+                        continue
+                    SATURATED.inc()
+                    SHED.inc()
+                    retry_after = _fleet_retry_after(monitor, raw)
+                    self._json(429, {"error": "fleet saturated",
+                                     "shed": True,
+                                     "retry_after_s": retry_after},
+                               {"Retry-After": str(retry_after)})
                     return
                 b = server.policy.choose(cands, key=key, now=now,
                                          first_attempt=not tried)
@@ -796,6 +1006,26 @@ def _make_handler(server: GatewayServer):
             return "done"
 
     return Handler
+
+
+def _fleet_retry_after(monitor: HealthMonitor, raw: bytes) -> int:
+    """Retry-After from fleet-wide throughput: outstanding work (queued
+    + running across routable backends) times this request's own token
+    ask, over the fleet's summed tok/s EMA — clamped to [1, 30] s."""
+    pending, tok_s = 0, 0.0
+    for b in monitor.routable():
+        ld = b.load_snapshot()
+        pending += int(ld.get("queued", 0)) + int(ld.get("running", 0))
+        tok_s += float(ld.get("tok_s_ema", 0.0) or 0.0)
+    max_tokens = 16
+    try:
+        body = json.loads(raw or b"{}")
+        if isinstance(body, dict):
+            max_tokens = int(body.get("max_tokens", 16) or 16)
+    except (ValueError, TypeError):
+        pass
+    est = (max(1, pending) * max(1, max_tokens)) / max(tok_s, 1.0)
+    return max(1, min(30, round(est)))
 
 
 def _as_seconds(retry_after: str | None) -> float:

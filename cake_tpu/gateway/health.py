@@ -27,10 +27,24 @@ full jitter on the shape of :class:`cake_tpu.runtime.retry.RetryPolicy`
 of hammering a dead port every interval, and while the breaker holds the
 backend is not probed at all. Routing (``gateway/policy.py``) only ever
 sees ``routable()`` — the UP subset.
+
+Membership is dynamic (ISSUE 19): ``--backends`` seeds *static* members,
+and serve replicas self-register over ``POST /v1/fleet/register``
+(:meth:`HealthMonitor.register`). A dynamic registration is a **lease
+with a TTL**, renewed from two directions — the replica's periodic
+re-register heartbeat and every successful gateway-side probe. A missed
+renewal never deletes: an expired lease feeds the same hysteresis
+failure counter a refused probe does (demote, ``down_after`` applies),
+and only a lease that has stayed expired for a whole GC window is
+removed from membership. An explicit deregister (the SIGTERM drain
+path) pins the backend DRAINING — a racing 200 probe cannot flip it
+back to UP until a fresh registration clears the pin — so the probe
+race window can never route a request into a dying replica.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import random
@@ -53,6 +67,12 @@ _STATE_VALUE = {UP: 2, DRAINING: 1, DOWN: 0}
 
 BACKENDS_UP = obs_metrics.gauge("gateway.backends_up")
 BREAKER_OPEN = obs_metrics.gauge("gateway.breaker_open")
+REGISTRATIONS = obs_metrics.counter("gateway.registrations")
+DEREGISTRATIONS = obs_metrics.counter("gateway.deregistrations")
+LEASE_EXPIRED = obs_metrics.counter("gateway.lease_expired")
+
+STATIC = "static"
+DYNAMIC = "dynamic"
 
 
 class Backend:
@@ -75,9 +95,15 @@ class Backend:
         "_next_probe_t": "_lock",
         "_role": "_lock",
         "_transfer_port": "_lock",
+        "_lease_ttl_s": "_lock",
+        "_lease_expires_t": "_lock",
+        "_lease_noted": "_lock",
+        "_deregistered": "_lock",
+        "_last_probe_t": "_lock",
     }
 
-    def __init__(self, name: str, addr: str):
+    def __init__(self, name: str, addr: str,
+                 registered_via: str = STATIC):
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
             raise ValueError(f"backend address {addr!r} is not host:port")
@@ -85,7 +111,19 @@ class Backend:
         self.addr = addr
         self.host = host
         self.port = int(port)
+        # how this member joined: STATIC (--backends seed, immortal) or
+        # DYNAMIC (self-registered, lease-governed). Immutable.
+        self.registered_via = registered_via
         self._lock = threading.Lock()
+        # lease plane (dynamic members only): 0 = no lease held
+        self._lease_ttl_s = 0.0
+        self._lease_expires_t = 0.0
+        self._lease_noted = False  # expiry already counted this episode
+        # an explicit deregister pins DRAINING until re-registration:
+        # without the pin, a 200 probe racing the replica's own drain
+        # flag would flip it back UP and route traffic into the exit
+        self._deregistered = False
+        self._last_probe_t = 0.0
         # optimistic start: a freshly configured backend is routable until
         # the first probe (run synchronously at monitor start) says no
         self._state = UP
@@ -166,15 +204,108 @@ class Backend:
         with self._lock:
             return self._state != DOWN or now >= self._next_probe_t
 
+    def load_snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._load)
+
+    # -- lease plane ----------------------------------------------------------
+    def lease_renew(self, ttl_s: float, now: float | None = None) -> None:
+        """(Re)take the membership lease and clear the deregister pin —
+        a fresh registration is the replica's statement that it is back."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._lease_ttl_s = max(0.0, ttl_s)
+            self._lease_expires_t = now + self._lease_ttl_s
+            self._lease_noted = False
+            self._deregistered = False
+
+    def lease_expired(self, now: float | None = None) -> bool:
+        """The lease lapsed (dynamic members only; static never expire)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return bool(self._lease_expires_t) and now >= \
+                self._lease_expires_t
+
+    def lease_note_expiry(self, now: float) -> bool:
+        """True exactly once per expiry episode (drives the
+        ``gateway.lease_expired`` counter; renewal re-arms it)."""
+        with self._lock:
+            if (self._lease_expires_t and now >= self._lease_expires_t
+                    and not self._lease_noted):
+                self._lease_noted = True
+                return True
+            return False
+
+    def lease_gc_due(self, now: float, gc_s: float) -> bool:
+        """Expired for a whole GC window AND not routable: safe to drop
+        from membership. Static seeds are immortal."""
+        if self.registered_via != DYNAMIC:
+            return False
+        with self._lock:
+            if not self._lease_expires_t or self._state == UP:
+                return False
+            return now >= self._lease_expires_t + gc_s
+
+    def deregistered(self) -> bool:
+        with self._lock:
+            return self._deregistered
+
+    def mark_deregistered(self) -> None:
+        """Explicit deregister (drain notification): DRAINING now, and
+        pinned there — only :meth:`lease_renew` lifts the pin."""
+        with self._lock:
+            self._fails = 0
+            self._oks = 0
+            self._deregistered = True
+            if self._state != DRAINING:
+                self._set_state_locked(DRAINING)
+
+    def advertise(self, role: str | None, transfer_port: int) -> None:
+        """Registration-time capability hints (the probe loop keeps
+        confirming them against the replica's own /healthz answers)."""
+        with self._lock:
+            if role:
+                self._role = role
+            if transfer_port:
+                self._transfer_port = int(transfer_port)
+
+    def note_probe(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._last_probe_t = now
+
+    def health_entry(self, now: float | None = None) -> dict:
+        """The per-backend row in the gateway's own ``/healthz`` map:
+        state plus membership staleness at a glance (ISSUE 19)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {
+                "state": self._state,
+                "registered_via": self.registered_via,
+                "last_probe_age_s": (
+                    round(now - self._last_probe_t, 3)
+                    if self._last_probe_t else None),
+                "lease_expires_in_s": (
+                    round(self._lease_expires_t - now, 3)
+                    if self._lease_expires_t else None),
+            }
+
     def describe(self) -> dict:
         with self._lock:
+            now = time.monotonic()
             return {
                 "name": self.name,
                 "addr": self.addr,
                 "state": self._state,
                 "role": self._role,
+                "registered_via": self.registered_via,
                 **({"transfer_addr": f"{self.host}:{self._transfer_port}"}
                    if self._transfer_port else {}),
+                **({"lease_expires_in_s":
+                    round(self._lease_expires_t - now, 3)}
+                   if self._lease_expires_t else {}),
+                "last_probe_age_s": (round(now - self._last_probe_t, 3)
+                                     if self._last_probe_t else None),
                 "load": dict(self._load),
                 "consecutive_failures": self._fails,
                 "requests": self.requests.value,
@@ -186,7 +317,11 @@ class Backend:
         """A 200 ``/healthz``: refresh the load signal; DOWN needs
         ``up_after`` consecutive clean probes to re-enter rotation,
         DRAINING re-enters immediately (the backend explicitly said it is
-        serving again)."""
+        serving again) — unless the deregister pin holds, in which case
+        the probe refreshes load but can never promote (the replica said
+        it is leaving; only a fresh registration outranks that). A clean
+        probe also renews a held lease: the gateway-side half of the
+        heartbeat, riding the existing probe loop."""
         with self._lock:
             for k in self._load:
                 if k in load:
@@ -201,6 +336,12 @@ class Backend:
                 self._role = role
             self._transfer_port = int(load.get("transfer_port", 0) or 0)
             self._fails = 0
+            if self._deregistered:
+                return
+            if self._lease_ttl_s:
+                self._lease_expires_t = (time.monotonic()
+                                         + self._lease_ttl_s)
+                self._lease_noted = False
             self._oks += 1
             if self._state == DRAINING or (
                 self._state == DOWN and self._oks >= up_after
@@ -264,24 +405,51 @@ class Backend:
         self._state_gauge.set(_STATE_VALUE[state])
 
 
-class HealthMonitor:
-    """Background ``/healthz`` prober over a fixed backend set."""
+# process-wide dynamic-member name sequence: names key the per-backend
+# metric families (gateway.<name>.*), so they must never be reused for a
+# DIFFERENT address within one process (get-or-create would silently
+# merge two replicas' series)
+_DYN_SEQ = itertools.count()
 
-    # cakelint CK-THREAD: every mutation goes through Backend's lock;
-    # the monitor's own state is an Event + immutable config, so its
-    # surface is callable from handler threads and the prober alike
+
+class HealthMonitor:
+    """Background ``/healthz`` prober over a dynamic backend set:
+    ``--backends`` seeds static members, :meth:`register` adds/renews
+    leased dynamic ones (ISSUE 19)."""
+
+    # cakelint CK-THREAD: every mutation goes through Backend's lock or
+    # the membership lock below; the rest is an Event + immutable
+    # config, so the surface is callable from handler threads and the
+    # prober alike
     _THREAD_DOMAIN = "any"
+
+    # membership: handler threads register/deregister while the prober
+    # iterates — every touch of the list goes through the lock
+    # (machine-checked by cakelint CK-LOCK)
+    _GUARDED_BY = {"_backends": "_mlock"}
 
     def __init__(self, backends: list[Backend], probe_interval: float = 2.0,
                  down_after: int = 2, up_after: int = 2,
                  probe_timeout: float | None = None,
                  retry_policy: RetryPolicy | None = None,
-                 rng: random.Random | None = None):
-        if not backends:
-            raise ValueError("a gateway needs at least one backend")
+                 rng: random.Random | None = None,
+                 lease_ttl_s: float = 10.0,
+                 lease_gc_s: float | None = None,
+                 allow_empty: bool = False):
+        if not backends and not allow_empty:
+            raise ValueError("a gateway needs at least one backend "
+                             "(or allow_empty=True to form the fleet "
+                             "from self-registrations)")
         if probe_interval <= 0:
             raise ValueError("probe_interval must exceed 0")
-        self.backends = list(backends)
+        self._mlock = threading.Lock()
+        self._backends = list(backends)
+        self.lease_ttl_s = max(0.5, lease_ttl_s)
+        # how long an expired lease may linger (demoted, still listed)
+        # before the member is dropped: generous, so a replica that
+        # crashed mid-upgrade can still rejoin under its old entry
+        self.lease_gc_s = (lease_gc_s if lease_gc_s is not None
+                           else max(30.0, 3 * self.lease_ttl_s))
         self.probe_interval = probe_interval
         self.down_after = max(1, down_after)
         self.up_after = max(1, up_after)
@@ -298,11 +466,83 @@ class HealthMonitor:
         self._thread: threading.Thread | None = None
 
     # -- routing views --------------------------------------------------------
+    @property
+    def backends(self) -> list[Backend]:
+        """Membership snapshot (stable order: seeds first, then
+        registration order). Always a copy — iterate freely."""
+        with self._mlock:
+            return list(self._backends)
+
     def routable(self) -> list[Backend]:
         return [b for b in self.backends if b.routable()]
 
     def describe(self) -> list[dict]:
         return [b.describe() for b in self.backends]
+
+    def lookup(self, key: str) -> Backend | None:
+        """Find a member by name or host:port address."""
+        for b in self.backends:
+            if b.name == key or b.addr == key:
+                return b
+        return None
+
+    # -- dynamic membership (the fleet registration plane) --------------------
+    def register(self, addr: str, role: str | None = None,
+                 transfer_port: int = 0) -> Backend:
+        """Create-or-renew the lease for ``addr`` (idempotent: a
+        duplicate registration — crash-rejoin, retried POST, or a
+        100-way storm — updates the one existing entry in place, never a
+        phantom second member). A brand-new or non-routable member gets
+        one decisive welcome probe so membership re-forms within a
+        heartbeat, not an ``up_after`` hysteresis climb."""
+        created = False
+        with self._mlock:
+            b = next((x for x in self._backends if x.addr == addr), None)
+            if b is None:
+                b = self._lease_acquire(addr)
+                self._backends.append(b)
+                created = True
+        b.advertise(role, transfer_port)
+        b.lease_renew(self.lease_ttl_s)
+        REGISTRATIONS.inc()
+        if created:
+            log.info("backend %s (%s): registered (dynamic)", b.name,
+                     addr)
+        if created or not b.routable():
+            # decisive (down_after=1), same rationale as the bootstrap
+            # pass: a registering replica has no failure history, one
+            # honest probe settles it either way
+            self._probe_one(b, down_after=1)
+        self._publish_gauges()
+        return b
+
+    def _lease_acquire(self, addr: str) -> Backend:
+        """Mint the leased member object (CK-CLAIM ``gateway.lease``:
+        the caller must hand it to the membership list or release it)."""
+        return Backend(f"d{next(_DYN_SEQ)}", addr,
+                       registered_via=DYNAMIC)
+
+    def _lease_release(self, b: Backend) -> None:
+        """Drop a member whose lease lapsed past the GC window."""
+        with self._mlock:
+            if b in self._backends:
+                self._backends.remove(b)
+        log.warning("backend %s (%s): expired lease past GC window; "
+                    "dropped from membership", b.name, b.addr)
+
+    def deregister(self, key: str) -> Backend | None:
+        """Explicit leave (drain notification): pin the member DRAINING
+        immediately — before any 503 is ever served — and leave the
+        lease to expire on its own. Returns None for an unknown member
+        (a stale deregister must be harmless)."""
+        b = self.lookup(key)
+        if b is None:
+            return None
+        b.mark_deregistered()
+        DEREGISTRATIONS.inc()
+        log.info("backend %s (%s): deregistered", b.name, b.addr)
+        self._publish_gauges()
+        return b
 
     # -- passive signals (called by the proxy path) ---------------------------
     def report_failure(self, backend: Backend) -> None:
@@ -354,18 +594,37 @@ class HealthMonitor:
                 log.exception("health probe pass failed")
 
     def probe_pass(self, bootstrap: bool = False) -> None:
-        """Probe every backend whose breaker allows it, then refresh the
-        fleet-level gauges. ``bootstrap`` collapses the DOWN hysteresis
-        to one failure (the decisive first pass)."""
+        """Probe every backend whose breaker allows it, enforce lease
+        expiry (demote via the hysteresis counter, GC only after a whole
+        grace window), then refresh the fleet-level gauges.
+        ``bootstrap`` collapses the DOWN hysteresis to one failure (the
+        decisive first pass)."""
         now = time.monotonic()
         down_after = 1 if bootstrap else self.down_after
+        reap = []
         for b in self.backends:
+            if b.lease_note_expiry(now):
+                LEASE_EXPIRED.inc()
+                log.warning("backend %s (%s): lease expired", b.name,
+                            b.addr)
+            if b.lease_gc_due(now, self.lease_gc_s):
+                reap.append(b)
+                continue
+            if b.lease_expired(now) and not b.deregistered():
+                # missed renewal = one hysteresis failure per pass:
+                # demotes after down_after passes, never deletes — the
+                # flap-absorbing state machine is the same one probes use
+                b.report_failure(self.retry_policy, self._rng,
+                                 down_after, now)
             if b.probe_due(now):
                 self._probe_one(b, down_after)
+        for b in reap:
+            self._lease_release(b)
         self._publish_gauges()
 
     def _probe_one(self, b: Backend, down_after: int) -> None:
         url = f"http://{b.addr}/healthz"
+        b.note_probe()
         try:
             with urllib.request.urlopen(url,
                                         timeout=self.probe_timeout) as r:

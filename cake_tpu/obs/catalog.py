@@ -82,6 +82,19 @@ SERIES: dict[str, tuple[str, str]] = {
     "gateway.backends_up": (GAUGE, "backends currently routable (UP)"),
     "gateway.breaker_open": (
         GAUGE, "DOWN backends whose circuit breaker is holding probes"),
+    "gateway.deregistrations": (
+        COUNTER, "explicit fleet leaves (the SIGTERM drain path's "
+                 "goodbye; pins the member DRAINING)"),
+    "gateway.lease_expired": (
+        COUNTER, "registration leases that missed their renewal window "
+                 "(demotes through the probe hysteresis, never an "
+                 "instant delete)"),
+    "gateway.queued_admissions": (
+        COUNTER, "saturated-fleet requests held in the bounded admission "
+                 "queue instead of being shed"),
+    "gateway.registrations": (
+        COUNTER, "fleet registration/renewal POSTs accepted (dynamic "
+                 "membership leases)"),
     "gateway.rejected": (
         COUNTER, "requests refused at the gateway (draining / no backend "
                  "up)"),
@@ -94,6 +107,9 @@ SERIES: dict[str, tuple[str, str]] = {
         COUNTER, "requests landed on their prefix-preferred replica"),
     "gateway.saturated": (
         COUNTER, "429s propagated because every UP backend was saturated"),
+    "gateway.shed": (
+        COUNTER, "requests shed at the front door under fleet saturation "
+                 "(429 with a fleet-derived Retry-After)"),
     # -- engine profiling plane (cake_tpu/obs/prof) ----------------------
     "prof.compiles": (
         COUNTER, "XLA backend compiles observed process-wide "
@@ -169,6 +185,9 @@ SERIES: dict[str, tuple[str, str]] = {
     "serve.cancelled": (COUNTER, "requests cancelled (client went away)"),
     "serve.completed": (COUNTER, "requests that got their tokens"),
     "serve.decode_dispatch_ms": (HISTOGRAM, "batched decode dispatch"),
+    "serve.migrated_sessions": (
+        COUNTER, "live sessions re-homed to a sibling replica by a "
+                 "drain-migration (rolling restart)"),
     "serve.queue_depth": (GAUGE, "requests waiting for admission"),
     "serve.rejected": (COUNTER, "submissions refused at the queue bound"),
     "serve.stop_matches": (COUNTER, "streams ended by a stop-string match"),

@@ -24,6 +24,14 @@ scheduler:
   client; finish_reason ``"stop"``, distinct from ``"eos"``);
   ``logprobs: N`` adds top-N logprobs to every token event and the
   final usage block (server capacity set by ``--serve-logprobs``).
+- ``POST /v1/fleet/drain`` — gateway-initiated rolling restart (ISSUE
+  19): begin a drain that RE-HOMES live sessions to the sibling named
+  in ``migrate_to`` instead of making clients wait it out. Admitted
+  streams export their KV via the disagg snapshot path and the handler
+  splices the sibling's resumed stream onto the client connection
+  (skipping the tokens already delivered here), so the client sees one
+  uninterrupted, bit-identical stream; queued sessions re-run whole on
+  the sibling. Without ``migrate_to`` this is a classic drain.
 - ``GET /v1/models`` / ``GET /healthz`` — discovery and liveness.
 - ``GET /`` + ``GET /metrics`` — the exact statusd surface
   (``obs.statusd.status_response``), so one port serves traffic AND
@@ -43,6 +51,7 @@ import http.server
 import json
 import logging
 import threading
+import time
 
 from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.obs import statusd as _statusd
@@ -215,10 +224,20 @@ def _parse_request(body: dict, scheduler) -> Session:
 class ApiServer:
     """The serving front end; ``start_api_server`` is the entry point."""
 
+    _GUARDED_BY = {"_relays": "_relay_lock"}
+
     def __init__(self, scheduler, status_fn=None, bind: str = "127.0.0.1",
-                 port: int = 0, model_id: str = "cake-tpu"):
+                 port: int = 0, model_id: str = "cake-tpu", on_drain=None):
         self.scheduler = scheduler
         self.model_id = model_id
+        # rolling-restart hook: called (handler thread) after a
+        # /v1/fleet/drain ack so the process can schedule its own exit
+        self.on_drain = on_drain
+        self._relay_lock = threading.Lock()
+        self._relays = 0
+        # set once a drain carries a migrate_to target: drain() then
+        # waits for handler threads still splicing sibling streams
+        self._migrating = threading.Event()
         if status_fn is None:
             def status_fn():
                 from cake_tpu.obs import metrics as obs_metrics
@@ -245,8 +264,39 @@ class ApiServer:
         leak the bound port."""
         try:
             self.scheduler.stop(drain=True, timeout_s=timeout_s)
+            self._await_relays(timeout_s)
         finally:
             self.close()
+
+    def _relay_enter(self) -> None:
+        with self._relay_lock:
+            self._relays += 1
+
+    def _relay_exit(self) -> None:
+        with self._relay_lock:
+            self._relays -= 1
+
+    def _await_relays(self, timeout_s: float) -> None:
+        """Drain helper: wait out in-flight migration relays (handler
+        threads splicing a sibling's stream onto their client) before
+        the process tears down — exiting under them would fail the very
+        streams the migration saved. The settle window covers the gap
+        between the engine thread queueing a migrate event and the
+        handler thread entering its relay. No-op unless a migrate
+        drain actually started."""
+        if not self._migrating.is_set():
+            return
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        quiet_t = time.monotonic()
+        while time.monotonic() < deadline:
+            with self._relay_lock:
+                busy = self._relays > 0
+            now = time.monotonic()
+            if busy:
+                quiet_t = now
+            elif now - quiet_t >= 0.25:
+                return
+            time.sleep(0.05)
 
     def close(self) -> None:
         try:
@@ -256,11 +306,21 @@ class ApiServer:
 
 
 def start_api_server(scheduler, status_fn=None, bind: str = "127.0.0.1",
-                     port: int = 0, model_id: str = "cake-tpu") -> ApiServer:
+                     port: int = 0, model_id: str = "cake-tpu",
+                     on_drain=None) -> ApiServer:
     """Build + start an :class:`ApiServer`; returns it with ``.port``
     bound (``port=0`` picks an ephemeral one)."""
     return ApiServer(scheduler, status_fn=status_fn, bind=bind, port=port,
-                     model_id=model_id).start()
+                     model_id=model_id, on_drain=on_drain).start()
+
+
+def _iter_sse(resp):
+    """Yield each SSE frame's data payload (str) from a sibling's
+    streaming HTTP response."""
+    for line in resp:
+        line = line.strip()
+        if line.startswith(b"data: "):
+            yield line[6:].decode()
 
 
 def _make_handler(server: ApiServer):
@@ -360,7 +420,11 @@ def _make_handler(server: ApiServer):
 
         # -- POST: completions --------------------------------------------
         def do_POST(self):  # noqa: N802 (stdlib casing)
-            if self.path.rstrip("/") != "/v1/completions":
+            path = self.path.rstrip("/")
+            if path == "/v1/fleet/drain":
+                self._fleet_drain()
+                return
+            if path != "/v1/completions":
                 self._error(404, f"no route for POST {self.path}")
                 return
             try:
@@ -376,6 +440,9 @@ def _make_handler(server: ApiServer):
             except ValueError as e:
                 self._error(400, str(e))
                 return
+            # kept so a drain can re-submit this request to a sibling
+            # if it re-homes the session mid-flight (ISSUE 19)
+            sess.raw_body = body
             # request-scoped trace context: honor the client/gateway's
             # traceparent (or mint one), and judge completed requests
             # against the replica's SLO targets, if any
@@ -473,6 +540,12 @@ def _make_handler(server: ApiServer):
                 _, status, message = ev
                 self._error(status, message)
                 return
+            if ev[0] == "migrate":
+                # drain re-home: the sibling re-runs prefill+handoff
+                # from the original body; its answer (the decode-side
+                # xfer id) relays as-is
+                self._migrate_unary(sess, None, ev[2])
+                return
             if ev[0] != "handoff":  # e.g. a deadline fired mid-prefill
                 self._error(504, f"prefill did not complete ({ev[0]}); "
                                  "re-prefill")
@@ -505,6 +578,159 @@ def _make_handler(server: ApiServer):
                 "prompt_tokens": len(sess.prompt_ids),
                 "snapshot_bytes": len(payload),
             })
+
+        def _fleet_drain(self) -> None:
+            """Gateway-initiated rolling restart (ISSUE 19): begin a
+            drain that re-homes live sessions to the sibling named in
+            ``migrate_to`` (absent = classic drain). The ack is written
+            before the process-exit hook fires so the caller always
+            sees it."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._error(400, f"bad JSON body: {e}")
+                return
+            target = body.get("migrate_to") if isinstance(body, dict) \
+                else None
+            if target is not None and not (
+                    isinstance(target, dict)
+                    and isinstance(target.get("addr"), str)):
+                self._error(400, "'migrate_to' must be "
+                                 "{\"addr\": \"host:port\", ...}")
+                return
+            if target is not None:
+                server._migrating.set()
+            n = scheduler.migrate_out(target)
+            self._json(200, {"ok": True, "draining": True, "migrating": n})
+            if server.on_drain is not None:
+                server.on_drain()
+
+        def _migrate_post(self, sess, payload, target):
+            """Ship the KV snapshot (if any) to the sibling's transfer
+            channel and re-submit the original request there as a
+            resume. Falls back to a plain full re-run when the snapshot
+            cannot be delivered — decoding is deterministic, so the
+            sibling reproduces the same stream either way. Returns
+            ``(conn, response)``; the caller owns both."""
+            import http.client
+
+            from cake_tpu.disagg import (
+                TransferError,
+                peek_xfer_id,
+                send_snapshot,
+            )
+
+            body = dict(sess.raw_body or {})
+            # a queued resume's import was aborted with the drain; the
+            # sibling re-prefills from the prompt the body still carries
+            body.pop("_resume", None)
+            if payload is not None:
+                body.pop("_disagg", None)
+                try:
+                    xfer = target.get("transfer")
+                    if not isinstance(xfer, str):
+                        raise TransferError(
+                            "sibling advertises no transfer channel")
+                    host, _, port = xfer.rpartition(":")
+                    scheduler.xfer_out_enter()
+                    try:
+                        send_snapshot(
+                            host, int(port), payload,
+                            deadline_s=scheduler.transfer_deadline_s,
+                            trace=sess.reqtrace)
+                    finally:
+                        scheduler.xfer_out_exit()
+                    body["_resume"] = {"xfer_id": peek_xfer_id(payload)}
+                except TransferError as e:
+                    log.warning("drain snapshot ship failed (%s); the "
+                                "sibling re-runs request %s in full",
+                                e, sess.id)
+            host, _, port = target["addr"].rpartition(":")
+            raw = json.dumps(body).encode()
+            headers = {"Content-Type": "application/json"}
+            if sess.reqtrace is not None:
+                headers[obs_reqtrace.HEADER] = sess.reqtrace.header()
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=30.0)
+            conn.request("POST", "/v1/completions", raw, headers)
+            return conn, conn.getresponse()
+
+        def _migrate_stream(self, sess, payload, target,
+                            index: int) -> None:
+            """Splice the sibling's stream onto this connection: the
+            sibling re-emits the WHOLE stream (resume replay), so the
+            first ``index`` token frames — already delivered here — are
+            skipped and the rest flow through, making the client's view
+            bit-identical to an uninterrupted run. On failure before
+            the first relayed byte the connection just closes: the
+            gateway has not committed the response (it withholds the
+            head until the first body byte) and retries transparently
+            against a healthy sibling."""
+            server._relay_enter()
+            wrote = False
+            conn = None
+            try:
+                conn, resp = self._migrate_post(sess, payload, target)
+                if resp.status != 200:
+                    raise OSError(f"sibling answered {resp.status}")
+                for data in _iter_sse(resp):
+                    if data == "[DONE]":
+                        self.wfile.write(sse_event("[DONE]"))
+                        self.wfile.flush()
+                        return
+                    frame = json.loads(data)
+                    if frame.get("error") is not None:
+                        raise OSError(
+                            f"sibling stream failed: {frame['error']}")
+                    if frame.get("done"):
+                        frame["id"] = sess.id
+                    elif frame.get("index", 0) < index:
+                        continue  # already delivered by this replica
+                    self.wfile.write(sse_event(frame))
+                    self.wfile.flush()
+                    wrote = True
+                raise OSError("sibling stream ended without [DONE]")
+            except Exception as e:
+                log.warning("migrate relay for %s failed: %s", sess.id, e)
+                if wrote or index > 0:
+                    # mid-stream: the response is committed — the best
+                    # remaining option is an explicit error frame
+                    try:
+                        self.wfile.write(sse_event(
+                            {"id": sess.id, "status": 502,
+                             "error": f"migration relay failed: {e}"}))
+                        self.wfile.flush()
+                    except OSError:
+                        pass
+            finally:
+                if conn is not None:
+                    conn.close()
+                server._relay_exit()
+
+        def _migrate_unary(self, sess, payload, target) -> None:
+            """Re-run/resume on the sibling and relay its answer under
+            the original request id. Nothing has been written to this
+            client yet, so a failure just closes the connection — the
+            gateway retries uncommitted responses transparently."""
+            server._relay_enter()
+            conn = None
+            try:
+                conn, resp = self._migrate_post(sess, payload, target)
+                out = json.loads(resp.read())
+                if resp.status != 200:
+                    raise OSError(f"sibling answered {resp.status}: {out}")
+                if "id" in out:
+                    out["id"] = sess.id
+                self._json(200, out)
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+            except Exception as e:
+                log.warning("migrate relay for %s failed: %s", sess.id, e)
+            finally:
+                if conn is not None:
+                    conn.close()
+                server._relay_exit()
 
         def _next_event(self, sess):
             """Block on the session queue, but never past a dead engine
@@ -548,6 +774,10 @@ def _make_handler(server: ApiServer):
                         self.wfile.write(sse_event("[DONE]"))
                         self.wfile.flush()
                         return
+                    elif ev[0] == "migrate":
+                        _, payload, target = ev
+                        self._migrate_stream(sess, payload, target, index)
+                        return
                     else:  # error
                         _, status, message = ev
                         self.wfile.write(sse_event(
@@ -568,6 +798,11 @@ def _make_handler(server: ApiServer):
                 if ev[0] == "token":
                     if ev[2]:
                         texts.append(ev[2])
+                elif ev[0] == "migrate":
+                    # the sibling re-runs the whole request; its full
+                    # answer supersedes the tokens collected so far
+                    self._migrate_unary(sess, ev[1], ev[2])
+                    return
                 elif ev[0] == "done":
                     _, reason, usage, tail = ev
                     if tail:

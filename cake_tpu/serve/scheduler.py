@@ -55,6 +55,10 @@ ROLES = ("mixed", "prefill", "decode")
 # field the gateway's tier map reads
 _INFLIGHT = obs_metrics.gauge("disagg.inflight")
 
+# sessions re-homed to a sibling replica by a drain (ISSUE 19 rolling
+# restarts): queued ones re-run whole, admitted ones ride a KV snapshot
+MIGRATED = obs_metrics.counter("serve.migrated_sessions")
+
 
 class QueueFull(Exception):
     """Admission queue at capacity; ``retry_after_s`` is the backpressure
@@ -95,6 +99,7 @@ class Scheduler:
         "_imports_meta": "_cond",
         "_xfer_out": "_cond",
         "_engine_stats": "_cond",
+        "_migrate_to": "_cond",
     }
 
     # Thread domains, machine-checked by cakelint CK-THREAD: the class
@@ -113,7 +118,8 @@ class Scheduler:
         "submit", "cancel", "stop", "close", "encode_prompt",
         "submit_import", "abort_import", "import_meta",
         "xfer_out_enter", "xfer_out_exit", "kv_transfers_inflight",
-        "retry_after_s", "stats", "_sync_inflight",
+        "retry_after_s", "stats", "_sync_inflight", "migrate_out",
+        "can_migrate",
     )
 
     def __init__(self, engine, queue_depth: int = 64,
@@ -161,6 +167,9 @@ class Scheduler:
         self._import_inbox: deque = deque()
         self._imports_meta: dict[str, dict] = {}
         self._xfer_out = 0
+        # drain migration target ({"addr", "transfer"}): set by
+        # migrate_out, consumed by the engine thread's _migrate_all
+        self._migrate_to: dict | None = None
         self._last_sweep = time.monotonic()
         # engine-stats snapshot for handler threads: the engine thread
         # refreshes it every loop pass, so stats()/healthz never walk
@@ -271,6 +280,31 @@ class Scheduler:
         sess.cancelled.set()
         with self._cond:
             self._cond.notify_all()
+
+    def can_migrate(self) -> bool:
+        """Admitted streams can ride a KV snapshot to a sibling (the
+        disagg export plane). Queued sessions re-home regardless."""
+        return bool(hasattr(self.engine, "export_stream")
+                    and getattr(self.engine, "paged", False))
+
+    def migrate_out(self, target: dict | None) -> int:
+        """Begin a drain that RE-HOMES live sessions instead of making
+        clients wait it out (ISSUE 19 rolling restarts): stop admitting,
+        and ask the engine thread to hand every live session to its
+        handler with a migration target — queued sessions re-run whole
+        on the sibling, admitted ones export their stream via the
+        existing disagg snapshot path. ``target`` is ``{"addr":
+        "host:port", "transfer": "host:port"}`` (None = classic drain:
+        in-flight streams finish here). Returns the number of sessions
+        that will migrate."""
+        with self._cond:
+            self._draining = True
+            n = 0
+            if target is not None and isinstance(target.get("addr"), str):
+                self._migrate_to = dict(target)
+                n = len(self._queue) + len(self._by_sid)
+            self._cond.notify_all()
+        return n
 
     # -- KV-transfer plane (cake_tpu/disagg) ----------------------------------
     def submit_import(self, payload: bytes, timeout_s: float = 10.0) -> dict:
@@ -453,6 +487,7 @@ class Scheduler:
     # -- engine thread --------------------------------------------------------
     def _has_work_locked(self) -> bool:
         return bool(self._queue or self._by_sid or self._import_inbox
+                    or self._migrate_to is not None
                     or self.engine.pending_admissions())
 
     def _run(self) -> None:
@@ -502,6 +537,11 @@ class Scheduler:
             try:
                 self._drain_import_inbox()
                 self._sweep_imports()
+                if self._migrate_all():
+                    # the slot set just went empty: skip the engine step
+                    # and let the top-of-loop drain check park/exit
+                    self._refresh_engine_stats(best_effort=True)
+                    continue
                 self._admit()
                 row = self.engine.step()
                 steps += 1
@@ -538,7 +578,14 @@ class Scheduler:
             if s.cancelled.is_set():
                 _session.CANCELLED.inc()
             elif self._draining:
-                s.fail(503, "server is draining; retry against a peer")
+                if self._migrate_to is not None:
+                    # drain with a sibling: re-home instead of refusing —
+                    # nothing was emitted yet, so the session re-runs
+                    # whole over there and the client sees one stream
+                    s.migrate_ready(None, self._migrate_to)
+                    MIGRATED.inc()
+                else:
+                    s.fail(503, "server is draining; retry against a peer")
             elif s.deadline is not None and now > s.deadline:
                 _session.TIMEOUTS.inc()
                 s.fail(504, "deadline expired while queued")
@@ -690,6 +737,46 @@ class Scheduler:
         with self._cond:
             self._by_sid.pop(sid, None)
         sess.handoff_ready(payload)
+
+    def _migrate_all(self) -> bool:
+        """Engine thread: drain-migrate every admitted session to the
+        sibling named by migrate_out (ISSUE 19 rolling restarts). Each
+        live stream's KV exports via the disagg snapshot path when the
+        engine supports it; the payload (or None — the sibling re-runs
+        the whole request) rides the session's event queue to the
+        handler thread, which ships it and splices the sibling's stream
+        onto the client connection (serve/api._migrate_relay). Returns
+        True when a migration pass ran — the run loop then skips the
+        engine step, since the slot set just went empty."""
+        with self._cond:
+            target = self._migrate_to
+            if target is None:
+                return False
+            self._migrate_to = None
+        # finished/cancelled sessions close out normally first (tail
+        # flush, counters) so only live streams ride the migration
+        self._retire()
+        with self._cond:
+            items = list(self._by_sid.items())
+        exportable = self.can_migrate()
+        for sid, sess in items:
+            payload = None
+            # handoff sessions re-run their prefill+handoff on the
+            # sibling from the original body; no snapshot to carry
+            if exportable and sess.handoff is None:
+                try:
+                    payload = self.engine.export_stream(
+                        sid, codec=self.transfer_codec)
+                except Exception:
+                    log.exception("drain export of stream %d failed; "
+                                  "sibling re-runs the request", sid)
+                    payload = None
+            self.engine.finish(sid)
+            with self._cond:
+                self._by_sid.pop(sid, None)
+            sess.migrate_ready(payload, target)
+            MIGRATED.inc()
+        return True
 
     def _slot_of(self, sid: int) -> int | None:
         for i, s in enumerate(self.engine.streams):

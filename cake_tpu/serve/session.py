@@ -101,6 +101,10 @@ class Session:
         # the transfer id from ``_resume``)
         self.handoff: dict | None = None
         self.resume_xfer: str | None = None
+        # fleet drain migration (ISSUE 19): the original parsed request
+        # body, kept so a drain can re-home this session to a sibling
+        # replica (the resume request re-sends the same parameters)
+        self.raw_body: dict | None = None
         # scheduler-owned identity/state
         self.stream_id: int | None = None  # engine stream id once admitted
         self.finish_reason: str | None = None
@@ -314,6 +318,16 @@ class Session:
         over the transfer channel and answers the gateway."""
         self.finish_reason = "handoff"
         self.events.put(("handoff", payload))
+
+    def migrate_ready(self, payload: bytes | None,
+                      target: dict) -> None:
+        """A drain is re-homing this session (engine thread): the
+        handler thread ships the snapshot (``payload``; None for a
+        still-queued session — the sibling just re-runs the request)
+        and splices the sibling's stream into the client's connection
+        (ISSUE 19 rolling restarts)."""
+        self.finish_reason = "migrate"
+        self.events.put(("migrate", payload, target))
 
     # -- stats ----------------------------------------------------------------
     @property

@@ -36,6 +36,15 @@ seed to a schedule deterministically, so "the run that failed under
 ``--chaos seed=1337``" is reproducible from its seed alone, in CI or at a
 dev box. Applied faults are recorded in :attr:`ChaosProxy.events` for
 assertions and post-mortems.
+
+Control-plane chaos (ISSUE 19): the same seeded-schedule discipline,
+aimed at the gateway's *membership* plane instead of the data path.
+:class:`ControlFault` / :func:`control_schedule_from_seed` /
+:class:`ControlPlaneChaos` drive registration storms, heartbeat flaps,
+stale deregisters, and gateway restarts against a live fleet — the
+invariants (idempotent duplicate registration, demote-don't-delete
+leases, membership re-forming from heartbeats within one interval) get
+reproducible triggers exactly like the wire faults above.
 """
 
 from __future__ import annotations
@@ -304,6 +313,135 @@ class ChaosProxy:
                 dst.sendall(header + payload + crc)
         except (ConnectionError, OSError):
             pair.close()
+
+
+# -- control-plane chaos (ISSUE 19) ------------------------------------------
+
+CONTROL_FAULT_KINDS = ("storm", "flap", "stale_dereg", "dup_register",
+                       "gw_restart", "none")
+
+
+@dataclasses.dataclass(frozen=True)
+class ControlFault:
+    """One membership-plane fault. ``param`` is a count (``storm``:
+    concurrent registrations, ``flap``: register/deregister cycles,
+    ``dup_register``: sequential duplicates); unused otherwise."""
+
+    kind: str
+    param: int = 0
+
+    def __post_init__(self):
+        if self.kind not in CONTROL_FAULT_KINDS:
+            raise ValueError(f"unknown control fault {self.kind!r} "
+                             f"(know {CONTROL_FAULT_KINDS})")
+
+    def __str__(self) -> str:
+        return f"{self.kind}={self.param}" if self.param else self.kind
+
+
+def control_schedule_from_seed(seed: int, n: int = 4) -> list[ControlFault]:
+    """Seed -> deterministic control-plane fault schedule (same seed,
+    same faults, forever). ``gw_restart`` is opt-in by explicit spec —
+    it needs a restart hook armed — so the drawn kinds are the ones any
+    live gateway can absorb."""
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        kind = rng.choice(("storm", "flap", "stale_dereg", "dup_register"))
+        if kind == "storm":
+            param = rng.randint(20, 100)
+        elif kind == "flap":
+            param = rng.randint(2, 5)
+        elif kind == "dup_register":
+            param = rng.randint(3, 10)
+        else:
+            param = 0
+        out.append(ControlFault(kind, param))
+    return out
+
+
+class ControlPlaneChaos:
+    """Applies :class:`ControlFault` schedules against a live gateway's
+    fleet endpoints (``/v1/fleet/register`` / ``/v1/fleet/deregister``).
+
+    ``gateway`` is the base URL; ``addrs`` the replica addresses to
+    attack with (they should be REAL, serving replicas — the invariants
+    under test are about what happens to live traffic). ``restart_fn``
+    arms ``gw_restart``: it must kill and restart the gateway, returning
+    nothing (the test then asserts membership re-forms from heartbeats).
+    Applied faults land in :attr:`events` for assertions."""
+
+    def __init__(self, gateway: str, addrs: list[str], restart_fn=None):
+        self.gateway = gateway.rstrip("/")
+        self.addrs = list(addrs)
+        self.restart_fn = restart_fn
+        self.events: list[str] = []
+
+    # -- wire helpers --------------------------------------------------------
+    def _post(self, path: str, body: dict) -> dict | None:
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.gateway + path, data=_json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=2) as resp:
+                return _json.loads(resp.read() or b"{}")
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def register(self, addr: str) -> dict | None:
+        return self._post("/v1/fleet/register", {"addr": addr})
+
+    def deregister(self, addr: str) -> dict | None:
+        return self._post("/v1/fleet/deregister", {"addr": addr})
+
+    # -- faults --------------------------------------------------------------
+    def apply(self, fault: ControlFault, addr: str | None = None) -> None:
+        """Apply one fault (round-robins over ``addrs`` when ``addr`` is
+        not pinned)."""
+        addr = addr or self.addrs[len(self.events) % len(self.addrs)]
+        self.events.append(str(fault))
+        log.info("chaos(control): %s against %s", fault, addr)
+        if fault.kind == "storm":
+            # N concurrent re-registrations of ONE backend: the lease
+            # must update in place, never a phantom second entry
+            n = max(2, fault.param or 50)
+            threads = [threading.Thread(target=self.register, args=(addr,),
+                                        daemon=True) for _ in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+        elif fault.kind == "flap":
+            # rapid register/deregister cycles, ending REGISTERED: the
+            # hysteresis must absorb the thrash, and the final register
+            # must clear the deregister pin so traffic routes again
+            for _ in range(max(1, fault.param or 3)):
+                self.deregister(addr)
+                self.register(addr)
+        elif fault.kind == "stale_dereg":
+            # deregister-then-traffic race: a stale goodbye arrives
+            # AFTER the replica already re-registered; the re-register
+            # (fresh lease) must win over the later stale dereg only
+            # until the next renewal — here we end with a renewal so
+            # the member must be routable again within one heartbeat
+            self.deregister(addr)
+            self.register(addr)
+        elif fault.kind == "dup_register":
+            for _ in range(max(2, fault.param or 3)):
+                self.register(addr)
+        elif fault.kind == "gw_restart":
+            if self.restart_fn is None:
+                raise ValueError("gw_restart needs a restart_fn armed")
+            self.restart_fn()
+        # "none": explicit clean slot in a schedule
+
+    def run(self, schedule: list[ControlFault]) -> None:
+        for fault in schedule:
+            self.apply(fault)
 
 
 class _Pair:

@@ -378,6 +378,153 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     }
 
 
+def _spawn_replica(cfg, params, role: str = "mixed",
+                   max_concurrent: int = 2, queue_depth: int = 16,
+                   paged: bool = False, transfer: bool = False):
+    """One tiny in-process serve replica. Returns ``(server, scheduler,
+    transfer_server|None)``. ``paged`` runs the paged-KV engine (needed
+    for any KV movement); ``transfer`` opens the import listener."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+
+    kw = {"kv_layout": "paged", "kv_page_size": 16} if paged else {}
+    gen = BatchGenerator(
+        cfg, params,
+        settings=SamplerSettings(temperature=0.0, repeat_penalty=1.0),
+        **kw)
+    sched = Scheduler(gen, queue_depth=queue_depth, role=role)
+    sched.start(max_concurrent=max_concurrent, warm_prompt_len=8)
+    ts = None
+    if transfer:
+        from cake_tpu.disagg import TransferServer
+
+        ts = TransferServer(sched).start()
+        sched.transfer_port = ts.port
+    return start_api_server(sched), sched, ts
+
+
+class FleetHandle:
+    """A dynamically-registered loopback fleet with live resize (ISSUE
+    19). Replicas join by POSTing the gateway's ``/v1/fleet/register``
+    (no static seeds), :meth:`resize` grows by spawn+register and
+    shrinks through the gateway's ``/v1/fleet/drain/<addr>`` rolling-
+    restart flow — live sessions migrate to a sibling over the
+    KV-transfer plane, so a shrink under load fails zero requests."""
+
+    def __init__(self, gateway, monitor, build_replica):
+        self.gateway = gateway
+        self.monitor = monitor
+        self.url = f"http://127.0.0.1:{gateway.port}"
+        self._build = build_replica
+        self._stacks: list[tuple] = []  # (server, scheduler, xfer)
+        self.events: list[str] = []
+
+    def _post(self, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            self.url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def size(self) -> int:
+        return len(self._stacks)
+
+    def grow(self, k: int) -> None:
+        for _ in range(k):
+            srv, sched, ts = self._build()
+            self._stacks.append((srv, sched, ts))
+            ack = self._post("/v1/fleet/register", {
+                "addr": f"127.0.0.1:{srv.port}",
+                **({"transfer_port": sched.transfer_port}
+                   if sched.transfer_port else {}),
+            })
+            self.events.append(f"grow 127.0.0.1:{srv.port} "
+                               f"-> {ack.get('name')}")
+        # the welcome probe is decisive; give the last joiner a beat
+        deadline = time.monotonic() + 10.0
+        while (len(self.monitor.routable()) < len(self._stacks)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+    def shrink(self, k: int) -> None:
+        for _ in range(k):
+            if len(self._stacks) <= 1:
+                return  # never drain the last replica out from under load
+            srv, sched, ts = self._stacks.pop()
+            addr = f"127.0.0.1:{srv.port}"
+            ack = self._post(f"/v1/fleet/drain/{addr}", {})
+            self.events.append(
+                f"drain {addr} -> migrate_to {ack.get('migrate_to')}")
+            # wait for the replica to run dry (sessions migrated or
+            # finished), then tear it down like a clean process exit
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st = sched.stats()
+                if st["queued"] == 0 and st["running"] == 0:
+                    break
+                time.sleep(0.05)
+            srv.drain(timeout_s=15.0)
+            if ts is not None:
+                ts.stop()
+            sched.close()
+
+    def resize(self, m: int) -> None:
+        """Grow or drain to ``m`` replicas, live."""
+        m = max(1, m)
+        if m > len(self._stacks):
+            self.grow(m - len(self._stacks))
+        elif m < len(self._stacks):
+            self.shrink(len(self._stacks) - m)
+
+    def cleanup(self) -> None:
+        self.gateway.close()
+        self.monitor.stop()
+        for srv, sched, ts in self._stacks:
+            srv.close()
+            if ts is not None:
+                ts.stop()
+            sched.close()
+        self._stacks.clear()
+
+
+def spawn_elastic_fleet(n: int, max_concurrent: int = 2,
+                        queue_depth: int = 16, policy: str = "p2c",
+                        max_seq: int = 128) -> FleetHandle:
+    """The live-resize demo fleet (ISSUE 19): a gateway with ZERO static
+    backends plus ``n`` replicas that join by self-registration. Every
+    replica runs the paged engine with a transfer listener, so a shrink
+    migrates live sessions to a sibling instead of failing them.
+    Returns a :class:`FleetHandle`; call ``.cleanup()`` when done."""
+    import jax
+
+    from cake_tpu.gateway.api import start_gateway
+    from cake_tpu.gateway.health import HealthMonitor
+    from cake_tpu.gateway.policy import make_policy
+    from cake_tpu.models import llama
+    from cake_tpu.models.config import tiny
+
+    cfg = tiny(max_seq_len=max_seq, eos_token_id=-1)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def build_replica():
+        return _spawn_replica(cfg, params, max_concurrent=max_concurrent,
+                              queue_depth=queue_depth, paged=True,
+                              transfer=True)
+
+    monitor = HealthMonitor([], probe_interval=0.5, lease_ttl_s=3.0,
+                            allow_empty=True).start()
+    gateway = start_gateway(monitor, make_policy(policy))
+    handle = FleetHandle(gateway, monitor, build_replica)
+    try:
+        handle.grow(n)
+    except BaseException:
+        handle.cleanup()
+        raise
+    return handle
+
+
 def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
                 policy: str = "p2c", roles: list[str] | None = None,
                 max_seq: int = 128):
@@ -400,10 +547,6 @@ def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
     from cake_tpu.gateway.policy import make_policy
     from cake_tpu.models import llama
     from cake_tpu.models.config import tiny
-    from cake_tpu.ops.sampling import SamplerSettings
-    from cake_tpu.runtime.batch_generator import BatchGenerator
-    from cake_tpu.serve.api import start_api_server
-    from cake_tpu.serve.scheduler import Scheduler
 
     if roles is not None:
         if len(roles) != n:
@@ -419,21 +562,13 @@ def spawn_fleet(n: int, max_concurrent: int = 2, queue_depth: int = 16,
         role = roles[i] if roles is not None else "mixed"
         # tiered fleets run paged engines everywhere (the A/B against a
         # mixed fleet must compare the tier split, not the KV layout)
-        kw = ({"kv_layout": "paged", "kv_page_size": 16}
-              if roles is not None else {})
-        gen = BatchGenerator(
-            cfg, params,
-            settings=SamplerSettings(temperature=0.0, repeat_penalty=1.0),
-            **kw)
-        sched = Scheduler(gen, queue_depth=queue_depth, role=role)
-        sched.start(max_concurrent=max_concurrent, warm_prompt_len=8)
-        if role == "decode":
-            from cake_tpu.disagg import TransferServer
-
-            ts = TransferServer(sched).start()
-            sched.transfer_port = ts.port
+        srv, sched, ts = _spawn_replica(
+            cfg, params, role=role, max_concurrent=max_concurrent,
+            queue_depth=queue_depth, paged=roles is not None,
+            transfer=role == "decode")
+        if ts is not None:
             xfer_servers.append(ts)
-        stacks.append((start_api_server(sched), sched))
+        stacks.append((srv, sched))
     backends = [Backend(f"b{i}", f"127.0.0.1:{srv.port}")
                 for i, (srv, _) in enumerate(stacks)]
     monitor = HealthMonitor(backends, probe_interval=0.5).start()
@@ -519,6 +654,13 @@ def main(argv=None) -> int:
                         "replicas plus a routing gateway and drive the "
                         "gateway (no url needed) — one command exercises "
                         "the whole loopback fleet")
+    p.add_argument("--resize-to", type=int, default=None, dest="resize_to",
+                   metavar="M",
+                   help="with --spawn-backends N: the live-resize demo — "
+                        "grow the fleet to M replicas mid-load (dynamic "
+                        "self-registration, no static seeds) and drain "
+                        "back to N, migrating live sessions to siblings; "
+                        "the run must complete with zero failed requests")
     p.add_argument("--spawn-roles", default=None, dest="spawn_roles",
                    metavar="ROLE,...",
                    help="with --spawn-backends: per-replica roles "
@@ -550,6 +692,14 @@ def main(argv=None) -> int:
                 "--slo-tpot-ms (there is no goodput without a target)")
     if args.url is None and args.spawn_backends is None:
         p.error("a server url is required (or --spawn-backends N)")
+    if args.resize_to is not None:
+        if args.spawn_backends is None:
+            p.error("--resize-to needs --spawn-backends")
+        if args.resize_to < 1:
+            p.error("--resize-to must be >= 1")
+        if args.spawn_roles is not None:
+            p.error("--resize-to drives role-less (mixed) replicas; it "
+                    "is mutually exclusive with --spawn-roles")
     roles = None
     if args.spawn_roles is not None:
         if args.spawn_backends is None:
@@ -561,10 +711,26 @@ def main(argv=None) -> int:
                     f"--spawn-backends {args.spawn_backends}")
     lens = ([int(x) for x in args.prompt_len.split(",") if x.strip()]
             if args.prompt_len else None)
-    url, cleanup = args.url, None
+    url, cleanup, handle, resizer = args.url, None, None, None
     if args.spawn_backends:
-        gateway, cleanup = spawn_fleet(args.spawn_backends, roles=roles)
-        url = args.url or f"http://127.0.0.1:{gateway.port}"
+        if args.resize_to is not None:
+            handle = spawn_elastic_fleet(args.spawn_backends)
+            cleanup = handle.cleanup
+            url = args.url or handle.url
+
+            def _resize_cycle() -> None:
+                # resize up mid-load, then drain back down, still under
+                # load — the zero-failed-requests rolling cycle
+                time.sleep(1.0)
+                handle.resize(args.resize_to)
+                time.sleep(2.0)
+                handle.resize(args.spawn_backends)
+
+            resizer = threading.Thread(target=_resize_cycle, daemon=True)
+            resizer.start()
+        else:
+            gateway, cleanup = spawn_fleet(args.spawn_backends, roles=roles)
+            url = args.url or f"http://127.0.0.1:{gateway.port}"
     try:
         stats = run_load(
             url, args.requests, concurrency=args.concurrency,
@@ -576,10 +742,14 @@ def main(argv=None) -> int:
             slo_ttft_ms=args.slo_ttft_ms, slo_tpot_ms=args.slo_tpot_ms,
         )
     finally:
+        if resizer is not None:
+            resizer.join(timeout=60)
         if cleanup is not None:
             cleanup()
     stats = dict(stats)
     stats.pop("results")
+    if handle is not None:
+        stats["fleet_events"] = handle.events
     print(json.dumps(stats, indent=1))
     if (args.slo_goodput_min is not None
             and stats.get("slo", {}).get("goodput", 0.0)

@@ -1,0 +1,557 @@
+"""Fleet elasticity (ISSUE 19): self-registration, admission shedding,
+rolling restarts with session re-homing, and control-plane chaos.
+
+`make fleet-smoke` acceptance: a gateway started with ZERO static
+backends forms its fleet from `/v1/fleet/register` leases (storm-proof,
+idempotent); an explicit deregister pins the member DRAINING before its
+503s ever start (zero 5xx through the drain window); a lapsed lease
+demotes through the probe hysteresis and is GC'd, never instantly
+deleted; a saturated fleet queues interactive requests briefly and then
+sheds with a fleet-derived Retry-After (batch class sheds immediately);
+a gateway killed and restarted with an empty member list re-forms from
+heartbeat re-registrations within one heartbeat interval; a rolling
+restart migrates in-flight decode streams to a sibling over the
+KV-transfer plane bit-identically; and a live 2->3->2 resize under
+Poisson load completes with zero failed requests.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from cake_tpu.gateway import health as health_mod
+from cake_tpu.gateway.api import start_gateway
+from cake_tpu.gateway.health import (DRAINING, DYNAMIC, STATIC, UP, Backend,
+                                     HealthMonitor)
+from cake_tpu.gateway.policy import make_policy
+from cake_tpu.models import llama
+from cake_tpu.models.config import tiny
+from test_gateway import _StubBackend, _get, _post, _post_sse, _url
+
+_LOAD_OK = {"queued": 0, "running": 0, "max_concurrent": 4}
+
+
+# -- helpers ----------------------------------------------------------------
+
+
+def _fleet_post(gw, path: str, body: dict, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(
+        _url(gw) + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _post_sse_hook(url: str, body: dict, after_n: int, hook,
+                   timeout: float = 120.0):
+    """Stream one request; after ``after_n`` delivered token frames run
+    ``hook()`` once (inline — the server keeps generating into the
+    socket buffer meanwhile), then keep reading to the end."""
+    body = dict(body, stream=True)
+    req = urllib.request.Request(
+        url.rstrip("/") + "/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    events, n_tok, fired = [], 0, False
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        for raw in r:
+            raw = raw.strip()
+            if not raw.startswith(b"data: "):
+                continue
+            data = raw[len(b"data: "):]
+            ev = data.decode() if data == b"[DONE]" else json.loads(data)
+            events.append(ev)
+            if isinstance(ev, dict) and "token" in ev:
+                n_tok += 1
+            if not fired and n_tok >= after_n:
+                fired = True
+                hook()
+    assert fired, f"stream ended after {n_tok} tokens, before the hook"
+    return events
+
+
+def _tokens_of(events):
+    return [e for e in events if isinstance(e, dict) and "token" in e]
+
+
+@pytest.fixture
+def empty_gateway():
+    """Factory: gateway whose fleet starts EMPTY (membership formed
+    purely from registrations); everything torn down at test end."""
+    created = []
+
+    def build(policy="round_robin", **monitor_kw):
+        monitor_kw.setdefault("probe_interval", 0.2)
+        monitor_kw.setdefault("up_after", 1)
+        mon = HealthMonitor([], allow_empty=True, **monitor_kw).start()
+        gw = start_gateway(mon, make_policy(policy),
+                           connect_timeout=1.0, read_timeout=60.0)
+        created.append((gw, mon))
+        return gw, mon
+
+    yield build
+    for gw, mon in created:
+        gw.close()
+        mon.stop()
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    cfg = tiny(max_seq_len=192, eos_token_id=-1)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+# -- lease-plane units ------------------------------------------------------
+
+
+def test_lease_lifecycle_unit():
+    b = Backend("d900", "127.0.0.1:9", registered_via=DYNAMIC)
+    now = 100.0
+    b.lease_renew(0.5, now=now)
+    assert not b.lease_expired(now + 0.4)
+    assert b.lease_expired(now + 0.6)
+    assert b.lease_note_expiry(now + 0.6) is True
+    assert b.lease_note_expiry(now + 0.7) is False  # once per episode
+    b.lease_renew(0.5, now=now + 1.0)  # renewal re-arms the edge
+    assert not b.lease_expired(now + 1.2)
+    assert b.lease_note_expiry(now + 1.6) is True
+    # static seeds hold no lease and are immortal to the GC
+    s = Backend("s900", "127.0.0.1:9")
+    assert s.registered_via == STATIC
+    assert not s.lease_expired(now)
+    assert s.lease_gc_due(now + 9999.0, 0.0) is False
+
+
+def test_deregister_pin_blocks_probe_promotion():
+    """The drain race, distilled: a 200 probe landing AFTER the explicit
+    deregister must not flip the member back UP — only a fresh
+    registration (the replica saying it is back) outranks the goodbye."""
+    b = Backend("d901", "127.0.0.1:9", registered_via=DYNAMIC)
+    b.probe_ok(_LOAD_OK, 1)
+    assert b.routable()
+    b.mark_deregistered()
+    assert b.state == DRAINING
+    for _ in range(3):
+        b.probe_ok(_LOAD_OK, 1)
+    assert b.state == DRAINING, "a probe promoted a deregistered member"
+    b.lease_renew(5.0)
+    b.probe_ok(_LOAD_OK, 1)
+    assert b.routable()
+
+
+# -- registration plane over HTTP -------------------------------------------
+
+
+def test_register_ack_routing_and_healthz_entry(empty_gateway):
+    gw, mon = empty_gateway(lease_ttl_s=5.0)
+    stub = _StubBackend("ok")
+    try:
+        ack = _fleet_post(gw, "/v1/fleet/register", {"addr": stub.addr})
+        assert ack["ok"] is True and ack["state"] == UP
+        assert ack["name"].startswith("d")
+        assert ack["lease_ttl_s"] == 5.0
+        # the gateway dictates the heartbeat cadence: inside the TTL
+        assert 0.2 <= ack["heartbeat_s"] < ack["lease_ttl_s"]
+
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+        assert out["usage"]["completion_tokens"] == 2
+        assert stub.completions == 1
+
+        health = _get(_url(gw) + "/healthz")
+        entry = health["backends"][ack["name"]]
+        assert entry["state"] == UP
+        assert entry["registered_via"] == "dynamic"
+        assert entry["lease_expires_in_s"] is not None
+        assert 0 < entry["lease_expires_in_s"] <= 5.0
+        assert entry["last_probe_age_s"] is not None
+
+        # draining an unknown member is a loud 404, not a silent no-op
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _fleet_post(gw, "/v1/fleet/drain/127.0.0.1:1", {})
+        assert exc.value.code == 404
+    finally:
+        stub.close()
+
+
+def test_static_seed_and_dynamic_member_coexist(empty_gateway):
+    """--backends stays as static seeds: no lease, never expires, never
+    GC'd — and /healthz tells the two membership origins apart."""
+    seed = _StubBackend("ok")
+    mon = HealthMonitor([Backend("seed0", seed.addr)], probe_interval=0.2,
+                        up_after=1, lease_ttl_s=5.0).start()
+    gw = start_gateway(mon, make_policy("round_robin"),
+                       connect_timeout=1.0, read_timeout=60.0)
+    dyn = _StubBackend("ok")
+    try:
+        ack = _fleet_post(gw, "/v1/fleet/register", {"addr": dyn.addr})
+        health = _get(_url(gw) + "/healthz")
+        assert health["backends"]["seed0"]["registered_via"] == "static"
+        assert health["backends"]["seed0"]["lease_expires_in_s"] is None
+        assert health["backends"][ack["name"]]["registered_via"] == "dynamic"
+        assert health["backends_up"] == 2
+    finally:
+        gw.close()
+        mon.stop()
+        seed.close()
+        dyn.close()
+
+
+def test_registration_storm_is_idempotent(empty_gateway):
+    """Satellite: 100 concurrent re-registrations of ONE backend update
+    one lease in place — never a phantom second member."""
+    gw, mon = empty_gateway()
+    stub = _StubBackend("ok")
+    try:
+        reg0 = health_mod.REGISTRATIONS.value
+        acks: list = []
+
+        def hit():
+            try:
+                acks.append(_fleet_post(gw, "/v1/fleet/register",
+                                        {"addr": stub.addr}, timeout=30.0))
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                acks.append(e)
+
+        threads = [threading.Thread(target=hit) for _ in range(100)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        oks = [a for a in acks if isinstance(a, dict) and a.get("ok")]
+        assert len(oks) == 100, f"storm lost acks: {acks}"
+        assert len({a["name"] for a in oks}) == 1  # one identity
+        assert [b.addr for b in mon.backends] == [stub.addr]
+        assert health_mod.REGISTRATIONS.value - reg0 >= 100
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+        assert out["usage"]["completion_tokens"] == 2
+    finally:
+        stub.close()
+
+
+def test_drain_window_zero_503s(empty_gateway):
+    """Satellite: the deregister lands BEFORE the replica's 503s start.
+    Probes are parked far away (30 s), so only the explicit deregister
+    can save the probe-race window — zero failed requests through it."""
+    gw, mon = empty_gateway(probe_interval=30.0)
+    a, b = _StubBackend("ok"), _StubBackend("ok")
+    try:
+        _fleet_post(gw, "/v1/fleet/register", {"addr": a.addr})
+        _fleet_post(gw, "/v1/fleet/register", {"addr": b.addr})
+        # replica A announces its exit, THEN starts failing
+        _fleet_post(gw, "/v1/fleet/deregister", {"addr": a.addr})
+        a.mode = "draining"
+        for _ in range(8):
+            out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+            assert out["usage"]["completion_tokens"] == 2
+        assert a.completions == 0, "a request routed into the exit"
+        assert b.completions == 8
+        assert mon.lookup(a.addr).state == DRAINING
+        # stale deregister of an unknown member: harmless no-op
+        ack = _fleet_post(gw, "/v1/fleet/deregister",
+                          {"addr": "127.0.0.1:1"})
+        assert ack["ok"] is True and ack["known"] is False
+        # ...and the replica comes back by simply re-registering
+        a.mode = "ok"
+        _fleet_post(gw, "/v1/fleet/register", {"addr": a.addr})
+        assert mon.lookup(a.addr).routable()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_lease_expiry_demotes_then_gc_reaps(empty_gateway):
+    """A crashed replica (no heartbeat, no probe answer): the lease
+    expiry demotes through the hysteresis, and only after a full GC
+    window does the member leave the list entirely."""
+    gw, mon = empty_gateway(lease_ttl_s=0.5, lease_gc_s=0.3,
+                            probe_interval=0.1, down_after=2)
+    stub = _StubBackend("ok")
+    exp0 = health_mod.LEASE_EXPIRED.value
+    try:
+        _fleet_post(gw, "/v1/fleet/register", {"addr": stub.addr})
+        assert len(mon.routable()) == 1
+    finally:
+        stub.close()  # crash: probes fail AND renewals stop
+    deadline = time.time() + 20
+    while time.time() < deadline and mon.backends:
+        time.sleep(0.05)
+    assert not mon.backends, "expired member was never GC'd"
+    assert health_mod.LEASE_EXPIRED.value > exp0
+
+
+# -- admission control ------------------------------------------------------
+
+
+def test_admission_queue_rides_out_brief_saturation():
+    """A 429 that will clear within the admission budget: the request
+    queues (gateway.queued_admissions moves) and then completes — no
+    client-visible 429 for a blip."""
+    from cake_tpu.gateway import api as gw_api
+
+    flaky = _StubBackend("flaky429", retry_after="1")
+    mon = HealthMonitor([Backend("adm0", flaky.addr)], probe_interval=30.0,
+                        up_after=1).start()
+    gw = start_gateway(mon, make_policy("round_robin"), connect_timeout=1.0,
+                       read_timeout=60.0, admit_wait_s=3.0)
+    try:
+        q0 = gw_api.QUEUED_ADMISSIONS.value
+        t0 = time.monotonic()
+        out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+        wall = time.monotonic() - t0
+        assert out["usage"]["completion_tokens"] == 2
+        assert gw_api.QUEUED_ADMISSIONS.value > q0
+        assert wall >= 0.8, f"never actually waited ({wall:.2f}s)"
+        assert flaky.completions == 1  # exactly one 429, then served
+    finally:
+        gw.close()
+        mon.stop()
+        flaky.close()
+
+
+def test_batch_class_sheds_immediately():
+    """"class": "batch" is the load to shed first: no admission queue,
+    an instant fleet-derived 429 with shed marker."""
+    from cake_tpu.gateway import api as gw_api
+
+    sat = _StubBackend("reject429", retry_after="9")
+    mon = HealthMonitor([Backend("adm1", sat.addr)], probe_interval=30.0,
+                        up_after=1).start()
+    gw = start_gateway(mon, make_policy("round_robin"), connect_timeout=1.0,
+                       read_timeout=60.0, admit_wait_s=5.0)
+    try:
+        q0 = gw_api.QUEUED_ADMISSIONS.value
+        shed0 = gw_api.SHED.value
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2,
+                             "class": "batch"})
+        wall = time.monotonic() - t0
+        assert exc.value.code == 429
+        body = json.loads(exc.value.read())
+        assert body["shed"] is True
+        assert 1 <= body["retry_after_s"] <= 30
+        assert int(exc.value.headers["Retry-After"]) == body["retry_after_s"]
+        assert wall < 2.0, "batch class rode the admission queue"
+        assert gw_api.QUEUED_ADMISSIONS.value == q0
+        assert gw_api.SHED.value > shed0
+    finally:
+        gw.close()
+        mon.stop()
+        sat.close()
+
+
+# -- gateway restart + control-plane chaos ----------------------------------
+
+
+def test_gateway_restart_reforms_fleet_from_heartbeats():
+    """Satellite: kill the gateway mid-fleet, restart it with an EMPTY
+    member list on the same port — heartbeat re-registrations re-form
+    the whole fleet within about one heartbeat interval, and a retrying
+    client sails through the blip."""
+    from cake_tpu.serve.register import Registrar
+    from cake_tpu.testing.chaos import ControlFault, ControlPlaneChaos
+
+    def _mon():
+        return HealthMonitor([], probe_interval=0.2, up_after=1,
+                             lease_ttl_s=0.9, allow_empty=True).start()
+
+    a, b = _StubBackend("ok"), _StubBackend("ok")
+    state = {"mon": _mon()}
+    state["gw"] = start_gateway(state["mon"], make_policy("round_robin"),
+                                connect_timeout=1.0, read_timeout=60.0)
+    port = state["gw"].port
+    url = f"http://127.0.0.1:{port}"
+    # ack-driven cadence: lease_ttl 0.9 -> the gateway asks for 0.3 s
+    regs = [Registrar(url, s.addr, heartbeat_s=0.25).start()
+            for s in (a, b)]
+
+    def restart():
+        state["gw"].close()
+        state["mon"].stop()
+        state["mon"] = _mon()
+        state["gw"] = start_gateway(state["mon"],
+                                    make_policy("round_robin"),
+                                    port=port, connect_timeout=1.0,
+                                    read_timeout=60.0)
+
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(state["mon"].routable()) < 2:
+            time.sleep(0.02)
+        assert len(state["mon"].routable()) == 2
+
+        ControlPlaneChaos(url, [a.addr, b.addr],
+                          restart_fn=restart).apply(
+                              ControlFault("gw_restart"))
+        t0 = time.monotonic()
+        deadline = time.time() + 10
+        while time.time() < deadline and len(state["mon"].routable()) < 2:
+            time.sleep(0.02)
+        reform_s = time.monotonic() - t0
+        assert len(state["mon"].routable()) == 2, \
+            "fleet never re-formed after the gateway restart"
+        assert reform_s < 2.0, (  # one 0.3 s heartbeat, with slack
+            f"re-form took {reform_s:.2f}s — longer than a heartbeat")
+        out = None
+        for _ in range(50):  # the client's view: retry through the blip
+            try:
+                out = _post(url, {"prompt_ids": [1], "max_tokens": 2})
+                break
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.1)
+        assert out is not None and out["usage"]["completion_tokens"] == 2
+
+        # graceful leave: deregister stops the heartbeat AND the routing
+        regs[0].deregister()
+        assert state["mon"].lookup(a.addr).state == DRAINING
+        time.sleep(0.8)  # >2 heartbeats: no zombie renewal re-joins it
+        assert state["mon"].lookup(a.addr).state == DRAINING
+    finally:
+        for r in regs:
+            r.stop()
+        state["gw"].close()
+        state["mon"].stop()
+        a.close()
+        b.close()
+
+
+def test_control_plane_chaos_matrix(empty_gateway):
+    """The seeded fault schedule (storms, flaps, stale deregisters,
+    duplicate registrations) against a live gateway: membership stays
+    sane — exactly the real members, all routable, zero 5xx after."""
+    from cake_tpu.testing.chaos import (ControlFault, ControlPlaneChaos,
+                                        control_schedule_from_seed)
+
+    schedule = control_schedule_from_seed(19, n=6)
+    assert ([str(f) for f in schedule]
+            == [str(f) for f in control_schedule_from_seed(19, n=6)])
+    with pytest.raises(ValueError):
+        ControlFault("fork_bomb")
+    with pytest.raises(ValueError):
+        ControlPlaneChaos("http://127.0.0.1:1", ["127.0.0.1:1"]).apply(
+            ControlFault("gw_restart"))  # needs a restart_fn armed
+
+    gw, mon = empty_gateway(lease_ttl_s=2.0)
+    a, b = _StubBackend("ok"), _StubBackend("ok")
+    try:
+        for s in (a, b):
+            _fleet_post(gw, "/v1/fleet/register", {"addr": s.addr})
+        chaos = ControlPlaneChaos(_url(gw), [a.addr, b.addr])
+        chaos.run(schedule)
+        assert chaos.events == [str(f) for f in schedule]
+        deadline = time.time() + 10
+        while time.time() < deadline and len(mon.routable()) < 2:
+            time.sleep(0.05)
+        assert len(mon.routable()) == 2
+        # no phantom members survived the storm/flap/dup barrage
+        assert sorted(x.addr for x in mon.backends) == sorted(
+            [a.addr, b.addr])
+        for _ in range(6):
+            out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
+            assert out["usage"]["completion_tokens"] == 2
+        assert a.completions + b.completions == 6
+    finally:
+        a.close()
+        b.close()
+
+
+# -- rolling restart with live migration (real engines) ---------------------
+
+
+def test_rolling_restart_migrates_stream_bit_identical(tiny_params):
+    """The tentpole acceptance: drain a replica mid-stream through the
+    gateway — the in-flight decode stream migrates to the sibling over
+    the KV-transfer plane and the client's spliced stream is
+    bit-identical to an uninterrupted run."""
+    from cake_tpu.serve import scheduler as scheduler_mod
+    from cake_tpu.tools.loadgen import _spawn_replica
+
+    cfg, params = tiny_params
+    srv_a, sched_a, ts_a = _spawn_replica(cfg, params, paged=True,
+                                          transfer=True)
+    srv_b, sched_b, ts_b = _spawn_replica(cfg, params, paged=True,
+                                          transfer=True)
+    addr_a, addr_b = (f"127.0.0.1:{srv_a.port}", f"127.0.0.1:{srv_b.port}")
+    mon = HealthMonitor([], probe_interval=0.3, up_after=1, lease_ttl_s=5.0,
+                        allow_empty=True).start()
+    gw = start_gateway(mon, make_policy("round_robin"),
+                       connect_timeout=1.0, read_timeout=120.0)
+    body = {"prompt_ids": [3, 1, 4, 1, 5, 9, 2, 6], "max_tokens": 120}
+    try:
+        _fleet_post(gw, "/v1/fleet/register",
+                    {"addr": addr_a, "transfer_port": ts_a.port})
+        # baseline: replica A alone, uninterrupted
+        base_events, _ = _post_sse(_url(gw), body)
+        base_tokens = _tokens_of(base_events)
+        assert len(base_tokens) == 120
+
+        migrated0 = scheduler_mod.MIGRATED.value
+        acks = {}
+
+        def drain_a():
+            _fleet_post(gw, "/v1/fleet/register",
+                        {"addr": addr_b, "transfer_port": ts_b.port})
+            acks["drain"] = _fleet_post(gw, f"/v1/fleet/drain/{addr_a}",
+                                        {}, timeout=60.0)
+
+        events = _post_sse_hook(_url(gw), body, after_n=3, hook=drain_a)
+        assert not [e for e in events
+                    if isinstance(e, dict) and e.get("error")]
+        assert events[-1] == "[DONE]"
+        done = [e for e in events if isinstance(e, dict) and e.get("done")]
+        assert len(done) == 1 and done[0]["finish_reason"] == "length"
+        # the spliced stream: every token frame identical to baseline
+        assert _tokens_of(events) == base_tokens
+        assert acks["drain"]["ok"] is True
+        assert acks["drain"]["migrate_to"]["addr"] == addr_b
+        assert scheduler_mod.MIGRATED.value > migrated0, \
+            "the stream never actually migrated"
+        # the drained replica is out of rotation; traffic lands on B
+        out = _post(_url(gw), {"prompt_ids": [1, 2], "max_tokens": 4})
+        assert out["usage"]["completion_tokens"] == 4
+        assert mon.lookup(addr_a).state == DRAINING
+    finally:
+        gw.close()
+        mon.stop()
+        for srv, sched, ts in ((srv_a, sched_a, ts_a),
+                               (srv_b, sched_b, ts_b)):
+            srv.close()
+            ts.stop()
+            sched.close()
+
+
+def test_live_resize_under_load_zero_failures():
+    """The end-state demo: a self-registered fleet grows 2->3 and
+    shrinks back to 2 under open-loop Poisson load — the shrink is a
+    rolling restart through the gateway's drain flow — with zero failed
+    requests."""
+    from cake_tpu.tools.loadgen import run_load, spawn_elastic_fleet
+
+    handle = spawn_elastic_fleet(2, max_concurrent=2, queue_depth=16,
+                                 max_seq=128)
+    try:
+        def cycle():
+            time.sleep(0.5)
+            handle.resize(3)
+            time.sleep(1.0)
+            handle.resize(2)
+
+        resizer = threading.Thread(target=cycle, daemon=True)
+        resizer.start()
+        stats = run_load(handle.url, 24, concurrency=4, max_tokens=8,
+                         rate=12.0, seed=3, stream=True, retry_429=True,
+                         timeout=120.0)
+        resizer.join(timeout=180)
+        assert not resizer.is_alive(), "resize cycle never finished"
+        assert stats["errors"] == 0, f"failed requests: {stats}"
+        assert stats["completed"] == 24, f"incomplete run: {stats}"
+        assert any(e.startswith("grow") for e in handle.events)
+        assert any(e.startswith("drain") for e in handle.events)
+        assert handle.size() == 2
+    finally:
+        handle.cleanup()

@@ -590,7 +590,7 @@ def test_gateway_slo_judges_client_view(stub_gateway):
         ok.close()
 
 
-def test_429_propagates_only_when_every_backend_saturated(stub_gateway):
+def test_429_sheds_only_when_every_backend_saturated(stub_gateway):
     from cake_tpu.gateway import api as gw_api
 
     sat1 = _StubBackend("reject429", retry_after="7")
@@ -603,16 +603,24 @@ def test_429_propagates_only_when_every_backend_saturated(stub_gateway):
         out = _post(_url(gw), {"prompt_ids": [1], "max_tokens": 2})
         assert out["usage"]["completion_tokens"] == 2
 
-        # every replica saturated: NOW the 429 (and its Retry-After)
-        # reaches the client
+        # every replica saturated: admission control waits its bounded
+        # budget, then SHEDS with a Retry-After derived from fleet-wide
+        # tok/s (ISSUE 19) — the backend's own "7" is NOT relayed
         sat0 = gw_api.SATURATED.value
+        shed0 = gw_api.SHED.value
         gw2, _ = stub_gateway([sat1.addr, sat2.addr],
                               policy="round_robin", probe_interval=30.0)
+        gw2.admit_wait_s = 0.2  # keep the bounded wait short here
         with pytest.raises(urllib.error.HTTPError) as exc:
             _post(_url(gw2), {"prompt_ids": [1], "max_tokens": 2})
         assert exc.value.code == 429
-        assert exc.value.headers["Retry-After"] == "7"
+        body = json.loads(exc.value.read())
+        assert body["shed"] is True
+        assert 1 <= body["retry_after_s"] <= 30
+        assert (int(exc.value.headers["Retry-After"])
+                == body["retry_after_s"])
         assert gw_api.SATURATED.value > sat0
+        assert gw_api.SHED.value > shed0
     finally:
         sat1.close()
         sat2.close()
@@ -666,7 +674,11 @@ def test_gateway_healthz_models_status_metrics(stub_gateway):
         gw, _ = stub_gateway([ok.addr])
         health = _get(_url(gw) + "/healthz")
         assert health["ok"] is True and health["backends_up"] == 1
-        assert list(health["backends"].values()) == [UP]
+        entry = next(iter(health["backends"].values()))
+        assert entry["state"] == UP
+        assert entry["registered_via"] == "static"
+        assert entry["lease_expires_in_s"] is None  # static: no lease
+        assert entry["last_probe_age_s"] is not None
         models = _get(_url(gw) + "/v1/models")
         assert models["data"][0]["id"] == "stub"
         status = _get(_url(gw) + "/")
@@ -962,8 +974,18 @@ def test_gateway_cli_validation():
     loud (no silent ignores), without starting a server."""
     from cake_tpu import cli
 
-    with pytest.raises(SystemExit, match="--backends"):
-        cli.main(["--mode", "gateway"])
+    # an empty --backends is VALID since the fleet plane (ISSUE 19) —
+    # membership forms from self-registrations — so the misconfig
+    # guards below are what is left to keep loud
+    with pytest.raises(SystemExit, match="--lease-ttl"):
+        cli.main(["--mode", "gateway", "--lease-ttl", "0"])
+    with pytest.raises(SystemExit, match="--admit-wait"):
+        cli.main(["--mode", "gateway", "--admit-wait", "-1"])
+    with pytest.raises(SystemExit, match="--admit-queue"):
+        cli.main(["--mode", "gateway", "--admit-queue", "0"])
+    with pytest.raises(SystemExit, match="--register-with"):
+        cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
+                  "--register-with", "http://127.0.0.1:2"])
     with pytest.raises(SystemExit, match="--model"):
         cli.main(["--mode", "gateway", "--backends", "127.0.0.1:1",
                   "--model", "x"])
