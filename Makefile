@@ -198,6 +198,21 @@ prof-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_fleet.py -q -m 'not slow'
 
+# SLO-scheduling smoke (ISSUE 20): priority classes, preemption with
+# host-RAM KV spill, per-tenant fairness — interactive jumping a batch
+# flood, preempted streams (greedy/sampled/mid-grammar) resuming
+# bit-identically from the spill store, the spill chaos matrix
+# (resume-storm / spill-store-full / victim-finishes-during-spill),
+# admission deferral counted exactly once under spill pressure, the
+# /v1/batch bulk endpoint, gateway-vs-direct classed-request parity —
+# then the CAKE_BENCH_SLO interactive-TTFT-p95 row: class-aware
+# scheduling must beat the FIFO baseline under the mixed-class flood
+# or the row fails.
+slo-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_slo.py -q -m 'not slow'
+	CAKE_BENCH_SLO=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=16 \
+	  CAKE_BENCH_BATCH=2 JAX_PLATFORMS=cpu $(PY) bench.py
+
 # bench regression gate: newest bench_results.jsonl row per metric vs
 # the best prior run (tools/benchdiff) — nonzero exit past the
 # thresholds, so a perf regression fails CI the way a lint finding does.
@@ -214,7 +229,7 @@ bench-diff:
 # the same engine hot path. Lint runs first: an invariant violation
 # fails faster than any smoke, and the smokes exercise exactly the
 # invariants cakelint pins (ownership, deadlines, lock discipline).
-perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke
+perf-smoke: lint cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke slo-smoke
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_perf_smoke.py \
 	  tests/test_wire_codec.py -q -m 'not slow'
 	CAKE_BENCH_OBS=1 CAKE_BENCH_PRESET=tiny CAKE_BENCH_STEPS=32 \
@@ -234,4 +249,4 @@ clean:
 	rm -f native/*.so native/cake_host_demo
 	find . -name __pycache__ -type d -exec rm -rf {} +
 
-.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke bench-diff perf-smoke deploy clean
+.PHONY: test lint native bench kernel-check flash-sweep int4-sweep ici-probe stage-slice spec-corpus watch ttft trace-smoke cluster-trace-smoke chaos-smoke serve-smoke constrain-smoke gateway-smoke kv-smoke disagg-smoke reqtrace-smoke prof-smoke fleet-smoke slo-smoke bench-diff perf-smoke deploy clean

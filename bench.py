@@ -961,6 +961,97 @@ def _run_gateway_http(config, params, preset, quant, dev, batch,
     return 0
 
 
+def _run_slo(config, params, preset, quant, dev, batch, steps) -> int:
+    """CAKE_BENCH_SLO=1: class-aware scheduling (ISSUE 20) vs FIFO under
+    the mixed-class flood — an interactive trickle (every 4th request)
+    inside a batch flood against ONE paged serve stack, A/B/A/B'd by
+    swapping the scheduler's policy between legs (same warmed engine,
+    same compiled programs — the policy is the only variable). The
+    figure of merit is interactive TTFT p95: under FIFO it is hostage
+    to however many batch requests queued first; under "slo" the
+    arrivals jump the queue and preempt batch victims to host-RAM
+    spill. The row FAILS unless slo beats fifo."""
+    from cake_tpu.ops.sampling import SamplerSettings
+    from cake_tpu.runtime.batch_generator import BatchGenerator
+    from cake_tpu.serve.api import start_api_server
+    from cake_tpu.serve.scheduler import Scheduler
+    from cake_tpu.tools import loadgen
+
+    kv_quant = _kv_quant()
+    batch = max(2, batch)
+    max_tokens = max(4, min(steps, config.max_seq_len - 16))
+    settings = SamplerSettings(temperature=0.0, repeat_penalty=1.0)
+    gen = BatchGenerator(config, params, settings=settings,
+                        kv_quant=kv_quant, kv_layout="paged",
+                        kv_page_size=16)
+    n = 12 * batch  # per leg; every 4th request is interactive
+    sched = Scheduler(gen, queue_depth=2 * n, sched_policy="slo")
+    sched.start(max_concurrent=batch, warm_prompt_len=8)
+    srv = start_api_server(sched)
+    url = f"http://127.0.0.1:{srv.port}"
+    # arrivals must decisively outpace service so the admission queue
+    # builds — a drained queue has nothing for the policy to reorder,
+    # and FIFO only loses when interactive arrivals find a deep queue.
+    # A near-burst guarantees depth regardless of how fast this host
+    # decodes the tiny model.
+    rate = 100.0 * batch
+    ttfts = {"fifo": [], "slo": []}
+    counts = {"fifo": 0, "slo": 0}
+    try:
+        # warm pass: first requests pay decode/admission compiles
+        loadgen.run_load(url, batch, concurrency=batch, max_tokens=4,
+                         prompt_lens=[8], vocab=config.vocab_size - 1,
+                         seed=1)
+        for rep in range(2):  # interleaved A/B/A/B on one warmed stack
+            for policy in ("fifo", "slo"):
+                sched.set_policy(policy)
+                leg = loadgen.run_load(
+                    url, n, max_tokens=max_tokens, prompt_lens=[8],
+                    vocab=config.vocab_size - 1, rate=rate,
+                    seed=3 + rep, workload="mixed-class")
+                if leg["errors"] or leg["completed"] != n:
+                    sys.stderr.write(f"slo bench leg failed "
+                                     f"({policy}): {leg}\n")
+                    return 1
+                counts[policy] += leg["completed"]
+                ttfts[policy] += [
+                    r["ttft_s"] * 1e3
+                    for i, r in enumerate(leg["results"])
+                    if i % 4 == 0 and r and r.get("ttft_s") is not None]
+        st = sched.stats()
+    finally:
+        srv.close()
+        sched.close()
+    fifo_p95 = round(loadgen._percentile(ttfts["fifo"], 0.95), 1)
+    slo_p95 = round(loadgen._percentile(ttfts["slo"], 0.95), 1)
+    ratio = slo_p95 / fifo_p95 if fifo_p95 else 0.0
+    wtag = _wtag(quant, kv_quant)
+    _emit({
+        "metric": (f"slo_interactive_ttft_p95_{_mtag(preset)}_{wtag}_"
+                   f"1chip_c{batch}"),
+        "value": slo_p95,
+        "unit": "ms",
+        "vs_baseline": round(ratio, 4),
+    }, dev,
+        baseline=f"fifo_interactive_ttft_p95_{fifo_p95:.1f}ms",
+        interactive_n=len(ttfts["slo"]),
+        requests=counts["fifo"] + counts["slo"],
+        preemptions=st.get("preemptions", 0),
+        max_tokens=max_tokens, interleaved_reps=2)
+    sys.stderr.write(
+        f"device={dev.device_kind} clients={batch} "
+        f"interactive ttft_p95 fifo={fifo_p95}ms slo={slo_p95}ms "
+        f"ratio={ratio:.3f} preemptions={st.get('preemptions', 0)}\n"
+    )
+    if slo_p95 >= fifo_p95:
+        sys.stderr.write(
+            "slo bench FAILED: class-aware interactive TTFT p95 "
+            f"({slo_p95}ms) must beat the FIFO baseline "
+            f"({fifo_p95}ms)\n")
+        return 1
+    return 0
+
+
 def _run_disagg(config, params, preset, quant, dev, batch, steps) -> int:
     """CAKE_BENCH_DISAGG=1: the disaggregated prefill/decode tiers
     (cake_tpu/disagg) under the interference regime they exist for — the
@@ -1790,6 +1881,9 @@ def main() -> int:
     if os.environ.get("CAKE_BENCH_DISAGG") == "1":
         return _run_disagg(config, params, preset, quant, dev,
                            max(2, batch), steps)
+    if os.environ.get("CAKE_BENCH_SLO") == "1":
+        return _run_slo(config, params, preset, quant, dev,
+                        max(2, batch), steps)
     if os.environ.get("CAKE_BENCH_SPEC"):
         k = int(os.environ["CAKE_BENCH_SPEC"])
         if os.environ.get("CAKE_BENCH_SPEC_CORPUS") == "1":

@@ -25,6 +25,10 @@ Rules in force:
 - **disagg transfer ids**: an ``import_begin`` registration must flow
   into ``import_attach``/``import_abort`` or be stored for the resume
   handler; an orphaned one pins pool pages until the TTL sweep.
+- **spill-store reservations** (ISSUE 20): a ``spill_begin`` claim must
+  reach ``spill_commit`` or ``spill_abort`` on every path — a leaked
+  reservation shrinks the bounded host-RAM store for every later
+  preemption.
 
 What counts as a release (per rule): an explicit release call
 (``x.close()``; ``unref(pid)``/``unpin(pid)`` — including a loop
@@ -140,6 +144,16 @@ CLAIM_RULES = (
         release_funcs=("_admit_exit",),
         hint="release the admission-queue slot in a finally — a leaked "
              "slot shrinks the queue for every later request",
+    ),
+    ClaimRule(
+        rule="serve.spill",
+        style="binding",
+        patterns=(".spill_begin",),
+        release_funcs=("spill_commit", "spill_abort"),
+        exclude=("cake_tpu/serve/spill.py",),
+        hint="commit the spilled payload or abort the claim in an "
+             "except/finally — a leaked reservation shrinks the store "
+             "for every later preemption",
     ),
 )
 

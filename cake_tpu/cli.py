@@ -352,6 +352,29 @@ def build_parser() -> argparse.ArgumentParser:
                         "the codec is lossless for the cache dtype — "
                         "none always, bf16 on a bf16 cache, int8 on an "
                         "int8-quantized pool")
+    p.add_argument("--sched-policy", choices=["slo", "fifo"],
+                   default="slo", dest="sched_policy",
+                   help="--mode serve: admission policy (ISSUE 20) — "
+                        "slo (default): priority classes ('class': "
+                        "interactive|batch on /v1/completions), "
+                        "preemption with host-RAM KV spill, per-tenant "
+                        "fairness; fifo: strict arrival order, no "
+                        "preemption (the single-tenant baseline)")
+    p.add_argument("--spill-mb", type=float, default=64.0,
+                   dest="spill_mb", metavar="MB",
+                   help="--mode serve: host-RAM budget for preempted "
+                        "stream snapshots (default 64; 0 disables "
+                        "preemption — class ordering still applies). "
+                        "Spilling needs the paged engine "
+                        "(--kv-layout paged)")
+    p.add_argument("--fairness-factor", type=float, default=2.0,
+                   dest="fairness_factor", metavar="X",
+                   help="--mode serve: a tenant is over budget when its "
+                        "share of recent tokens exceeds X times its "
+                        "fair share (default 2.0) — over-budget "
+                        "tenants queue behind in-budget arrivals and "
+                        "are preferred preemption victims ('tenant' "
+                        "body field, defaults to the request class)")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    dest="slo_ttft_ms", metavar="MS",
                    help="--mode serve/gateway: per-request time-to-first-"
@@ -727,6 +750,12 @@ def _serve_flags(args) -> list[str]:
         out.append("--slo-ttft-ms")
     if args.slo_tpot_ms is not None:
         out.append("--slo-tpot-ms")
+    if args.sched_policy != "slo":
+        out.append("--sched-policy")
+    if args.spill_mb != 64.0:
+        out.append("--spill-mb")
+    if args.fairness_factor != 2.0:
+        out.append("--fairness-factor")
     return out
 
 
@@ -901,7 +930,10 @@ def run_http_serve(args) -> int:
                               request_timeout_s=request_timeout,
                               role=args.role,
                               transfer_codec=args.transfer_codec,
-                              slo=_slo_tracker(args))
+                              slo=_slo_tracker(args),
+                              sched_policy=args.sched_policy,
+                              spill_mb=args.spill_mb,
+                              fairness_factor=args.fairness_factor)
     except ValueError as e:
         sys.exit(f"error: {e}")
     # warm the masked (constrained-decoding) program too when requests

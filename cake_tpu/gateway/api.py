@@ -721,6 +721,20 @@ def _make_handler(server: GatewayServer):
                 if isinstance(body, dict):
                     key = policy_mod.prefix_key(body,
                                                 server.prefix_block)
+            # class-aware routing (ISSUE 20): batch traffic drains to
+            # the least-loaded replica while interactive keeps the
+            # configured policy (prefix affinity's hot-KV wins matter
+            # for latency, not throughput). The byte-scan keeps the
+            # hot path free of a json.loads unless a class is present;
+            # the body itself forwards untouched either way.
+            is_batch = False
+            if b'"class"' in raw:
+                try:
+                    cbody = json.loads(raw or b"{}")
+                    is_batch = (isinstance(cbody, dict)
+                                and cbody.get("class") == "batch")
+                except ValueError:
+                    pass
             t0 = time.perf_counter()
             # two-stage tiered route (cake_tpu/disagg): when the fleet
             # has both a prefill and a decode tier, prefill runs on one
@@ -763,8 +777,11 @@ def _make_handler(server: GatewayServer):
                                      "retry_after_s": retry_after},
                                {"Retry-After": str(retry_after)})
                     return
-                b = server.policy.choose(cands, key=key, now=now,
-                                         first_attempt=not tried)
+                if is_batch:
+                    b = policy_mod.pick_batch(cands)
+                else:
+                    b = server.policy.choose(cands, key=key, now=now,
+                                             first_attempt=not tried)
                 tried.append(b)
                 b.requests.inc()
                 if len(tried) > 1:

@@ -130,7 +130,7 @@ class Backend:
         self._fails = 0
         self._oks = 0
         self._load = {"queued": 0, "running": 0, "max_concurrent": 1,
-                      "tok_s_ema": 0.0}
+                      "tok_s_ema": 0.0, "spilled": 0}
         self._saturated_until = 0.0
         self._breaker_attempt = 0
         self._next_probe_t = 0.0
@@ -179,10 +179,13 @@ class Backend:
                 "kv_transfers_inflight", 0)
 
     def load_score(self) -> float:
-        """Outstanding work per slot — the p2c comparison key."""
+        """Outstanding work per slot — the p2c comparison key. Spilled
+        streams (ISSUE 20 preemption) count as latent load: they hold
+        no slot today but WILL resume on this replica."""
         with self._lock:
             ld = self._load
-            return (ld["queued"] + ld["running"]) / max(
+            return (ld["queued"] + ld["running"]
+                    + ld.get("spilled", 0)) / max(
                 1, ld["max_concurrent"])
 
     def saturated(self, now: float | None = None) -> bool:

@@ -162,6 +162,19 @@ def pick_prefill(candidates, rng: random.Random | None = None):
     return (rng or random).choice(tied)
 
 
+def pick_batch(candidates, rng: random.Random | None = None):
+    """Batch-class choice (ISSUE 20 SLO routing): drain offline traffic
+    to the least-loaded replica instead of the affinity pick —
+    interactive requests keep prefix affinity and its hot-KV wins, while
+    batch floods spread wherever slack is (their TTFT does not matter
+    and their slots are the preemption victims). Least outstanding work
+    per slot, spilled victims included; ties break randomly."""
+    scored = [(b.load_score(), b) for b in candidates]
+    best = min(score for score, _ in scored)
+    tied = [b for score, b in scored if score == best]
+    return (rng or random).choice(tied)
+
+
 _DECODE_PREFIX = Prefix()
 
 

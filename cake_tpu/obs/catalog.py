@@ -188,9 +188,22 @@ SERIES: dict[str, tuple[str, str]] = {
     "serve.migrated_sessions": (
         COUNTER, "live sessions re-homed to a sibling replica by a "
                  "drain-migration (rolling restart)"),
+    "serve.preemptions": (
+        COUNTER, "batch streams spilled to host RAM so a higher-class "
+                 "arrival could take the slot (SLO scheduling)"),
     "serve.queue_depth": (GAUGE, "requests waiting for admission"),
     "serve.rejected": (COUNTER, "submissions refused at the queue bound"),
+    "serve.resume_ms": (
+        HISTOGRAM, "preempted-stream resume time (spill take through "
+                   "replay + attach queued)"),
+    "serve.spill_bytes": (
+        GAUGE, "host-RAM bytes held by spilled stream snapshots"),
+    "serve.spill_pages": (
+        GAUGE, "KV pages represented by spilled stream snapshots"),
     "serve.stop_matches": (COUNTER, "streams ended by a stop-string match"),
+    "serve.tenant_throttled": (
+        COUNTER, "admissions where an over-budget tenant's arrival was "
+                 "queued behind in-budget traffic of its class"),
     "serve.timeouts": (COUNTER, "requests expired (queued or mid-stream)"),
     "serve.tokens_emitted": (COUNTER, "tokens emitted by the batch engine"),
     "serve.tpot_ms": (HISTOGRAM, "inter-token gap per serving request"),
@@ -242,6 +255,12 @@ DYNAMIC: dict[str, tuple[str, str]] = {
         HISTOGRAM, "per-phase wall ms inside sampled engine steps "
                    "(admit/pages/guide/dispatch/sync/emit/idle_park and "
                    "the spec_* phases — obs/prof.PHASES)"),
+    "serve.ttft_ms.*": (
+        HISTOGRAM, "per-class submit-to-first-token (serve.session "
+                   "CLASSES — the SLO rows split interactive from "
+                   "batch)"),
+    "serve.tpot_ms.*": (
+        HISTOGRAM, "per-class inter-token gap"),
 }
 
 

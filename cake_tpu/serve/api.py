@@ -52,11 +52,13 @@ import json
 import logging
 import threading
 import time
+import uuid
+from collections import deque
 
 from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.obs import statusd as _statusd
 from cake_tpu.serve.scheduler import Draining, QueueFull
-from cake_tpu.serve.session import Session, sse_event
+from cake_tpu.serve.session import CLASSES, Session, sse_event
 
 log = logging.getLogger("cake_tpu.serve.api")
 
@@ -216,15 +218,27 @@ def _parse_request(body: dict, scheduler) -> Session:
         not isinstance(timeout, (int, float)) or timeout <= 0
     ):
         raise ValueError("'timeout_s' must be a positive number")
+    # SLO scheduling fields (ISSUE 20): validated here so serve and the
+    # gateway agree — the gateway forwards both untouched, and a typo'd
+    # class is a 400, not a silent demotion to the default
+    cls = body.get("class", "interactive")
+    if cls not in CLASSES:
+        raise ValueError(
+            f"'class' must be one of {list(CLASSES)}, got {cls!r}")
+    tenant = body.get("tenant")
+    if tenant is not None and not (
+            isinstance(tenant, str) and 0 < len(tenant) <= 64):
+        raise ValueError("'tenant' must be a non-empty string "
+                         "(at most 64 chars)")
     return Session(ids, max_tokens=max_tokens, stream=stream,
                    timeout_s=timeout, stop=stop, logprobs=logprobs,
-                   guide=guide)
+                   guide=guide, cls=cls, tenant=tenant)
 
 
 class ApiServer:
     """The serving front end; ``start_api_server`` is the entry point."""
 
-    _GUARDED_BY = {"_relays": "_relay_lock"}
+    _GUARDED_BY = {"_relays": "_relay_lock", "_batches": "_batch_lock"}
 
     def __init__(self, scheduler, status_fn=None, bind: str = "127.0.0.1",
                  port: int = 0, model_id: str = "cake-tpu", on_drain=None):
@@ -235,6 +249,11 @@ class ApiServer:
         self.on_drain = on_drain
         self._relay_lock = threading.Lock()
         self._relays = 0
+        # /v1/batch registry (ISSUE 20): results land here as each
+        # prompt finishes, so a client that disconnected mid-batch
+        # re-fetches by id instead of re-running N prompts
+        self._batch_lock = threading.Lock()
+        self._batches: dict[str, dict] = {}
         # set once a drain carries a migrate_to target: drain() then
         # waits for handler threads still splicing sibling streams
         self._migrating = threading.Event()
@@ -369,6 +388,11 @@ def _make_handler(server: ApiServer):
                     "role": st.get("role", "mixed"),
                     "kv_transfers_inflight": st.get(
                         "kv_transfers_inflight", 0),
+                    # spill pressure (ISSUE 20): victims parked in host
+                    # RAM are latent load that WILL resume here — the
+                    # gateway's p2c signal folds them into load_score
+                    "spilled": st.get("spilled", 0),
+                    "preemptions": st.get("preemptions", 0),
                 }
                 if st.get("transfer_port"):
                     body["transfer_port"] = st["transfer_port"]
@@ -385,6 +409,18 @@ def _make_handler(server: ApiServer):
                     # the same probe body dashboards already poll
                     body["slo"] = st["slo"]
                 self._json(200 if not st["draining"] else 503, body)
+            elif path.startswith("/v1/batch/"):
+                # resumable batch fetch: results recorded so far (the
+                # POST side updates the registry as prompts finish)
+                key = path.rsplit("/", 1)[1]
+                with server._batch_lock:
+                    rec = server._batches.get(key)
+                    rec = dict(rec, results=list(rec["results"])) \
+                        if rec is not None else None
+                if rec is None:
+                    self._error(404, f"no batch {key!r}")
+                else:
+                    self._json(200, rec)
             elif path.startswith("/v1/requests/"):
                 # per-request debug timeline: spans + SLO verdict for a
                 # recent request, by request id or trace id
@@ -423,6 +459,9 @@ def _make_handler(server: ApiServer):
             path = self.path.rstrip("/")
             if path == "/v1/fleet/drain":
                 self._fleet_drain()
+                return
+            if path == "/v1/batch":
+                self._batch_request()
                 return
             if path != "/v1/completions":
                 self._error(404, f"no route for POST {self.path}")
@@ -578,6 +617,134 @@ def _make_handler(server: ApiServer):
                 "prompt_tokens": len(sess.prompt_ids),
                 "snapshot_bytes": len(payload),
             })
+
+        def _batch_request(self) -> None:
+            """``POST /v1/batch`` (ISSUE 20): N prompts in, one JSON
+            result set out — the offline workload's front door. Each
+            prompt becomes its own session (class defaults to "batch",
+            so the scheduler deprioritizes them behind interactive
+            traffic and they are preemption victims); submissions
+            self-throttle against QueueFull instead of erroring, and
+            every finished prompt lands in the server-side registry
+            first, so the batch is resumable by id after a disconnect
+            (``GET /v1/batch/<id>`` or an idempotent re-POST)."""
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._error(400, f"bad JSON body: {e}")
+                return
+            if not isinstance(body, dict):
+                self._error(400, "body must be a JSON object")
+                return
+            prompts = body.get("prompts")
+            if (not isinstance(prompts, list) or not prompts
+                    or len(prompts) > 256):
+                self._error(400, "'prompts' must be a list of 1..256 "
+                                 "prompts")
+                return
+            bid = body.get("id")
+            if bid is not None and not (isinstance(bid, str)
+                                        and 0 < len(bid) <= 128):
+                self._error(400, "'id' must be a non-empty string")
+                return
+            with server._batch_lock:
+                if bid is not None and bid in server._batches:
+                    # idempotent re-POST: the batch already ran (or is
+                    # running) — answer from the registry
+                    rec = server._batches[bid]
+                    out = dict(rec, results=list(rec["results"]))
+                    self._json(200, out)
+                    return
+                bid = bid or f"batch-{uuid.uuid4().hex[:12]}"
+                rec = {"id": bid, "object": "batch", "n": len(prompts),
+                       "done": 0, "status": "running",
+                       "results": [None] * len(prompts)}
+                server._batches[bid] = rec
+            shared = {k: v for k, v in body.items()
+                      if k not in ("prompts", "id", "prompt",
+                                   "prompt_ids", "stream")}
+            shared.setdefault("class", "batch")
+
+            def record(i: int, result: dict) -> None:
+                with server._batch_lock:
+                    rec["results"][i] = result
+                    rec["done"] += 1
+
+            pending: deque = deque()
+            for i, p in enumerate(prompts):
+                per = dict(shared)
+                if isinstance(p, str):
+                    per["prompt"] = p
+                else:
+                    per["prompt_ids"] = p
+                try:
+                    sess = _parse_request(per, scheduler)
+                except ValueError as e:
+                    record(i, {"error": str(e), "status": 400})
+                    continue
+                sess.raw_body = per
+                sess.slo = scheduler.slo
+                pending.append((i, sess))
+            active: deque = deque()
+            while pending or active:
+                while pending:
+                    i, sess = pending[0]
+                    try:
+                        scheduler.submit(sess)
+                    except QueueFull:
+                        break  # self-throttle: drain one, then retry
+                    except Draining:
+                        for j, s in list(pending):
+                            record(j, {"error": "server is draining",
+                                       "status": 503})
+                        pending.clear()
+                        break
+                    pending.popleft()
+                    active.append((i, sess))
+                if active:
+                    i, sess = active.popleft()
+                    record(i, self._collect_unary(sess))
+                elif pending:
+                    time.sleep(0.05)
+            with server._batch_lock:
+                rec["status"] = "done"
+                out = dict(rec, results=list(rec["results"]))
+            try:
+                self._json(200, out)
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                pass  # results are in the registry; re-fetch by id
+
+        def _collect_unary(self, sess) -> dict:
+            """Pump one batch session to completion and return its
+            result object (never raises; errors become result rows)."""
+            texts: list[str] = []
+            try:
+                while True:
+                    ev = self._next_event(sess)
+                    if ev[0] == "token":
+                        if ev[2]:
+                            texts.append(ev[2])
+                    elif ev[0] == "done":
+                        _, reason, usage, tail = ev
+                        if tail:
+                            texts.append(tail)
+                        out = {"id": sess.id, "finish_reason": reason,
+                               "usage": usage,
+                               "token_ids": list(sess.generated)}
+                        if scheduler.engine.tokenizer is not None:
+                            out["text"] = "".join(texts)
+                        return out
+                    elif ev[0] == "migrate":
+                        # batches don't relay: the prompt re-runs via
+                        # a re-POST against the sibling
+                        return {"error": "replica drained mid-batch; "
+                                         "re-submit", "status": 503}
+                    else:
+                        return {"error": ev[2], "status": ev[1]}
+            finally:
+                if sess.finish_reason is None:
+                    scheduler.cancel(sess)
 
         def _fleet_drain(self) -> None:
             """Gateway-initiated rolling restart (ISSUE 19): begin a

@@ -20,8 +20,28 @@ turns it into a ``429`` without ever stalling the accept loop.
 
 Iteration-level scheduling is the Orca lesson and continuous batching the
 vLLM one; both live in the engine already — this layer adds what a service
-needs around them: admission, fairness (FIFO arrival order), deadlines,
-cancellation, and drain.
+needs around them: admission, fairness, deadlines, cancellation, and
+drain.
+
+SLO-aware scheduling (ISSUE 20), ``sched_policy="slo"`` (the default;
+``"fifo"`` is the single-tenant baseline the bench A/B's against):
+
+- **Priority classes** — each session carries a class
+  (``session.CLASSES``, highest first): interactive arrivals jump batch
+  arrivals in the admission queue (FIFO within a class).
+- **Preemption with host-RAM KV spill** — an interactive arrival that
+  finds every slot held by batch streams picks a victim (lowest class,
+  over-budget tenants preferred, most recently admitted), exports its
+  stream via the disagg snapshot path into the bounded
+  :class:`~cake_tpu.serve.spill.SpillStore`, and takes the slot + pages.
+  The victim resumes bit-identically through the engine's import path
+  when pressure drops; device rows the export captured past what the
+  client saw replay into the session first, so the client's stream is
+  byte-identical to an unpreempted run.
+- **Per-tenant fairness** — a decaying token-rate accountant keyed by
+  the session's ``tenant`` (defaults to its class): over-budget tenants
+  queue behind in-budget arrivals of the same class and are preferred
+  preemption victims (``serve.tenant_throttled``).
 """
 
 from __future__ import annotations
@@ -38,9 +58,16 @@ from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.obs import prof as obs_prof
 from cake_tpu.obs import reqtrace as obs_reqtrace
 from cake_tpu.serve import session as _session
-from cake_tpu.serve.session import Session
+from cake_tpu.serve.session import CLASSES, Session
+from cake_tpu.serve.spill import SpillFull, SpillStore
 
 log = logging.getLogger("cake_tpu.serve.scheduler")
+
+# admission policies: "slo" = class-priority + preemption + tenant
+# fairness (the production mix); "fifo" = strict arrival order, no
+# preemption (the single-tenant baseline the CAKE_BENCH_SLO row A/B's
+# class-aware scheduling against)
+SCHED_POLICIES = ("slo", "fifo")
 
 # replica roles (cake_tpu/disagg): what this scheduler DOES with a
 # request is role-driven — "prefill" runs bucketed prefill only and
@@ -58,6 +85,60 @@ _INFLIGHT = obs_metrics.gauge("disagg.inflight")
 # sessions re-homed to a sibling replica by a drain (ISSUE 19 rolling
 # restarts): queued ones re-run whole, admitted ones ride a KV snapshot
 MIGRATED = obs_metrics.counter("serve.migrated_sessions")
+
+# SLO-aware scheduling (ISSUE 20): batch victims spilled to host RAM
+# for an interactive arrival, how long their resume took (import begin
+# through attach queued, replay included), and admissions where an
+# over-budget tenant was queued behind in-budget arrivals
+PREEMPTIONS = obs_metrics.counter("serve.preemptions")
+RESUME_MS = obs_metrics.histogram("serve.resume_ms")
+THROTTLED = obs_metrics.counter("serve.tenant_throttled")
+
+
+class TenantAccounts:
+    """Decayed per-tenant token-rate shares (engine thread only — fed by
+    ``_deliver``, read by admission ordering and victim selection).
+
+    A tenant is over budget when its share of recently-emitted tokens
+    exceeds ``factor``× its fair share (1/active tenants) — a relative
+    test, so it needs no absolute rate knob and a lone tenant is never
+    over. The half-life makes monopoly a *recent-history* property: a
+    tenant that backs off re-earns its place within a few half-lives.
+    """
+
+    _THREAD_DOMAIN = "engine"
+
+    def __init__(self, half_life_s: float = 10.0, factor: float = 2.0):
+        self.half_life_s = half_life_s
+        self.factor = factor
+        self._tokens: dict[str, float] = {}
+        self._t = time.monotonic()
+
+    def _decay(self) -> None:
+        now = time.monotonic()
+        dt = now - self._t
+        if dt <= 0:
+            return
+        self._t = now
+        k = 0.5 ** (dt / self.half_life_s)
+        for tenant in list(self._tokens):
+            v = self._tokens[tenant] * k
+            if v < 0.5:
+                del self._tokens[tenant]  # idle tenants leave the census
+            else:
+                self._tokens[tenant] = v
+
+    def add(self, tenant: str, n: int = 1) -> None:
+        self._decay()
+        self._tokens[tenant] = self._tokens.get(tenant, 0.0) + n
+
+    def over_budget(self, tenant: str) -> bool:
+        self._decay()
+        total = sum(self._tokens.values())
+        n = len(self._tokens)
+        if n < 2 or total <= 0:
+            return False
+        return self._tokens.get(tenant, 0.0) / total > self.factor / n
 
 
 class QueueFull(Exception):
@@ -100,6 +181,7 @@ class Scheduler:
         "_xfer_out": "_cond",
         "_engine_stats": "_cond",
         "_migrate_to": "_cond",
+        "_spilled": "_cond",
     }
 
     # Thread domains, machine-checked by cakelint CK-THREAD: the class
@@ -119,7 +201,7 @@ class Scheduler:
         "submit_import", "abort_import", "import_meta",
         "xfer_out_enter", "xfer_out_exit", "kv_transfers_inflight",
         "retry_after_s", "stats", "_sync_inflight", "migrate_out",
-        "can_migrate",
+        "can_migrate", "set_policy",
     )
 
     def __init__(self, engine, queue_depth: int = 64,
@@ -127,11 +209,16 @@ class Scheduler:
                  role: str = "mixed", transfer_codec: str = "none",
                  transfer_deadline_s: float = 15.0,
                  import_ttl_s: float = 120.0,
-                 slo: obs_reqtrace.SloTracker | None = None):
+                 slo: obs_reqtrace.SloTracker | None = None,
+                 sched_policy: str = "slo", spill_mb: float = 64.0,
+                 fairness_factor: float = 2.0):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        if sched_policy not in SCHED_POLICIES:
+            raise ValueError(f"sched_policy must be one of "
+                             f"{SCHED_POLICIES}, got {sched_policy!r}")
         if role != "mixed" and not (hasattr(engine, "export_stream")
                                     and getattr(engine, "paged", False)):
             raise ValueError(
@@ -150,6 +237,28 @@ class Scheduler:
         # SLO accounting (--slo-ttft-ms/--slo-tpot-ms): sessions judge
         # themselves against this tracker at finish (obs/reqtrace)
         self.slo = slo
+        # SLO-aware scheduling (ISSUE 20). sched_policy is written only
+        # by set_policy (under _cond) and read by the engine thread each
+        # pass — a str attribute swap, tolerated like the _tok_s reads.
+        # The spill store exists only when the engine can export pages
+        # (SingleStreamEngine and slot-layout engines degrade to class
+        # ordering without preemption).
+        self.sched_policy = sched_policy
+        can_spill = bool(hasattr(engine, "export_stream")
+                         and getattr(engine, "paged", False))
+        self._spill: SpillStore | None = (
+            SpillStore(max_bytes=int(spill_mb * (1 << 20)))
+            if can_spill and spill_mb > 0 else None)
+        # spilled victims awaiting resume: {"sess": Session, "t": float}
+        self._spilled: list[dict] = []
+        # token-rate fairness accountant — engine-thread-only (fed by
+        # _deliver, read by admission/victim ordering), so it stays out
+        # of _GUARDED_BY like the throughput EMA
+        self._tenants = TenantAccounts(factor=fairness_factor)
+        self._n_preempt = 0  # engine-thread writes, atomic healthz reads
+        # testing/chaos.SpillChaos hook, consulted on the engine thread
+        # at the preempt/resume protocol points (tests arm it directly)
+        self.spill_chaos = None
         self.transfer_port: int | None = None
         self.max_concurrent = 0  # set by start() (dp may pad the batch up)
         self._queue: deque[Session] = deque()
@@ -286,6 +395,16 @@ class Scheduler:
         disagg export plane). Queued sessions re-home regardless."""
         return bool(hasattr(self.engine, "export_stream")
                     and getattr(self.engine, "paged", False))
+
+    def set_policy(self, policy: str) -> None:
+        """Swap the admission policy between runs (the CAKE_BENCH_SLO
+        row A/B's "fifo" against "slo" on one warmed stack). Handler-
+        safe; takes effect at the engine thread's next pass."""
+        if policy not in SCHED_POLICIES:
+            raise ValueError(f"sched_policy must be one of "
+                             f"{SCHED_POLICIES}, got {policy!r}")
+        with self._cond:
+            self.sched_policy = policy
 
     def migrate_out(self, target: dict | None) -> int:
         """Begin a drain that RE-HOMES live sessions instead of making
@@ -462,6 +581,7 @@ class Scheduler:
         with self._cond:
             queued = len(self._queue)
             running = len(self._by_sid)
+            spilled = len(self._spilled)
             draining = self._draining
             # the engine block is the ENGINE THREAD's own snapshot
             # (refreshed every loop pass) — handler threads must not
@@ -476,6 +596,15 @@ class Scheduler:
             "draining": draining,
             "observed_tok_s": round(self._tok_s, 2),
             "role": self.role,
+            "sched_policy": self.sched_policy,
+            # spill pressure (ISSUE 20): victims parked in host RAM and
+            # the preemption count — /healthz forwards both so the
+            # gateway's p2c load signal sees latent load that will
+            # resume here
+            "spilled": spilled,
+            "preemptions": self._n_preempt,
+            **({"spill": self._spill.stats()}
+               if self._spill is not None else {}),
             "kv_transfers_inflight": self.kv_transfers_inflight(),
             **({"transfer_port": self.transfer_port}
                if self.transfer_port else {}),
@@ -487,6 +616,7 @@ class Scheduler:
     # -- engine thread --------------------------------------------------------
     def _has_work_locked(self) -> bool:
         return bool(self._queue or self._by_sid or self._import_inbox
+                    or self._spilled
                     or self._migrate_to is not None
                     or self.engine.pending_admissions())
 
@@ -549,6 +679,7 @@ class Scheduler:
                     obs_prof.sentinel().mark_steady()
                 self._deliver(row)
                 self._retire()
+                self._sweep_spilled()
                 self._fail_lost_attaches()
                 self._refresh_engine_stats()
             except Exception as e:  # engine fault: fail every session
@@ -603,52 +734,309 @@ class Scheduler:
     def _admit(self) -> None:
         """Move queued sessions into the engine while slots are spoken
         for < max_concurrent (the engine interleaves each arrival's
-        prefill with decode; its own FIFO keeps arrival order)."""
+        prefill with decode). Under ``sched_policy="slo"`` the pick is
+        class-ordered — spilled resumes and queued arrivals merge, and
+        a saturated engine preempts a batch victim for a waiting
+        higher-class arrival (``_maybe_preempt``); ``"fifo"`` keeps
+        strict arrival order with no preemption."""
+        self._maybe_resume_storm()
         while True:
-            with self._cond:
-                if not self._queue or len(self._by_sid) >= self.max_concurrent:
-                    return
-                sess = self._queue.popleft()
-                _session.QUEUE_DEPTH.set(len(self._queue))
-                sid = self._next_sid
-                self._next_sid += 1
-            ctx = sess.reqtrace
-            if ctx is not None:
-                t_now = time.time()
-                ctx.add_span("serve.queue", sess.t_submit_unix,
-                             (t_now - sess.t_submit_unix) * 1e3,
-                             request=sess.id)
-            admit_span = (ctx.span("serve.admit", request=sess.id)
-                          if ctx is not None else contextlib.nullcontext())
+            while True:
+                with self._cond:
+                    pick = (self._pick_next_locked()
+                            if len(self._by_sid) < self.max_concurrent
+                            else None)
+                if pick is None:
+                    break
+                kind, item = pick
+                if kind == "resume":
+                    self._resume_one(item)
+                else:
+                    self._admit_one(item)
+            if not self._maybe_preempt():
+                return
+
+    def _pick_next_locked(self):
+        """Pop and return the next admission candidate: ``("resume",
+        entry)`` for a spilled victim, ``("admit", session)`` for a
+        queued arrival, None when nothing is eligible. Ordering under
+        "slo": higher class first; within a class, in-budget tenants
+        before over-budget ones, resumes before fresh arrivals (they
+        are strictly older), FIFO last. "fifo" is strict arrival order
+        (spilled entries only exist under "slo", but drain-overlap ones
+        still resume here)."""
+        if self.sched_policy == "fifo":
+            if self._spilled:
+                return ("resume", self._spilled.pop(0))
+            if not self._queue:
+                return None
+            sess = self._queue.popleft()
+            _session.QUEUE_DEPTH.set(len(self._queue))
+            return ("admit", sess)
+        best_key, best = None, None
+        for j, ent in enumerate(self._spilled):
+            s = ent["sess"]
+            key = (CLASSES.index(s.cls),
+                   self._tenants.over_budget(s.tenant), 0, j)
+            if best_key is None or key < best_key:
+                best_key, best = key, ("resume", j)
+        for i, s in enumerate(self._queue):
+            key = (CLASSES.index(s.cls),
+                   self._tenants.over_budget(s.tenant), 1, i)
+            if best_key is None or key < best_key:
+                best_key, best = key, ("admit", i)
+        if best is None:
+            return None
+        kind, idx = best
+        if kind == "resume":
+            return ("resume", self._spilled.pop(idx))
+        sess = self._queue[idx]
+        if any(CLASSES.index(q.cls) == CLASSES.index(sess.cls)
+               for q in list(self._queue)[:idx]):
+            # an earlier same-class arrival was bypassed — only an
+            # over-budget tenant sorts behind within its class
+            THROTTLED.inc()
+        del self._queue[idx]
+        _session.QUEUE_DEPTH.set(len(self._queue))
+        return ("admit", sess)
+
+    def _admit_one(self, sess: Session) -> None:
+        """Hand one queued session to the engine (enqueue, or attach a
+        begun import for a gateway-routed resume)."""
+        with self._cond:
+            sid = self._next_sid
+            self._next_sid += 1
+        ctx = sess.reqtrace
+        if ctx is not None:
+            t_now = time.time()
+            ctx.add_span("serve.queue", sess.t_submit_unix,
+                         (t_now - sess.t_submit_unix) * 1e3,
+                         request=sess.id)
+        admit_span = (ctx.span("serve.admit", request=sess.id)
+                      if ctx is not None else contextlib.nullcontext())
+        try:
+            with admit_span:
+                if sess.resume_xfer is not None:
+                    # a resumed import: attach the already-landed
+                    # pages to a slot (page-table edit) — the
+                    # snapshot, not the request body, is the source
+                    # of stream state
+                    self.engine.import_attach(sess.resume_xfer, sid)
+                    with self._cond:
+                        self._imports_meta.pop(sess.resume_xfer, None)
+                    self._sync_inflight()
+                # guide= only when constrained: unconstrained
+                # admission keeps the bare protocol every engine
+                # stub speaks
+                elif sess.guide is not None:
+                    self.engine.enqueue(sess.prompt_ids, sid,
+                                        guide=sess.guide)
+                else:
+                    self.engine.enqueue(sess.prompt_ids, sid)
+        except KeyError as e:  # unknown/expired transfer
+            sess.fail(409, str(e))
+            return
+        except ValueError as e:  # encode raced the window, etc.
+            sess.fail(400, str(e))
+            return
+        sess.t_admit_unix = time.time()
+        sess.stream_id = sid
+        with self._cond:
+            self._by_sid[sid] = sess
+
+    # -- preemption + spill (ISSUE 20) ----------------------------------------
+    def _chaos_fire(self, kind: str) -> bool:
+        chaos = self.spill_chaos
+        return bool(chaos is not None and chaos.fire(kind))
+
+    def _maybe_resume_storm(self) -> None:
+        """Chaos hook: a "resume_storm" fault resumes EVERY spilled
+        victim at once, regardless of capacity — the attaches queue
+        FIFO-fair at the engine and their page demand drives the pool's
+        deferral path (`kvpool.admit_defers`) under pressure."""
+        if self._spill is None:
+            return
+        with self._cond:
+            if not self._spilled:
+                return
+        if not self._chaos_fire("resume_storm"):
+            return
+        with self._cond:
+            storm, self._spilled = self._spilled, []
+        log.warning("chaos: resume storm over %d spilled streams",
+                    len(storm))
+        for ent in storm:
+            self._resume_one(ent)
+
+    def _resume_one(self, ent: dict) -> None:
+        """Bring a spilled victim back: pop its payload from the store,
+        import it through the engine's snapshot path, replay any tokens
+        the export captured past what the client saw (buffered device
+        rows drain into the snapshot, and `finish` discarded their
+        emission), and attach to a fresh slot. The replay makes the
+        client's stream byte-identical to an unpreempted run; the
+        engine emits only NEW tokens after the attach."""
+        sess: Session = ent["sess"]
+        if sess.cancelled.is_set():
+            _session.CANCELLED.inc()
+            self._spill.discard(sess.id)
+            sess.finish("cancelled")
+            return
+        t0 = time.perf_counter()
+        t0_unix = time.time()
+        payload = self._spill.take(sess.id)
+        if payload is None:
+            sess.fail(503, "spilled stream lost; retry")
+            return
+        try:
+            meta = self.engine.import_begin(payload)
+        except Exception as e:
+            log.exception("resume import of %s failed", sess.id)
+            sess.fail(500, f"spill resume failed: {e}")
+            return
+        xid = meta["xfer_id"]
+        # replay the suffix the client never saw; the session's stop
+        # holdback / max_tokens clamp applies exactly as if the tokens
+        # had streamed live
+        n_seen = len(sess.generated)
+        for tid, txt in zip(meta["generated"][n_seen:],
+                            meta["texts"][n_seen:]):
+            sess.on_token(tid, txt)
+            if sess.stop_hit or len(sess.generated) >= sess.max_tokens:
+                break
+        if sess.stop_hit or len(sess.generated) >= sess.max_tokens:
+            # the replay alone finished the request: no slot needed
+            self.engine.import_abort(xid)
+            sess.finish("stop" if sess.stop_hit else "length")
+            return
+        with self._cond:
+            sid = self._next_sid
+            self._next_sid += 1
+        try:
+            self.engine.import_attach(xid, sid)
+        except KeyError as e:
+            sess.fail(409, str(e))
+            return
+        sess.stream_id = sid
+        with self._cond:
+            self._by_sid[sid] = sess
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        RESUME_MS.observe(dt_ms)
+        if sess.reqtrace is not None:
+            sess.reqtrace.add_span("serve.resume", t0_unix, dt_ms,
+                                   request=sess.id)
+
+    def _pages_of(self, sess: Session) -> int:
+        """KV pages a live stream holds (ceil of its token count over
+        the pool's page size) — bookkeeping for the spill gauges."""
+        with self._cond:
+            ps = (self._engine_stats.get("kvpool") or {}).get("page_size", 0)
+        n = len(sess.prompt_ids) + len(sess.generated)
+        return (n - 1) // ps + 1 if ps and n else 0
+
+    def _maybe_preempt(self) -> bool:
+        """A waiting arrival outranks a running stream: spill the worst
+        victim (lowest class, over-budget tenant preferred, most
+        recently admitted) to host RAM and free its slot + pages.
+        Returns True when a preemption landed (the admit loop then
+        re-picks). The export is side-effect-free until `finish`, so
+        every refusal path — store full, victim raced retirement,
+        chaos fault — leaves the victim decoding untouched."""
+        if self._spill is None or self.sched_policy != "slo":
+            return False
+        with self._cond:
+            if self._draining or len(self._by_sid) < self.max_concurrent:
+                return False
+            waiting = [ent["sess"] for ent in self._spilled]
+            waiting += list(self._queue)
+            if not waiting:
+                return False
+            want = min(CLASSES.index(s.cls) for s in waiting)
+            cands = [
+                (sid, sess) for sid, sess in self._by_sid.items()
+                if CLASSES.index(sess.cls) > want
+                and sess.handoff is None and sess.logprobs == 0
+                and sess.finish_reason is None
+                and not sess.cancelled.is_set()
+            ]
+        if not cands:
+            return False
+        cands.sort(key=lambda it: (
+            -CLASSES.index(it[1].cls),
+            not self._tenants.over_budget(it[1].tenant),
+            -(it[1].t_admit_unix or 0.0),
+        ))
+        for sid, sess in cands:
+            slot = self._slot_of(sid)
+            if slot is None or self.engine.streams[slot].done:
+                continue  # finished since the locked snapshot
+            if self._chaos_fire("victim_finish"):
+                # injected selection race: the victim "finished" between
+                # pick and export — bail out, nothing was touched
+                log.warning("chaos: victim %d finished during spill", sid)
+                return False
             try:
-                with admit_span:
-                    if sess.resume_xfer is not None:
-                        # a resumed import: attach the already-landed
-                        # pages to a slot (page-table edit) — the
-                        # snapshot, not the request body, is the source
-                        # of stream state
-                        self.engine.import_attach(sess.resume_xfer, sid)
-                        with self._cond:
-                            self._imports_meta.pop(sess.resume_xfer, None)
-                        self._sync_inflight()
-                    # guide= only when constrained: unconstrained
-                    # admission keeps the bare protocol every engine
-                    # stub speaks
-                    elif sess.guide is not None:
-                        self.engine.enqueue(sess.prompt_ids, sid,
-                                            guide=sess.guide)
-                    else:
-                        self.engine.enqueue(sess.prompt_ids, sid)
-            except KeyError as e:  # unknown/expired transfer
-                sess.fail(409, str(e))
+                if self._chaos_fire("spill_full"):
+                    raise SpillFull("chaos: spill store at capacity")
+                payload = self.engine.export_stream(
+                    sid, codec=self.transfer_codec)
+                claim = self._spill.spill_begin(
+                    sess.id, len(payload), pages=self._pages_of(sess))
+            except SpillFull as e:
+                log.info("preemption skipped: %s", e)
+                return False  # payload dropped; victim keeps decoding
+            except ValueError:
+                continue  # stream raced retirement / already spilled
+            except Exception:
+                log.exception("export of victim %d failed", sid)
+                return False
+            try:
+                self.engine.finish(sid)  # frees the slot + pages
+                with self._cond:
+                    self._by_sid.pop(sid, None)
+                    self._spilled.append(
+                        {"sess": sess, "t": time.monotonic()})
+                self._spill.spill_commit(claim, payload)
+            except Exception:
+                self._spill.spill_abort(claim)
+                raise
+            self._n_preempt += 1
+            PREEMPTIONS.inc()
+            if sess.reqtrace is not None:
+                sess.reqtrace.add_span("serve.preempt", time.time(), 0.0,
+                                       request=sess.id)
+            log.info("preempted stream %d (%s/%s) for a higher-class "
+                     "arrival", sid, sess.cls, sess.tenant)
+            return True
+        return False
+
+    def _sweep_spilled(self) -> None:
+        """Spilled victims still own a deadline and a client: close out
+        the ones that cancelled or expired while parked, and drop their
+        payloads (they will never resume here)."""
+        if self._spill is None:
+            return
+        with self._cond:
+            ents = list(self._spilled)
+        if not ents:
+            return
+        now = time.perf_counter()
+        for ent in ents:
+            sess = ent["sess"]
+            reason = None
+            if sess.cancelled.is_set():
+                _session.CANCELLED.inc()
+                reason = "cancelled"
+            elif sess.deadline is not None and now > sess.deadline:
+                _session.TIMEOUTS.inc()
+                reason = "timeout"
+            if reason is None:
                 continue
-            except ValueError as e:  # encode raced the window, etc.
-                sess.fail(400, str(e))
-                continue
-            sess.t_admit_unix = time.time()
-            sess.stream_id = sid
+            self._spill.discard(sess.id)
             with self._cond:
-                self._by_sid[sid] = sess
+                if ent in self._spilled:
+                    self._spilled.remove(ent)
+            sess.finish(reason)
 
     def _deliver(self, row) -> None:
         """Fan one emitted row out to its sessions' event queues. A
@@ -675,6 +1063,7 @@ class Scheduler:
                 continue
             sess.on_token(tok.id, tok.text,
                           logprobs=getattr(tok, "logprobs", None))
+            self._tenants.add(sess.tenant)
             n += 1
             if tok.is_end_of_stream:
                 # the engine records WHY it ended the stream ("eos" |
@@ -776,6 +1165,15 @@ class Scheduler:
                 self._by_sid.pop(sid, None)
             sess.migrate_ready(payload, target)
             MIGRATED.inc()
+        # spilled victims migrate too: their snapshot is already in host
+        # RAM, so it rides the same path without touching the engine
+        with self._cond:
+            spilled, self._spilled = self._spilled, []
+        for ent in spilled:
+            sess = ent["sess"]
+            payload = self._spill.take(sess.id) if self._spill else None
+            sess.migrate_ready(payload, target)
+            MIGRATED.inc()
         return True
 
     def _slot_of(self, sid: int) -> int | None:
@@ -827,7 +1225,12 @@ class Scheduler:
             self._queue.clear()
             running = list(self._by_sid.values())
             self._by_sid.clear()
+            spilled = [ent["sess"] for ent in self._spilled]
+            self._spilled.clear()
             _session.QUEUE_DEPTH.set(0)
-        for s in queued + running:
+        for s in spilled:
+            if self._spill is not None:
+                self._spill.discard(s.id)
+        for s in queued + running + spilled:
             if s.finish_reason is None:
                 s.fail(503, message)

@@ -41,6 +41,14 @@ from cake_tpu.obs import flight as obs_flight
 from cake_tpu.obs import metrics as obs_metrics
 from cake_tpu.obs import reqtrace as obs_reqtrace
 
+# Priority classes (ISSUE 20), highest first: the scheduler admits (and
+# preempts) by CLASSES.index — "interactive" jumps "batch" in the
+# admission queue, and a saturated engine spills a batch victim's KV to
+# host RAM for an interactive arrival. The serve API validates the
+# request's "class" against this tuple (400 on anything else); "tenant"
+# defaults to the class and keys the fairness accountant.
+CLASSES = ("interactive", "batch")
+
 # Process-global serving instruments (get-or-create: the scheduler and the
 # API handler share these series without import-order coupling).
 TTFT_MS = obs_metrics.histogram("serve.ttft_ms")
@@ -79,12 +87,20 @@ class Session:
                  stream: bool = True, timeout_s: float | None = None,
                  request_id: str | None = None,
                  stop: list[str] | None = None, logprobs: int = 0,
-                 guide=None):
+                 guide=None, cls: str = "interactive",
+                 tenant: str | None = None):
         self.id = request_id or uuid.uuid4().hex[:12]
         self.prompt_ids = list(prompt_ids)
         self.max_tokens = int(max_tokens)
         self.stream = bool(stream)
         self.timeout_s = timeout_s
+        # SLO-aware scheduling (ISSUE 20): priority class + fairness
+        # tenant. The scheduler admits by class rank and accounts token
+        # rates by tenant; per-class latency variants land alongside the
+        # aggregate histograms so a batch flood cannot hide interactive
+        # tail latency in the blended series.
+        self.cls = cls if cls in CLASSES else "interactive"
+        self.tenant = tenant or self.cls
         # structured generation
         self.stop = list(stop or [])
         self.logprobs = max(0, int(logprobs))
@@ -143,6 +159,8 @@ class Session:
         if self._t_last is None:
             self.ttft_ms = (now - self.t_submit) * 1e3
             TTFT_MS.observe(self.ttft_ms)
+            obs_metrics.histogram(
+                f"serve.ttft_ms.{self.cls}").observe(self.ttft_ms)
             self._t_first_unix = time.time()
             ctx = self.reqtrace
             if ctx is not None:
@@ -159,6 +177,8 @@ class Session:
             gap_ms = (now - self._t_last) * 1e3
             self._tpot_sum_ms += gap_ms
             TPOT_MS.observe(gap_ms)
+            obs_metrics.histogram(
+                f"serve.tpot_ms.{self.cls}").observe(gap_ms)
         self._t_last = now
         self.generated.append(tok_id)
         top = logprobs[: self.logprobs] if (self.logprobs and logprobs) \

@@ -32,6 +32,15 @@ a tiered fleet isolates them. The report splits TTFT p50/p95 by prompt
 bucket (``ttft_ms_by_prompt_len``) so the short-prompt tail is visible
 next to the long one.
 
+``--workload mixed-class`` (ISSUE 20) is the SLO-scheduling regime: an
+interactive trickle (every 4th request, ``"class": "interactive"``)
+under a batch flood (the rest, ``"class": "batch"``), Poisson arrivals,
+every request streaming. Under FIFO the interactive TTFT tail is
+hostage to however many batch requests queued first; the class-aware
+scheduler jumps them (and preempts batch victims to host-RAM spill when
+slots are full). The report splits TTFT p50/p95 by class
+(``ttft_ms_by_class``) — the ``CAKE_BENCH_SLO=1`` acceptance signal.
+
 ``--retry-429`` makes a 429 honor its ``Retry-After`` and resubmit
 (bounded) instead of counting a hard rejection — the realistic open-loop
 client against a saturated server or gateway. ``--spawn-backends N``
@@ -205,9 +214,20 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     requests meeting BOTH set targets — next to the percentile view:
     percentiles say how slow the tail was, goodput says how many users
     got what the SLO promised."""
-    if workload not in ("text", "json", "churn", "mixed-prefill"):
-        raise ValueError(f"workload must be 'text', 'json', 'churn' or "
-                         f"'mixed-prefill', got {workload!r}")
+    if workload not in ("text", "json", "churn", "mixed-prefill",
+                        "mixed-class"):
+        raise ValueError(f"workload must be 'text', 'json', 'churn', "
+                         f"'mixed-prefill' or 'mixed-class', "
+                         f"got {workload!r}")
+    if workload == "mixed-class":
+        # the SLO-scheduling regime (ISSUE 20): an interactive trickle
+        # under a batch flood, open loop — the per-class TTFT split is
+        # the whole point
+        if rate is None:
+            rate = max(2.0, 2.0 * concurrency)
+        if not stream:
+            raise ValueError("workload='mixed-class' measures per-class "
+                             "TTFT tails; it needs streaming responses")
     if workload == "mixed-prefill":
         # the disagg interference regime: bimodal prompt lengths under
         # Poisson arrivals (open loop — the honest view of the tail the
@@ -236,11 +256,18 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
     results: list[dict] = [None] * n  # type: ignore[list-item]
     t_start = time.perf_counter()
 
+    def _class_of(i: int) -> str:
+        # every 4th request is the interactive trickle; the rest are
+        # the batch flood it must cut through
+        return "interactive" if i % 4 == 0 else "batch"
+
     def fire(i: int) -> None:
         body = dict(frags[i], max_tokens=max_tokens, stream=stream)
         if workload == "json":
             body["response_format"] = {"type": "json_schema",
                                        "schema": JSON_WORKLOAD_SCHEMA}
+        if workload == "mixed-class":
+            body["class"] = _class_of(i)
         abort_after = (2 if disconnect_every
                        and i % disconnect_every == disconnect_every - 1
                        else None)
@@ -325,6 +352,20 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
                   "p95": round(_percentile(xs, 0.95) * 1e3, 1),
                   "n": len(xs)}
         for ln, xs in sorted(by_len.items())}
+    # TTFT split by class (mixed-class): under FIFO the aggregate hides
+    # the interactive tail inside the batch flood's — the split is the
+    # CAKE_BENCH_SLO acceptance signal
+    ttft_by_class: dict[str, dict] = {}
+    if workload == "mixed-class":
+        by_cls: dict[str, list[float]] = {}
+        for i, r in enumerate(results):
+            if r and r.get("tokens") and r.get("ttft_s") is not None:
+                by_cls.setdefault(_class_of(i), []).append(r["ttft_s"])
+        ttft_by_class = {
+            cls: {"p50": round(_percentile(xs, 0.5) * 1e3, 1),
+                  "p95": round(_percentile(xs, 0.95) * 1e3, 1),
+                  "n": len(xs)}
+            for cls, xs in sorted(by_cls.items())}
     slo = None
     if slo_ttft_ms is not None or slo_tpot_ms is not None:
         good = 0
@@ -373,6 +414,7 @@ def run_load(url: str, n: int, concurrency: int = 4, max_tokens: int = 32,
         },
         **({"ttft_ms_by_prompt_len": ttft_by_len}
            if len(ttft_by_len) > 1 else {}),
+        **({"ttft_ms_by_class": ttft_by_class} if ttft_by_class else {}),
         **({"slo": slo} if slo is not None else {}),
         "results": results,
     }
@@ -625,7 +667,7 @@ def main(argv=None) -> int:
     p.add_argument("--no-stream", action="store_true",
                    help="unary JSON responses instead of SSE")
     p.add_argument("--workload", choices=["text", "json", "churn",
-                                          "mixed-prefill"],
+                                          "mixed-prefill", "mixed-class"],
                    default="text",
                    help="json: schema-constrained requests "
                         "(response_format json_schema), responses "
@@ -638,7 +680,11 @@ def main(argv=None) -> int:
                         "mixed-prefill: the disagg interference regime "
                         "— Poisson arrivals with a bimodal prompt mix "
                         "(--prompt-len defaults to 8,512); the report "
-                        "splits TTFT by prompt bucket")
+                        "splits TTFT by prompt bucket. mixed-class: the "
+                        "SLO-scheduling regime — an interactive trickle "
+                        "(every 4th request) under a batch flood, "
+                        "Poisson arrivals; the report splits TTFT by "
+                        "class (ttft_ms_by_class)")
     p.add_argument("--disconnect-every", type=int, default=None,
                    dest="disconnect_every", metavar="N",
                    help="every Nth request walks away after 2 tokens "
